@@ -1,0 +1,20 @@
+"""seldon-core-tpu: TPU-native inference-graph serving framework.
+
+A ground-up redesign of Seldon Core's capabilities (reference at
+/root/reference, surveyed in SURVEY.md) for TPU hardware: JAX/XLA compiled
+model runtime, server-side dynamic batching into HBM, on-device tensors across
+graph edges, mesh-sharded models via pjit/shard_map, and a topology-aware
+control plane.
+"""
+
+from seldon_core_tpu.messages import (  # noqa: F401
+    Feedback,
+    Meta,
+    Metric,
+    MetricType,
+    SeldonMessage,
+    Status,
+    new_puid,
+)
+
+__version__ = "0.1.0"

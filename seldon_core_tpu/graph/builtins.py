@@ -1,0 +1,145 @@
+"""Built-in graph units, on-device where it counts.
+
+Reference counterparts (behavioral parity, new implementations):
+- ``SIMPLE_MODEL``     engine/.../predictors/SimpleModelUnit.java:39
+- ``SIMPLE_ROUTER``    engine/.../predictors/SimpleRouterUnit.java:30
+- ``RANDOM_ABTEST``    engine/.../predictors/RandomABTestUnit.java:36
+- ``AVERAGE_COMBINER`` engine/.../predictors/AverageCombinerUnit.java:35
+- ``EPSILON_GREEDY``   examples/routers/epsilon_greedy/EpsilonGreedy.py:42-60
+
+The combiner averages with ``jnp`` so an ensemble of TPU models aggregates in
+HBM — no host round-trip (the reference pulls every child output back through
+JSON/ojAlgo on the engine JVM).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class SimpleModel:
+    """Static stub model: returns fixed values, like the reference's internal
+    benchmark model (``SimpleModelUnit.java:39`` — values [1.0, 2.0, 3.0],
+    classNames svc1..svc3).  Used by bench.py for orchestrator-overhead
+    parity with docs/benchmarking.md."""
+
+    class_names = ["svc1", "svc2", "svc3"]
+    _values = np.array([[1.0, 2.0, 3.0]])
+
+    def predict(self, X, names):
+        n = np.asarray(X).shape[0] if np.asarray(X).ndim > 1 else 1
+        return np.broadcast_to(self._values, (n, 3))
+
+
+class SimpleRouter:
+    """Always routes to branch 0 (``SimpleRouterUnit.java:30``)."""
+
+    def route(self, X, names) -> int:
+        return 0
+
+
+class RandomABTest:
+    """Random A/B split; parameter ``ratioA`` is the probability of branch 0
+    (``RandomABTestUnit.java:36-66``)."""
+
+    def __init__(self, ratioA: float = 0.5, seed: Optional[int] = None):
+        self.ratio_a = float(ratioA)
+        self._rng = random.Random(seed)
+
+    def route(self, X, names) -> int:
+        return 0 if self._rng.random() < self.ratio_a else 1
+
+
+class AverageCombiner:
+    """Element-wise mean over child outputs (``AverageCombinerUnit.java:35``).
+
+    On-device: with jax.Array children the mean runs on TPU via jnp and the
+    result stays in HBM for the next edge.
+    """
+
+    accepts_jax_arrays = True
+
+    def aggregate(self, Xs: Sequence[Any], names_list):
+        if not Xs:
+            raise ValueError("AverageCombiner: no inputs")
+        if any(type(x).__module__.startswith("jax") for x in Xs):
+            import jax.numpy as jnp
+
+            return jnp.mean(jnp.stack([jnp.asarray(x) for x in Xs]), axis=0)
+        return np.mean(np.stack([np.asarray(x) for x in Xs]), axis=0)
+
+
+class EpsilonGreedy:
+    """Multi-armed-bandit router with online reward learning.
+
+    Behavior of ``examples/routers/epsilon_greedy/EpsilonGreedy.py:20-60``:
+    explore with prob epsilon, else exploit best mean-reward branch;
+    ``send_feedback`` credits the branch recorded in response
+    ``meta.routing`` (delivered here via the engine's ``routing=`` kwarg —
+    the reference router re-parses it from the raw response dict).
+    Thread-safe; state is checkpointable (see graph engine persistence).
+    """
+
+    def __init__(
+        self,
+        n_branches: int = 2,
+        epsilon: float = 0.1,
+        verbose: bool = False,
+        seed: Optional[int] = None,
+    ):
+        self.n_branches = int(n_branches)
+        self.epsilon = float(epsilon)
+        self.counts = np.zeros(self.n_branches, dtype=np.int64)
+        self.values = np.zeros(self.n_branches, dtype=np.float64)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def route(self, X, names) -> int:
+        with self._lock:
+            if self._rng.random() < self.epsilon:
+                return self._rng.randrange(self.n_branches)
+            return int(np.argmax(self.values))
+
+    def send_feedback(self, request, names, reward, truth, routing=None):
+        # bounds-check: routing comes from client-supplied response meta
+        if routing is None or not (0 <= routing < self.n_branches):
+            return None
+        with self._lock:
+            self.counts[routing] += 1
+            n = self.counts[routing]
+            self.values[routing] += (reward - self.values[routing]) / n
+        return None
+
+    # state for checkpoint/restore (replaces reference Redis pickle
+    # persistence, wrappers/python/persistence.py:21-58)
+    def get_state(self) -> dict:
+        with self._lock:
+            return {"counts": self.counts.copy(), "values": self.values.copy()}
+
+    def set_state(self, state: dict) -> None:
+        with self._lock:
+            self.counts = np.asarray(state["counts"], dtype=np.int64).copy()
+            self.values = np.asarray(state["values"], dtype=np.float64).copy()
+
+
+def make_builtin(implementation: str, parameters: dict) -> Any:
+    """Implementation→object map, the analog of the reference's hardcoded
+    bean map (``PredictorConfigBean.java:45-99``)."""
+    impl = {
+        "SIMPLE_MODEL": SimpleModel,
+        "SIMPLE_ROUTER": SimpleRouter,
+        "RANDOM_ABTEST": RandomABTest,
+        "AVERAGE_COMBINER": AverageCombiner,
+        "EPSILON_GREEDY": EpsilonGreedy,
+    }.get(implementation)
+    if impl is None:
+        raise KeyError(f"unknown builtin implementation {implementation!r}")
+    import inspect
+
+    sig = inspect.signature(impl)
+    kwargs = {k: v for k, v in (parameters or {}).items() if k in sig.parameters}
+    return impl(**kwargs)

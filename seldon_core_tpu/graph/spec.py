@@ -1,0 +1,177 @@
+"""Inference-graph spec: the ``PredictiveUnit`` tree of the SeldonDeployment CRD.
+
+Schema parity with ``/root/reference/proto/seldon_deployment.proto:75-125``:
+``PredictiveUnit{name, children[], type, implementation, methods[],
+endpoint{service_host, service_port, type}, parameters[]{name,value,type}}``.
+Parsed from the same JSON layout users write in the reference
+(``helm-charts/seldon-single-model/templates/model.json``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+UNIT_TYPES = ("MODEL", "ROUTER", "COMBINER", "TRANSFORMER", "OUTPUT_TRANSFORMER")
+BUILTIN_IMPLEMENTATIONS = (
+    "SIMPLE_MODEL",
+    "SIMPLE_ROUTER",
+    "RANDOM_ABTEST",
+    "AVERAGE_COMBINER",
+    "EPSILON_GREEDY",  # TPU-native extra: reference ships it as an example
+    # component (examples/routers/epsilon_greedy/EpsilonGreedy.py), we make it
+    # a built-in so MAB graphs need no user container.
+)
+PARAM_TYPES = {"STRING": str, "INT": int, "FLOAT": float, "DOUBLE": float, "BOOL": None}
+
+
+class GraphValidationError(Exception):
+    pass
+
+
+@dataclass
+class Endpoint:
+    service_host: str = ""
+    service_port: int = 0
+    type: str = "REST"  # REST | GRPC | LOCAL
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "Endpoint":
+        d = d or {}
+        return cls(
+            service_host=d.get("service_host", d.get("serviceHost", "")),
+            service_port=int(d.get("service_port", d.get("servicePort", 0)) or 0),
+            type=d.get("type", "REST"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "service_host": self.service_host,
+            "service_port": self.service_port,
+            "type": self.type,
+        }
+
+
+def _coerce_param(value: str, ptype: str) -> Any:
+    """Parameter typing per ``seldon_deployment.proto:116-124`` — values are
+    strings tagged with a type, materialized as typed kwargs
+    (reference ``microservice.py:155-169`` parse_parameters)."""
+    if ptype == "BOOL":
+        return str(value).lower() in ("1", "true", "yes")
+    conv = PARAM_TYPES.get(ptype, str)
+    return conv(value) if conv else value
+
+
+@dataclass
+class PredictiveUnit:
+    name: str
+    type: Optional[str] = None  # inferred from implementation when absent
+    implementation: Optional[str] = None
+    children: list["PredictiveUnit"] = field(default_factory=list)
+    parameters: dict[str, Any] = field(default_factory=dict)
+    endpoint: Endpoint = field(default_factory=Endpoint)
+    methods: list[str] = field(default_factory=list)
+    # TPU placement hint: nodes sharing a slice_group exchange device-resident
+    # tensors; distinct groups talk over transport (no reference counterpart).
+    slice_group: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PredictiveUnit":
+        params = {}
+        for p in d.get("parameters", []) or []:
+            params[p["name"]] = _coerce_param(p.get("value"), p.get("type", "STRING"))
+        unit = cls(
+            name=d.get("name", ""),
+            type=d.get("type"),
+            implementation=d.get("implementation"),
+            children=[cls.from_dict(c) for c in d.get("children", []) or []],
+            parameters=params,
+            endpoint=Endpoint.from_dict(d.get("endpoint")),
+            methods=list(d.get("methods", []) or []),
+            slice_group=d.get("sliceGroup", ""),
+        )
+        return unit
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"name": self.name}
+        if self.type:
+            d["type"] = self.type
+        if self.implementation:
+            d["implementation"] = self.implementation
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        if self.parameters:
+            d["parameters"] = [
+                {"name": k, "value": str(v), "type": _param_type_name(v)}
+                for k, v in self.parameters.items()
+            ]
+        if self.endpoint.service_host or self.endpoint.service_port:
+            d["endpoint"] = self.endpoint.to_dict()
+        if self.methods:
+            d["methods"] = self.methods
+        if self.slice_group:
+            d["sliceGroup"] = self.slice_group
+        return d
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    @property
+    def resolved_type(self) -> str:
+        """Type inference from implementation, as the reference operator's
+        defaulting step does (``SeldonDeploymentOperatorImpl.java:375``)."""
+        if self.type:
+            return self.type
+        impl = self.implementation or ""
+        if impl in ("SIMPLE_MODEL",):
+            return "MODEL"
+        if impl in ("SIMPLE_ROUTER", "RANDOM_ABTEST", "EPSILON_GREEDY"):
+            return "ROUTER"
+        if impl in ("AVERAGE_COMBINER",):
+            return "COMBINER"
+        return "MODEL"
+
+
+def parse_graph(spec: Any) -> PredictiveUnit:
+    if isinstance(spec, (str, bytes)):
+        spec = json.loads(spec)
+    if isinstance(spec, PredictiveUnit):
+        return spec
+    return PredictiveUnit.from_dict(spec)
+
+
+def validate_graph(root: PredictiveUnit) -> None:
+    """Structural validation, mirroring the reference operator's checks
+    (``SeldonDeploymentOperatorImpl.java:426-466``): unique names, known
+    types/implementations, combiner-needs-children, router-needs-children."""
+    seen: set[str] = set()
+    for unit in root.walk():
+        if not unit.name:
+            raise GraphValidationError("graph node with empty name")
+        if unit.name in seen:
+            raise GraphValidationError(f"duplicate node name {unit.name!r}")
+        seen.add(unit.name)
+        t = unit.resolved_type
+        if t not in UNIT_TYPES:
+            raise GraphValidationError(f"{unit.name}: unknown type {t!r}")
+        if unit.implementation and unit.implementation not in BUILTIN_IMPLEMENTATIONS:
+            raise GraphValidationError(
+                f"{unit.name}: unknown implementation {unit.implementation!r}"
+            )
+        if t == "COMBINER" and not unit.children:
+            raise GraphValidationError(f"{unit.name}: COMBINER requires children")
+        if t == "ROUTER" and not unit.children:
+            raise GraphValidationError(f"{unit.name}: ROUTER requires children")
+
+
+def _param_type_name(v: Any) -> str:
+    if isinstance(v, bool):
+        return "BOOL"
+    if isinstance(v, int):
+        return "INT"
+    if isinstance(v, float):
+        return "FLOAT"
+    return "STRING"

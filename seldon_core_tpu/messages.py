@@ -1,0 +1,380 @@
+"""Core data model: the TPU-native equivalent of Seldon's ``SeldonMessage``.
+
+Reference semantics: ``/root/reference/proto/prediction.proto:12-82`` defines
+``SeldonMessage{status, meta, oneof(data|binData|strData)}`` with a
+``double``-only ``Tensor``.  This redesign keeps the same wire-level JSON shape
+(so reference clients work unchanged) but fixes the known weaknesses for TPU:
+
+- **dtype-rich tensors** (bfloat16/float32/int8/... — the reference's Tensor is
+  double-only, a serialization and HBM bandwidth disaster for accelerators),
+- **device-resident payloads**: ``SeldonMessage.data`` may hold a ``jax.Array``
+  living in HBM.  Graph edges between co-located nodes pass the handle, never
+  bytes — serialization happens only at the transport boundary
+  (contrast reference ``engine/.../InternalPredictionService.java:346-350``
+  which JSON-serializes at every graph hop).
+- **binary tensor framing** (``binTensor``) for the REST path: base64 raw
+  buffer + shape + dtype instead of a JSON number array.
+
+JSON wire format parity (``docs/reference/internal-api.md`` in the reference):
+
+.. code-block:: json
+
+    {"meta": {...}, "data": {"names": ["a","b"], "ndarray": [[1,2]]}}
+    {"data": {"names": [], "tensor": {"shape": [2,2], "values": [1,2,3,4]}}}
+    {"binData": "<base64>"} | {"strData": "..."} | {"jsonData": {...}}
+"""
+
+from __future__ import annotations
+
+import base64
+import enum
+import json
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "MetricType",
+    "Metric",
+    "Meta",
+    "Status",
+    "SeldonMessage",
+    "Feedback",
+    "new_puid",
+]
+
+ArrayLike = Union[np.ndarray, "jax.Array"]  # noqa: F821  (jax imported lazily)
+
+
+def new_puid() -> str:
+    """Prediction-unique id.
+
+    Reference: 130-bit SecureRandom base32
+    (``engine/.../service/PredictionService.java:72-80``).
+    """
+    return secrets.token_hex(16)
+
+
+class MetricType(str, enum.Enum):
+    COUNTER = "COUNTER"
+    GAUGE = "GAUGE"
+    TIMER = "TIMER"
+
+
+@dataclass
+class Metric:
+    """Custom metric carried in response meta (``prediction.proto:64-72``)."""
+
+    key: str
+    type: MetricType = MetricType.COUNTER
+    value: float = 0.0
+    tags: dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "key": self.key,
+            "type": self.type.value,
+            "value": self.value,
+        }
+        if self.tags:
+            d["tags"] = dict(self.tags)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Metric":
+        return cls(
+            key=d.get("key", ""),
+            type=MetricType(d.get("type", "COUNTER")),
+            value=float(d.get("value", 0.0)),
+            tags=dict(d.get("tags", {})),
+        )
+
+
+@dataclass
+class Meta:
+    """Request metadata merged across the graph walk.
+
+    Semantics mirror the reference engine's meta handling
+    (``engine/.../predictors/PredictiveUnitBean.java:97,106-108,288-311``):
+    ``routing`` records each router's branch decision, ``requestPath`` is the
+    node→implementation breadcrumb, ``metrics`` accumulate from every
+    component's response, ``tags`` merge with child-overrides.
+    """
+
+    puid: str = ""
+    tags: dict[str, Any] = field(default_factory=dict)
+    routing: dict[str, int] = field(default_factory=dict)
+    request_path: dict[str, str] = field(default_factory=dict)
+    metrics: list[Metric] = field(default_factory=list)
+
+    def merge(self, other: "Meta") -> None:
+        """Merge a component response's meta into this request-level meta."""
+        if other.puid and not self.puid:
+            self.puid = other.puid
+        self.tags.update(other.tags)
+        self.routing.update(other.routing)
+        self.request_path.update(other.request_path)
+        self.metrics.extend(other.metrics)
+
+    def copy(self) -> "Meta":
+        return Meta(
+            puid=self.puid,
+            tags=dict(self.tags),
+            routing=dict(self.routing),
+            request_path=dict(self.request_path),
+            metrics=[
+                Metric(m.key, m.type, m.value, dict(m.tags)) for m in self.metrics
+            ],
+        )
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.puid:
+            d["puid"] = self.puid
+        if self.tags:
+            d["tags"] = self.tags
+        if self.routing:
+            d["routing"] = self.routing
+        if self.request_path:
+            d["requestPath"] = self.request_path
+        if self.metrics:
+            d["metrics"] = [m.to_dict() for m in self.metrics]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "Meta":
+        d = d or {}
+        return cls(
+            puid=d.get("puid", ""),
+            tags=dict(d.get("tags", {})),
+            routing={k: int(v) for k, v in d.get("routing", {}).items()},
+            request_path=dict(d.get("requestPath", {})),
+            metrics=[Metric.from_dict(m) for m in d.get("metrics", [])],
+        )
+
+
+@dataclass
+class Status:
+    """``prediction.proto:74-82`` Status."""
+
+    code: int = 200
+    info: str = ""
+    reason: str = ""
+    status: str = "SUCCESS"  # SUCCESS | FAILURE
+
+    @classmethod
+    def failure(cls, code: int, info: str, reason: str = "") -> "Status":
+        return cls(code=code, info=info, reason=reason, status="FAILURE")
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "info": self.info,
+            "reason": self.reason,
+            "status": self.status,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "Status":
+        d = d or {}
+        return cls(
+            code=int(d.get("code", 200)),
+            info=d.get("info", ""),
+            reason=d.get("reason", ""),
+            status=d.get("status", "SUCCESS"),
+        )
+
+
+def _is_jax_array(x: Any) -> bool:
+    # Cheap duck-type check that avoids importing jax on the hot path for
+    # plain-numpy deployments.
+    return type(x).__module__.startswith("jax") or hasattr(x, "addressable_shards")
+
+
+def _to_numpy(x: ArrayLike) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    return np.asarray(x)  # device→host transfer for jax.Array
+
+
+@dataclass
+class SeldonMessage:
+    """The unit of data flowing through an inference graph.
+
+    Exactly one of (``data``, ``bin_data``, ``str_data``, ``json_data``) is
+    typically set, mirroring the reference's oneof
+    (``proto/prediction.proto:16-20``).  ``data`` may be a ``numpy.ndarray``
+    *or a device-resident ``jax.Array``* — the latter never leaves HBM until a
+    transport boundary forces serialization.
+    """
+
+    data: Optional[ArrayLike] = None
+    names: list[str] = field(default_factory=list)
+    bin_data: Optional[bytes] = None
+    str_data: Optional[str] = None
+    json_data: Any = None
+    meta: Meta = field(default_factory=Meta)
+    status: Optional[Status] = None
+    # Preferred wire encoding for `data`: "ndarray" | "tensor" | "binTensor".
+    encoding: str = "ndarray"
+
+    # ---- constructors -------------------------------------------------
+    @classmethod
+    def from_ndarray(
+        cls, arr: ArrayLike, names: Sequence[str] = (), **kw
+    ) -> "SeldonMessage":
+        return cls(data=arr, names=list(names), **kw)
+
+    # ---- introspection ------------------------------------------------
+    @property
+    def is_device_resident(self) -> bool:
+        return self.data is not None and _is_jax_array(self.data)
+
+    def host_data(self) -> Optional[np.ndarray]:
+        """Materialize ``data`` on host (device→host copy iff needed)."""
+        if self.data is None:
+            return None
+        return _to_numpy(self.data)
+
+    # ---- JSON codec ---------------------------------------------------
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        md = self.meta.to_dict()
+        if md:
+            out["meta"] = md
+        if self.status is not None:
+            out["status"] = self.status.to_dict()
+        if self.data is not None:
+            arr = self.host_data()
+            datad: dict[str, Any] = {"names": list(self.names)}
+            if self.encoding == "tensor":
+                # strict reference parity: {shape, values} only, float64
+                # values (prediction.proto:31-34) — a reference client's
+                # proto-JSON parser rejects unknown fields.  dtype-rich
+                # payloads use "binTensor" instead.
+                datad["tensor"] = {
+                    "shape": list(arr.shape),
+                    "values": arr.astype(np.float64).ravel().tolist(),
+                }
+            elif self.encoding == "binTensor":
+                buf = np.ascontiguousarray(arr)
+                datad["binTensor"] = {
+                    "shape": list(arr.shape),
+                    "dtype": _dtype_str(arr.dtype),
+                    "b64": base64.b64encode(buf.tobytes()).decode("ascii"),
+                }
+            else:
+                datad["ndarray"] = arr.tolist()
+            out["data"] = datad
+        elif self.bin_data is not None:
+            out["binData"] = base64.b64encode(self.bin_data).decode("ascii")
+        elif self.str_data is not None:
+            out["strData"] = self.str_data
+        elif self.json_data is not None:
+            out["jsonData"] = self.json_data
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SeldonMessage":
+        msg = cls(
+            meta=Meta.from_dict(d.get("meta")),
+            status=Status.from_dict(d["status"]) if "status" in d else None,
+        )
+        if "data" in d:
+            datad = d["data"] or {}
+            msg.names = list(datad.get("names") or [])
+            if "ndarray" in datad:
+                msg.data = np.asarray(datad["ndarray"])
+                msg.encoding = "ndarray"
+            elif "tensor" in datad:
+                t = datad["tensor"]
+                msg.data = np.asarray(t.get("values", []), dtype=np.float64).reshape(
+                    t.get("shape", [-1])
+                )
+                msg.encoding = "tensor"
+            elif "binTensor" in datad:
+                t = datad["binTensor"]
+                raw = base64.b64decode(t["b64"])
+                dtype = _np_dtype(t.get("dtype", "float32"))
+                msg.data = np.frombuffer(raw, dtype=dtype).reshape(t["shape"])
+                msg.encoding = "binTensor"
+        elif "binData" in d:
+            msg.bin_data = base64.b64decode(d["binData"])
+        elif "strData" in d:
+            msg.str_data = d["strData"]
+        elif "jsonData" in d:
+            msg.json_data = d["jsonData"]
+        return msg
+
+    @classmethod
+    def from_json(cls, s: Union[str, bytes]) -> "SeldonMessage":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def parse(cls, s: Union[str, bytes, dict, "SeldonMessage"]) -> "SeldonMessage":
+        if isinstance(s, SeldonMessage):
+            return s
+        if isinstance(s, dict):
+            return cls.from_dict(s)
+        return cls.from_json(s)
+
+
+@dataclass
+class Feedback:
+    """Reward feedback (``prediction.proto:54-60``)."""
+
+    request: Optional[SeldonMessage] = None
+    response: Optional[SeldonMessage] = None
+    reward: float = 0.0
+    truth: Optional[SeldonMessage] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"reward": self.reward}
+        if self.request is not None:
+            d["request"] = self.request.to_dict()
+        if self.response is not None:
+            d["response"] = self.response.to_dict()
+        if self.truth is not None:
+            d["truth"] = self.truth.to_dict()
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Feedback":
+        return cls(
+            request=SeldonMessage.from_dict(d["request"]) if "request" in d else None,
+            response=(
+                SeldonMessage.from_dict(d["response"]) if "response" in d else None
+            ),
+            reward=float(d.get("reward", 0.0)),
+            truth=SeldonMessage.from_dict(d["truth"]) if "truth" in d else None,
+        )
+
+    @classmethod
+    def from_json(cls, s: Union[str, bytes]) -> "Feedback":
+        return cls.from_dict(json.loads(s))
+
+
+# ---- dtype helpers ----------------------------------------------------
+
+_ML_DTYPES = ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+
+
+def _dtype_str(dtype: Any) -> str:
+    return np.dtype(dtype).name
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including ml_dtypes extras (bfloat16 et al.)."""
+    if name in _ML_DTYPES:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+    return np.dtype(name)

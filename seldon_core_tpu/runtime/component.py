@@ -1,0 +1,351 @@
+"""User-component contract: the TPU-native model/router/transformer runtime.
+
+The duck-type contract is wire-compatible with the reference Python wrapper
+(``wrappers/python/model_microservice.py:32-43``,
+``wrappers/python/microservice.py:190-263``): a user class may define any of
+
+- ``predict(X, feature_names)``            (MODEL)
+- ``route(X, feature_names)``              (ROUTER)
+- ``aggregate(Xs, feature_names_list)``    (COMBINER)
+- ``transform_input(X, feature_names)``    (TRANSFORMER)
+- ``transform_output(X, feature_names)``   (OUTPUT_TRANSFORMER)
+- ``send_feedback(request, response, reward, truth)``
+- ``class_names`` attr / ``tags()`` / ``metrics()`` / ``score(X, names)``
+
+New TPU-first extension: a component may instead expose a *pure JAX function*
+
+- ``predict_fn(params, X) -> Y``  with a ``params`` pytree attribute
+
+in which case the runtime jit-compiles it (optionally pjit-sharded over a
+mesh), keeps params in HBM, and serves it through the dynamic batcher.
+A plain ``predict`` that happens to be jax-traceable can opt in with
+``jit_compile = True``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.messages import (
+    Feedback,
+    Meta,
+    Metric,
+    MetricType,
+    SeldonMessage,
+)
+
+logger = logging.getLogger(__name__)
+
+SERVICE_TYPES = (
+    "MODEL",
+    "ROUTER",
+    "COMBINER",
+    "TRANSFORMER",
+    "OUTPUT_TRANSFORMER",
+    "OUTLIER_DETECTOR",
+)
+
+
+class SeldonComponentError(Exception):
+    """Maps to a FAILURE Status on the wire (reference
+    ``wrappers/python/microservice.py`` SeldonMicroserviceException)."""
+
+    def __init__(self, message: str, status_code: int = 400, reason: str = ""):
+        super().__init__(message)
+        self.status_code = status_code
+        self.reason = reason
+
+
+def validate_metrics(metrics: Any) -> list[Metric]:
+    """Validate a user ``metrics()`` return value.
+
+    Reference: ``wrappers/python/metrics.py:21-38`` (raises
+    MICROSERVICE_BAD_METRIC on malformed entries).
+    """
+    if metrics is None:
+        return []
+    if not isinstance(metrics, (list, tuple)):
+        raise SeldonComponentError(
+            "metrics() must return a list", reason="MICROSERVICE_BAD_METRIC"
+        )
+    out = []
+    for m in metrics:
+        if isinstance(m, Metric):
+            out.append(m)
+            continue
+        if not isinstance(m, dict) or "key" not in m or "value" not in m:
+            raise SeldonComponentError(
+                f"bad metric entry: {m!r}", reason="MICROSERVICE_BAD_METRIC"
+            )
+        try:
+            mtype = MetricType(m.get("type", "COUNTER"))
+            value = float(m["value"])
+        except (ValueError, TypeError) as e:
+            raise SeldonComponentError(
+                f"bad metric entry: {m!r}: {e}", reason="MICROSERVICE_BAD_METRIC"
+            )
+        out.append(Metric(key=str(m["key"]), type=mtype, value=value,
+                          tags=dict(m.get("tags", {}))))
+    return out
+
+
+class ComponentHandle:
+    """Wraps a user object and adapts it to SeldonMessage in/out.
+
+    This is the in-process analog of one wrapped microservice container: what
+    the reference runs as a Flask/gRPC pod (``model_microservice.py:50-105``),
+    we run as an object whose methods take and return messages with
+    possibly-device-resident tensors.
+    """
+
+    def __init__(
+        self,
+        user_object: Any,
+        name: str = "",
+        service_type: str = "MODEL",
+    ):
+        if service_type not in SERVICE_TYPES:
+            raise ValueError(f"unknown service_type {service_type}")
+        self.user = user_object
+        self.name = name or type(user_object).__name__
+        self.service_type = service_type
+        self._has = {
+            m: callable(getattr(user_object, m, None))
+            for m in (
+                "predict",
+                "route",
+                "aggregate",
+                "transform_input",
+                "transform_output",
+                "send_feedback",
+                "tags",
+                "metrics",
+                "score",
+                "health_status",
+                "init_metadata",
+            )
+        }
+        # TPU fast path: pure fn + params pytree → jit once, serve compiled.
+        self._compiled: Optional[Callable] = None
+        predict_fn = getattr(user_object, "predict_fn", None)
+        if callable(predict_fn):
+            import jax
+
+            if len(_positional_params(predict_fn)) >= 2 and not hasattr(
+                user_object, "params"
+            ):
+                raise ValueError(
+                    f"{self.name}: predict_fn takes (params, X) but the "
+                    "component has no `params` attribute"
+                )
+            params = getattr(user_object, "params", None)
+            donate = bool(getattr(user_object, "donate_input", False))
+            shardings = getattr(user_object, "shardings", None)
+            jit_kw: dict[str, Any] = {}
+            if shardings is not None:
+                jit_kw["in_shardings"] = shardings.get("in")
+                jit_kw["out_shardings"] = shardings.get("out")
+            if donate:
+                jit_kw["donate_argnums"] = (1,)
+            fn = jax.jit(predict_fn, **jit_kw)
+            self._params = params if hasattr(user_object, "params") else _NO_PARAMS
+            self._compiled = fn
+        elif getattr(user_object, "jit_compile", False) and self._has["predict"]:
+            import jax
+
+            names_free = lambda X: user_object.predict(X, [])  # noqa: E731
+            self._compiled = jax.jit(names_free)
+            self._params = _NO_PARAMS
+
+    # ---- capability flags (engine consults these like the reference's
+    # `methods` list, seldon_deployment.proto:95) -----------------------
+    def has(self, method: str) -> bool:
+        if method == "predict":
+            return self._compiled is not None or self._has["predict"]
+        return self._has.get(method, False)
+
+    # ---- response assembly --------------------------------------------
+    def _component_meta(self) -> Meta:
+        meta = Meta()
+        if self._has["tags"]:
+            try:
+                meta.tags.update(self.user.tags() or {})
+            except Exception:
+                logger.exception("tags() failed for %s", self.name)
+        if self._has["metrics"]:
+            meta.metrics.extend(validate_metrics(self.user.metrics()))
+        return meta
+
+    def _class_names(self, X: Any, fallback: Sequence[str]) -> list[str]:
+        cn = getattr(self.user, "class_names", None)
+        if cn is not None:
+            return list(cn)
+        arr = np.asarray(X) if not hasattr(X, "ndim") else X
+        if getattr(arr, "ndim", 0) >= 2:
+            return [f"t:{i}" for i in range(arr.shape[-1])]
+        return list(fallback)
+
+    # ---- methods -------------------------------------------------------
+    def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        """MODEL predict.  Device-resident fast path: if the component is
+        compiled and the input is already a jax.Array, everything stays on
+        device; the reference instead round-trips JSON per hop
+        (``InternalPredictionService.java:217-254``)."""
+        if self._compiled is not None:
+            X = msg.data if msg.data is not None else self._decode_nontensor(msg)
+            if self._params is _NO_PARAMS:
+                Y = self._compiled(X)
+            else:
+                Y = self._compiled(self._params, X)
+            out = SeldonMessage(
+                data=Y, names=self._class_names(Y, msg.names), meta=self._component_meta()
+            )
+            return out
+        if not self._has["predict"]:
+            raise SeldonComponentError(
+                f"{self.name} has no predict()", status_code=400,
+                reason="MICROSERVICE_NO_METHOD",
+            )
+        X = self._user_input(msg)
+        Y = self.user.predict(X, msg.names)
+        return SeldonMessage(
+            data=np.asarray(Y) if not hasattr(Y, "dtype") else Y,
+            names=self._class_names(Y, msg.names),
+            meta=self._component_meta(),
+        )
+
+    def route(self, msg: SeldonMessage) -> int:
+        """ROUTER: returns branch index; -1 means fan out to all children
+        (reference ``PredictiveUnitBean.java:271-281`` getBranchIndex)."""
+        if not self._has["route"]:
+            return -1
+        branch = self.user.route(self._user_input(msg), msg.names)
+        arr = np.asarray(branch)
+        return int(arr.ravel()[0])
+
+    def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        """COMBINER over child outputs (reference ``/aggregate``)."""
+        if not self._has["aggregate"]:
+            raise SeldonComponentError(
+                f"{self.name} has no aggregate()", reason="MICROSERVICE_NO_METHOD"
+            )
+        Xs = [self._user_input(m) for m in msgs]
+        names_list = [m.names for m in msgs]
+        Y = self.user.aggregate(Xs, names_list)
+        names = self._class_names(Y, msgs[0].names if msgs else [])
+        return SeldonMessage(data=_as_array(Y), names=names, meta=self._component_meta())
+
+    def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if not self._has["transform_input"]:
+            return msg
+        Y = self.user.transform_input(self._user_input(msg), msg.names)
+        out = SeldonMessage(
+            data=_as_array(Y),
+            names=self._transformed_names(msg.names),
+            meta=self._component_meta(),
+        )
+        return out
+
+    def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        if not self._has["transform_output"]:
+            return msg
+        Y = self.user.transform_output(self._user_input(msg), msg.names)
+        return SeldonMessage(
+            data=_as_array(Y),
+            names=self._transformed_names(msg.names, output=True),
+            meta=self._component_meta(),
+        )
+
+    def score(self, msg: SeldonMessage) -> np.ndarray:
+        """OUTLIER_DETECTOR score per row (reference
+        ``wrappers/python/outlier_detector_microservice.py:16-40``)."""
+        return np.asarray(self.user.score(self._user_input(msg), msg.names))
+
+    def send_feedback(self, fb: Feedback) -> Optional[SeldonMessage]:
+        if not self._has["send_feedback"]:
+            return None
+        req = fb.request.host_data() if fb.request is not None else None
+        names = fb.request.names if fb.request is not None else []
+        truth = fb.truth.host_data() if fb.truth is not None else None
+        resp = fb.response
+        routing = None
+        if resp is not None and self.name in resp.meta.routing:
+            routing = resp.meta.routing[self.name]
+        sig = inspect.signature(self.user.send_feedback)
+        if "routing" in sig.parameters:
+            ret = self.user.send_feedback(req, names, fb.reward, truth, routing=routing)
+        else:
+            # Reference 4-arg signature (model_microservice.py:84-100); routers
+            # there re-derive routing from response meta themselves
+            # (router_microservice.py:76-105).
+            ret = self.user.send_feedback(req, names, fb.reward, truth)
+        if ret is None:
+            return None
+        return SeldonMessage(data=_as_array(ret))
+
+    # ---- helpers -------------------------------------------------------
+    def _user_input(self, msg: SeldonMessage) -> Any:
+        if msg.data is not None:
+            return msg.data if self._wants_device_arrays() else msg.host_data()
+        return self._decode_nontensor(msg)
+
+    def _decode_nontensor(self, msg: SeldonMessage) -> Any:
+        if msg.bin_data is not None:
+            return msg.bin_data
+        if msg.str_data is not None:
+            return msg.str_data
+        return msg.json_data
+
+    def _wants_device_arrays(self) -> bool:
+        return self._compiled is not None or bool(
+            getattr(self.user, "accepts_jax_arrays", False)
+        )
+
+    def _transformed_names(self, names: list[str], output: bool = False) -> list[str]:
+        attr = "class_names" if output else "feature_names"
+        cn = getattr(self.user, attr, None)
+        return list(cn) if cn is not None else list(names)
+
+
+_NO_PARAMS = object()  # sentinel: component's compiled fn takes only X
+
+
+def _positional_params(fn) -> list:
+    sig = inspect.signature(fn)
+    return [
+        p
+        for p in sig.parameters.values()
+        if p.kind
+        in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+    ]
+
+
+def _as_array(Y: Any):
+    return Y if hasattr(Y, "dtype") else np.asarray(Y)
+
+
+def load_component(
+    module_name: str,
+    class_name: Optional[str] = None,
+    parameters: Optional[dict] = None,
+    service_type: str = "MODEL",
+) -> ComponentHandle:
+    """Import+instantiate a user component, mirroring the reference CLI boot
+    (``wrappers/python/microservice.py:209-216``): class name == module's
+    interface name, constructor kwargs from parameters."""
+    import importlib
+
+    mod = importlib.import_module(module_name)
+    cls = getattr(mod, class_name or module_name.rsplit(".", 1)[-1])
+    sig = inspect.signature(cls)
+    kwargs = dict(parameters or {})
+    if kwargs and not any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
+    ):
+        kwargs = {k: v for k, v in kwargs.items() if k in sig.parameters}
+    user = cls(**kwargs)
+    return ComponentHandle(user, service_type=service_type)

@@ -1,0 +1,447 @@
+"""Graph-engine tests: behavior parity with the reference engine's unit and
+full-stack tests (SURVEY.md §4.1 — AverageCombinerTest, RandomABTestUnitTest,
+TestRestClientControllerExternalGraphs fixtures), run against in-process
+components instead of mocked RestTemplates."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.builtins import AverageCombiner, EpsilonGreedy
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.graph.spec import (
+    GraphValidationError,
+    parse_graph,
+    validate_graph,
+)
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+from seldon_core_tpu.runtime.component import ComponentHandle
+
+
+class Identity:
+    def predict(self, X, names):
+        return X
+
+
+class PlusN:
+    def __init__(self, n=1.0):
+        self.n = n
+
+    def predict(self, X, names):
+        return np.asarray(X) + self.n
+
+
+class Doubler:
+    def transform_input(self, X, names):
+        return np.asarray(X) * 2.0
+
+
+class NegateOut:
+    def transform_output(self, X, names):
+        return -np.asarray(X)
+
+
+def resolver_for(mapping):
+    def resolve(unit):
+        obj, stype = mapping[unit.name]
+        return ComponentHandle(obj, name=unit.name, service_type=stype)
+
+    return resolve
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---- spec -------------------------------------------------------------
+
+
+def test_spec_parse_reference_layout():
+    # layout identical to helm-charts/seldon-single-model/templates/model.json
+    g = parse_graph(
+        {
+            "name": "classifier",
+            "type": "MODEL",
+            "endpoint": {"type": "REST"},
+            "children": [],
+            "parameters": [{"name": "alpha", "value": "0.5", "type": "FLOAT"}],
+        }
+    )
+    assert g.name == "classifier"
+    assert g.parameters == {"alpha": 0.5}
+
+
+def test_spec_validation_errors():
+    with pytest.raises(GraphValidationError):
+        validate_graph(parse_graph({"name": "c", "type": "COMBINER"}))
+    with pytest.raises(GraphValidationError):
+        validate_graph(
+            parse_graph(
+                {
+                    "name": "a",
+                    "type": "MODEL",
+                    "children": [{"name": "a", "type": "MODEL"}],
+                }
+            )
+        )
+    with pytest.raises(GraphValidationError):
+        validate_graph(parse_graph({"name": "x", "type": "WAT"}))
+
+
+# ---- single model -----------------------------------------------------
+
+
+def test_single_model_predict():
+    eng = GraphEngine(
+        {"name": "m", "type": "MODEL"},
+        resolver=resolver_for({"m": (PlusN(1.0), "MODEL")}),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[1.0, 2.0]]))))
+    np.testing.assert_array_equal(out.host_data(), [[2.0, 3.0]])
+    assert out.status.status == "SUCCESS"
+    assert out.meta.puid
+    assert out.meta.request_path == {"m": "PlusN"}
+
+
+def test_simple_model_builtin():
+    eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.zeros((2, 5)))))
+    np.testing.assert_array_equal(out.host_data(), [[1.0, 2.0, 3.0]] * 2)
+    assert out.names == ["svc1", "svc2", "svc3"]
+
+
+# ---- transformer chain ------------------------------------------------
+
+
+def test_transformer_and_output_transformer():
+    spec = {
+        "name": "out-t",
+        "type": "OUTPUT_TRANSFORMER",
+        "children": [
+            {
+                "name": "in-t",
+                "type": "TRANSFORMER",
+                "children": [{"name": "m", "type": "MODEL"}],
+            }
+        ],
+    }
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for(
+            {
+                "out-t": (NegateOut(), "OUTPUT_TRANSFORMER"),
+                "in-t": (Doubler(), "TRANSFORMER"),
+                "m": (PlusN(1.0), "MODEL"),
+            }
+        ),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[3.0]]))))
+    # (3*2)+1 = 7, negated = -7
+    np.testing.assert_array_equal(out.host_data(), [[-7.0]])
+    assert set(out.meta.request_path) == {"out-t", "in-t", "m"}
+
+
+# ---- combiner ---------------------------------------------------------
+
+
+def test_average_combiner_graph():
+    spec = {
+        "name": "ens",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "m1", "type": "MODEL"},
+            {"name": "m2", "type": "MODEL"},
+        ],
+    }
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for({"m1": (PlusN(0.0), "MODEL"), "m2": (PlusN(2.0), "MODEL")}),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[1.0, 1.0]]))))
+    np.testing.assert_array_equal(out.host_data(), [[2.0, 2.0]])
+
+
+def test_average_combiner_on_device():
+    import jax.numpy as jnp
+
+    comb = AverageCombiner()
+    res = comb.aggregate([jnp.ones((2, 2)), jnp.zeros((2, 2))], [[], []])
+    assert type(res).__module__.startswith("jax")
+    np.testing.assert_allclose(np.asarray(res), 0.5 * np.ones((2, 2)))
+
+
+# ---- routers ----------------------------------------------------------
+
+
+def test_router_branch_selection_and_routing_meta():
+    spec = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+
+    class AlwaysB:
+        def route(self, X, names):
+            return 1
+
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for(
+            {
+                "r": (AlwaysB(), "ROUTER"),
+                "a": (PlusN(100.0), "MODEL"),
+                "b": (PlusN(1.0), "MODEL"),
+            }
+        ),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[0.0]]))))
+    np.testing.assert_array_equal(out.host_data(), [[1.0]])
+    assert out.meta.routing == {"r": 1}
+    assert "a" not in out.meta.request_path  # unselected branch not executed
+
+
+def test_random_abtest_distribution():
+    spec = {
+        "name": "ab",
+        "implementation": "RANDOM_ABTEST",
+        "parameters": [{"name": "ratioA", "value": "1.0", "type": "FLOAT"}],
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for({"a": (PlusN(0.0), "MODEL"), "b": (PlusN(9.0), "MODEL")}),
+    )
+    for _ in range(10):
+        out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[1.0]]))))
+        assert out.meta.routing["ab"] == 0
+
+
+def test_router_fanout_all_when_minus_one():
+    spec = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {
+                "name": "c",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [{"name": "b", "type": "MODEL"}],
+            },
+        ],
+    }
+
+    class FanAll:
+        def route(self, X, names):
+            return -1
+
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for(
+            {
+                "r": (FanAll(), "ROUTER"),
+                "a": (PlusN(1.0), "MODEL"),
+                "b": (PlusN(2.0), "MODEL"),
+            }
+        ),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[0.0]]))))
+    # default aggregation = first child output (PredictiveUnitBean.java:234-245)
+    np.testing.assert_array_equal(out.host_data(), [[1.0]])
+    assert out.meta.routing["r"] == -1
+    assert "b" in out.meta.request_path  # all branches executed
+
+
+# ---- feedback / MAB ---------------------------------------------------
+
+
+def test_epsilon_greedy_learns_from_feedback():
+    spec = {
+        "name": "eg",
+        "implementation": "EPSILON_GREEDY",
+        "parameters": [
+            {"name": "n_branches", "value": "2", "type": "INT"},
+            {"name": "epsilon", "value": "0.0", "type": "FLOAT"},
+            {"name": "seed", "value": "0", "type": "INT"},
+        ],
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for({"a": (PlusN(0.0), "MODEL"), "b": (PlusN(1.0), "MODEL")}),
+    )
+    # reward branch 1 repeatedly via feedback replay of recorded routing
+    for _ in range(5):
+        resp = SeldonMessage()
+        resp.meta.routing["eg"] = 1
+        run(eng.send_feedback(Feedback(response=resp, reward=1.0)))
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[0.0]]))))
+    assert out.meta.routing["eg"] == 1  # exploit learned best branch
+    np.testing.assert_array_equal(out.host_data(), [[1.0]])
+    mab = eng.node_impl("eg").user
+    assert mab.counts[1] == 5 and mab.values[1] == pytest.approx(1.0)
+
+
+def test_feedback_reaches_models_down_routed_branch():
+    calls = []
+
+    class FBModel:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def predict(self, X, names):
+            return X
+
+        def send_feedback(self, request, names, reward, truth):
+            calls.append((self.tag, reward))
+
+    spec = {
+        "name": "r",
+        "implementation": "SIMPLE_ROUTER",
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for(
+            {"a": (FBModel("a"), "MODEL"), "b": (FBModel("b"), "MODEL")}
+        ),
+    )
+    resp = SeldonMessage()
+    resp.meta.routing["r"] = 0
+    run(eng.send_feedback(Feedback(response=resp, reward=0.5)))
+    assert calls == [("a", 0.5)]
+
+
+# ---- error handling ---------------------------------------------------
+
+
+def test_failure_status_on_component_error():
+    class Boom:
+        def predict(self, X, names):
+            raise_from = None
+            from seldon_core_tpu.runtime.component import SeldonComponentError
+
+            raise SeldonComponentError("bad input", status_code=400, reason="USER")
+
+    eng = GraphEngine(
+        {"name": "m", "type": "MODEL"}, resolver=resolver_for({"m": (Boom(), "MODEL")})
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.ones((1, 1)))))
+    assert out.status.status == "FAILURE"
+    assert out.status.code == 400
+    assert "bad input" in out.status.info
+
+
+# ---- custom metrics & tags passthrough --------------------------------
+
+
+def test_tags_and_metrics_flow_to_response_meta():
+    class Tagged:
+        def predict(self, X, names):
+            return X
+
+        def tags(self):
+            return {"version": "v7"}
+
+        def metrics(self):
+            return [{"key": "hits", "type": "COUNTER", "value": 1}]
+
+    eng = GraphEngine(
+        {"name": "m", "type": "MODEL"},
+        resolver=resolver_for({"m": (Tagged(), "MODEL")}),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.ones((1, 1)))))
+    assert out.meta.tags == {"version": "v7"}
+    assert [m.key for m in out.meta.metrics] == ["hits"]
+
+
+# ---- regression tests from code review --------------------------------
+
+
+def test_request_meta_not_mutated_and_not_duplicated():
+    spec = {
+        "name": "r",
+        "implementation": "SIMPLE_ROUTER",
+        "children": [{"name": "a", "type": "MODEL"}],
+    }
+    eng = GraphEngine(spec, resolver=resolver_for({"a": (Identity(), "MODEL")}))
+    req = SeldonMessage.from_ndarray(np.ones((1, 1)))
+    req.meta.tags["client"] = "v1"
+    out = run(eng.predict(req))
+    assert req.meta.tags == {"client": "v1"}  # caller's request untouched
+    assert out.meta.tags == {"client": "v1"}
+    assert out.meta.metrics == []
+
+
+def test_leaf_output_transformer_applies():
+    eng = GraphEngine(
+        {"name": "t", "type": "OUTPUT_TRANSFORMER"},
+        resolver=resolver_for({"t": (NegateOut(), "OUTPUT_TRANSFORMER")}),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[3.0]]))))
+    np.testing.assert_array_equal(out.host_data(), [[-3.0]])
+
+
+def test_generic_exception_maps_to_failure_status():
+    class Shatter:
+        def predict(self, X, names):
+            raise ValueError("shape mismatch")
+
+    eng = GraphEngine(
+        {"name": "m", "type": "MODEL"},
+        resolver=resolver_for({"m": (Shatter(), "MODEL")}),
+    )
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.ones((1, 1)))))
+    assert out.status.status == "FAILURE" and out.status.code == 500
+    assert "shape mismatch" in out.status.info
+
+
+def test_feedback_out_of_range_routing_is_safe():
+    spec = {
+        "name": "eg",
+        "implementation": "EPSILON_GREEDY",
+        "parameters": [{"name": "n_branches", "value": "2", "type": "INT"}],
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+    eng = GraphEngine(
+        spec,
+        resolver=resolver_for({"a": (Identity(), "MODEL"), "b": (Identity(), "MODEL")}),
+    )
+    resp = SeldonMessage()
+    resp.meta.routing["eg"] = 7  # client-supplied garbage
+    out = run(eng.send_feedback(Feedback(response=resp, reward=1.0)))
+    assert out.status.status == "SUCCESS"
+    assert np.all(eng.node_impl("eg").user.counts == 0)
+
+
+def test_pass_through_graph_does_not_return_request_object():
+    class NoOp:
+        pass  # no methods at all: graph is fully pass-through
+
+    eng = GraphEngine(
+        {"name": "t", "type": "TRANSFORMER", "children": [{"name": "t2", "type": "TRANSFORMER"}]},
+        resolver=resolver_for(
+            {"t": (NoOp(), "TRANSFORMER"), "t2": (NoOp(), "TRANSFORMER")}
+        ),
+    )
+    req = SeldonMessage.from_ndarray(np.ones((1, 1)))
+    out = run(eng.predict(req))
+    assert out is not req
+    assert req.status is None and req.meta.puid == ""
