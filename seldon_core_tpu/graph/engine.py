@@ -144,6 +144,7 @@ class GraphEngine:
                 bin_data=out.bin_data,
                 str_data=out.str_data,
                 json_data=out.json_data,
+                encoding=out.encoding,
             )
         out.meta = meta
         if out.status is None:
@@ -278,9 +279,9 @@ class GraphEngine:
             else:
                 targets = node.children
             await asyncio.gather(*(self._feedback_walk(c, fb) for c in targets))
-        if getattr(node.impl, "has", lambda m: False)("send_feedback") or (
-            not isinstance(node.impl, ComponentHandle)
-        ):
+        # has() is authoritative for both local handles and remote clients
+        # (RemoteComponent without a declared methods list answers True)
+        if getattr(node.impl, "has", lambda m: False)("send_feedback"):
             await _maybe_await(node.impl.send_feedback(fb))
 
     # ------------------------------------------------------------------

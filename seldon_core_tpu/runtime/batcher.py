@@ -1,0 +1,250 @@
+"""Server-side dynamic batching into HBM.
+
+The reference has **no** batcher — every request traverses the graph alone
+(SURVEY.md §2.7), which wastes an accelerator entirely.  This module is the
+new TPU-native subsystem required by the north star (BASELINE.json): queue →
+bucket/pad → one compiled device call per batch → split.
+
+Design for XLA semantics:
+- **Static shapes**: batches are padded up to a fixed bucket ladder
+  (powers of two by default) so jit compiles once per bucket, never per
+  request.  Warmup pre-compiles every bucket.
+- **One dispatch per batch**: the compiled fn is called on the padded
+  device array; JAX async dispatch means the event loop is NOT blocked while
+  the TPU computes — splitting the result into per-request views is lazy.
+- **Row accounting**: requests may carry multiple rows; the batcher packs
+  rows from many requests along axis 0 and returns each caller its slice.
+- Requests are grouped by trailing shape+dtype; mixed-shape traffic forms
+  independent lanes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def default_buckets(max_batch: int) -> list[int]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+@dataclass
+class BatcherConfig:
+    max_batch_size: int = 64
+    max_delay_ms: float = 2.0     # max time the first request waits for peers
+    buckets: Optional[list[int]] = None
+    pad_value: float = 0.0
+    name: str = "batcher"
+
+
+@dataclass
+class _Pending:
+    array: Any
+    nrows: int
+    future: asyncio.Future = field(compare=False, default=None)
+
+
+class _Lane:
+    """One (trailing-shape, dtype) lane with its own queue and flush task."""
+
+    def __init__(self, batcher: "DynamicBatcher", key):
+        self.batcher = batcher
+        self.key = key
+        self.pending: list[_Pending] = []
+        self.pending_rows = 0
+        self.flush_handle: Optional[asyncio.TimerHandle] = None
+
+
+class DynamicBatcher:
+    """Coalesces concurrent ``__call__(X)`` invocations into batched ``fn``
+    calls.  ``fn(batch) -> batch_out`` must be row-aligned on axis 0.
+
+    With ``returns_aux=True``, ``fn`` returns ``(batch_out, aux)`` and every
+    caller receives ``(row_slice, aux)`` — aux stays paired with its own
+    batch (no cross-batch aliasing)."""
+
+    def __init__(
+        self,
+        fn: Callable[[Any], Any],
+        config: Optional[BatcherConfig] = None,
+        metrics=None,  # MetricsRegistry or None
+        returns_aux: bool = False,
+    ):
+        self.fn = fn
+        self.returns_aux = returns_aux
+        self.config = config or BatcherConfig()
+        if self.config.buckets is None:
+            self.config.buckets = default_buckets(self.config.max_batch_size)
+        self.buckets = sorted(self.config.buckets)
+        self.metrics = metrics
+        self._lanes: dict[tuple, _Lane] = {}
+
+    # ------------------------------------------------------------------
+    def bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    def warmup(self, example_row: np.ndarray) -> None:
+        """Pre-compile every bucket size (first TPU compile is seconds; do it
+        before traffic, not during)."""
+        for b in self.buckets:
+            batch = np.broadcast_to(example_row, (b,) + tuple(example_row.shape))
+            y = self.fn(np.ascontiguousarray(batch))
+            if self.returns_aux:
+                y = y[0]
+            _block(y)
+
+    async def __call__(self, X: Any) -> Any:
+        arr = X if hasattr(X, "shape") else np.asarray(X)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        nrows = int(arr.shape[0])
+        if nrows > self.config.max_batch_size:
+            # oversized request: run it alone, unbatched (fn's return shape —
+            # including any aux — is already what the caller expects)
+            return self.fn(arr)
+        key = (tuple(arr.shape[1:]), str(arr.dtype))
+        lane = self._lanes.get(key)
+        if lane is None:
+            lane = self._lanes[key] = _Lane(self, key)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        lane.pending.append(_Pending(arr, nrows, fut))
+        lane.pending_rows += nrows
+        if lane.pending_rows >= self.config.max_batch_size:
+            self._flush(lane)
+        elif lane.flush_handle is None:
+            lane.flush_handle = loop.call_later(
+                self.config.max_delay_ms / 1000.0, self._flush, lane
+            )
+        return await fut
+
+    # ------------------------------------------------------------------
+    def _flush(self, lane: _Lane) -> None:
+        if lane.flush_handle is not None:
+            lane.flush_handle.cancel()
+            lane.flush_handle = None
+        batch_items: list[_Pending] = []
+        rows = 0
+        while lane.pending and rows + lane.pending[0].nrows <= self.config.max_batch_size:
+            p = lane.pending.pop(0)
+            rows += p.nrows
+            batch_items.append(p)
+        lane.pending_rows -= rows
+        if not batch_items:
+            return
+        if lane.pending:
+            # leftovers: schedule an immediate follow-up flush
+            loop = asyncio.get_running_loop()
+            lane.flush_handle = loop.call_soon(self._flush, lane)  # type: ignore[assignment]
+        try:
+            self._run_batch(batch_items, rows)
+        except Exception as e:
+            for p in batch_items:
+                if not p.future.done():
+                    p.future.set_exception(e)
+
+    def _run_batch(self, items: list[_Pending], rows: int) -> None:
+        bucket = self.bucket_for(rows)
+        if len(items) == 1 and rows == bucket:
+            batch = items[0].array
+        else:
+            first = items[0].array
+            batch = np.full(
+                (bucket,) + tuple(np.shape(first)[1:]),
+                self.config.pad_value,
+                dtype=_np_dtype_of(first),
+            )
+            off = 0
+            for p in items:
+                batch[off : off + p.nrows] = np.asarray(p.array)
+                off += p.nrows
+        if self.metrics is not None:
+            self.metrics.observe(
+                "seldon_batcher_batch_rows", rows, {"batcher": self.config.name}
+            )
+            self.metrics.counter_inc(
+                "seldon_batcher_batches_total", {"batcher": self.config.name}
+            )
+            self.metrics.counter_inc(
+                "seldon_batcher_pad_rows_total",
+                {"batcher": self.config.name},
+                bucket - rows,
+            )
+        out = self.fn(batch)  # async dispatch: returns before TPU finishes
+        aux = None
+        if self.returns_aux:
+            out, aux = out
+        off = 0
+        for p in items:
+            # lazy slice of the (possibly still computing) device array
+            sl = out[off : off + p.nrows]
+            p.future.set_result((sl, aux) if self.returns_aux else sl)
+            off += p.nrows
+
+
+def _np_dtype_of(arr: Any) -> Any:
+    return arr.dtype if hasattr(arr, "dtype") else np.asarray(arr).dtype
+
+
+def _block(x: Any) -> None:
+    if hasattr(x, "block_until_ready"):
+        x.block_until_ready()
+
+
+class BatchedModel:
+    """Adapter: wraps a ComponentHandle-compatible MODEL so its ``predict``
+    goes through a DynamicBatcher.  Engine-facing surface is async.
+
+    Non-tensor payloads (binData/strData/jsonData) bypass the batcher.
+    Batching limitation (documented): the user fn sees the *batch*, so
+    per-request feature names are not forwarded — components relying on
+    ``feature_names`` should be served unbatched.
+    """
+
+    def __init__(self, handle, config: Optional[BatcherConfig] = None, metrics=None):
+        import dataclasses
+
+        self.handle = handle
+        self.name = handle.name
+        cfg = dataclasses.replace(config) if config is not None else BatcherConfig()
+        cfg.name = self.name
+        self._batcher = DynamicBatcher(
+            self._predict_array, cfg, metrics=metrics, returns_aux=True
+        )
+
+    def warmup(self, example_row: np.ndarray) -> None:
+        self._batcher.warmup(example_row)
+
+    def _predict_array(self, batch):
+        from seldon_core_tpu.messages import SeldonMessage
+
+        out = self.handle.predict(SeldonMessage(data=batch))
+        return out.data, (out.meta, out.names)
+
+    def has(self, method: str) -> bool:
+        return self.handle.has(method)
+
+    async def predict(self, msg):
+        from seldon_core_tpu.messages import SeldonMessage
+
+        if msg.data is None:
+            return self.handle.predict(msg)
+        Y, (meta, names) = await self._batcher(msg.data)
+        return SeldonMessage(data=Y, names=list(names), meta=meta.copy())
+
+    def __getattr__(self, item):
+        return getattr(self.handle, item)

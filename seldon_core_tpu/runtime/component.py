@@ -135,14 +135,13 @@ class ComponentHandle:
         if callable(predict_fn):
             import jax
 
-            if len(_positional_params(predict_fn)) >= 2 and not hasattr(
-                user_object, "params"
-            ):
+            # arity decides the calling convention: (params, X) vs (X)
+            takes_params = len(_positional_params(predict_fn)) >= 2
+            if takes_params and not hasattr(user_object, "params"):
                 raise ValueError(
                     f"{self.name}: predict_fn takes (params, X) but the "
                     "component has no `params` attribute"
                 )
-            params = getattr(user_object, "params", None)
             donate = bool(getattr(user_object, "donate_input", False))
             shardings = getattr(user_object, "shardings", None)
             jit_kw: dict[str, Any] = {}
@@ -150,9 +149,9 @@ class ComponentHandle:
                 jit_kw["in_shardings"] = shardings.get("in")
                 jit_kw["out_shardings"] = shardings.get("out")
             if donate:
-                jit_kw["donate_argnums"] = (1,)
+                jit_kw["donate_argnums"] = (1,) if takes_params else (0,)
             fn = jax.jit(predict_fn, **jit_kw)
-            self._params = params if hasattr(user_object, "params") else _NO_PARAMS
+            self._params = user_object.params if takes_params else _NO_PARAMS
             self._compiled = fn
         elif getattr(user_object, "jit_compile", False) and self._has["predict"]:
             import jax
@@ -240,6 +239,19 @@ class ComponentHandle:
         return SeldonMessage(data=_as_array(Y), names=names, meta=self._component_meta())
 
     def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if self.service_type == "OUTLIER_DETECTOR" and self._has["score"]:
+            # outlier detectors are transformers that pass data through and
+            # tag per-row scores (reference
+            # wrappers/python/outlier_detector_microservice.py:16-89)
+            scores = self.score(msg)
+            out = SeldonMessage(
+                data=msg.data,
+                names=list(msg.names),
+                meta=self._component_meta(),
+                encoding=msg.encoding,
+            )
+            out.meta.tags["outlierScore"] = np.asarray(scores).ravel().tolist()
+            return out
         if not self._has["transform_input"]:
             return msg
         Y = self.user.transform_input(self._user_input(msg), msg.names)

@@ -1,0 +1,269 @@
+"""REST serving layer (aiohttp).
+
+Two reference API surfaces on one server:
+
+- **External API** (engine/apife parity —
+  ``engine/.../api/rest/RestClientController.java:103,142``):
+  ``POST /api/v0.1/predictions``, ``POST /api/v0.1/feedback``, plus the
+  lifecycle endpoints ``/ready``, ``/live``, ``/pause``, ``/unpause``
+  (``RestClientController.java:63-100``) used by probes and preStop drain.
+- **Internal microservice API** (wrapper parity —
+  ``wrappers/python/model_microservice.py:50-105``, docs/reference/internal-api.md):
+  ``POST /predict|/route|/aggregate|/transform-input|/transform-output|
+  /send-feedback`` so a single component can be served standalone, wire-
+  compatible with the reference engine calling it.
+
+Accepts both raw-JSON bodies and the reference's form-encoded ``json=`` field
+(``engine/.../service/InternalPredictionService.java:346-350``).
+``GET /metrics`` renders Prometheus text.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from seldon_core_tpu.messages import Feedback, SeldonMessage, Status
+from seldon_core_tpu.utils.metrics import EngineMetrics
+
+logger = logging.getLogger(__name__)
+
+
+async def _payload_json(request: web.Request) -> dict:
+    """Raw JSON body or form field ``json=`` (reference wire compat)."""
+    body = await request.read()
+    if not body:
+        raise web.HTTPBadRequest(
+            text=_err_json(400, "empty request body"),
+            content_type="application/json",
+        )
+    ctype = request.headers.get("Content-Type", "")
+    try:
+        if "application/x-www-form-urlencoded" in ctype or body[:5] == b"json=":
+            from urllib.parse import parse_qs
+
+            form = parse_qs(body.decode())
+            return json.loads(form["json"][0])
+        return json.loads(body)
+    except (ValueError, KeyError) as e:
+        raise web.HTTPBadRequest(
+            text=_err_json(400, f"malformed request: {e}"),
+            content_type="application/json",
+        )
+
+
+def _err_json(code: int, info: str, reason: str = "") -> str:
+    return SeldonMessage(status=Status.failure(code, info, reason)).to_json()
+
+
+def _msg_response(msg: SeldonMessage) -> web.Response:
+    code = 200
+    if msg.status is not None and msg.status.status == "FAILURE":
+        code = msg.status.code if 400 <= msg.status.code < 600 else 500
+    return web.Response(
+        text=msg.to_json(), content_type="application/json", status=code
+    )
+
+
+def _parse_msg(d: dict) -> SeldonMessage:
+    try:
+        return SeldonMessage.from_dict(d)
+    except Exception as e:
+        raise web.HTTPBadRequest(
+            text=_err_json(400, f"bad SeldonMessage: {e}"),
+            content_type="application/json",
+        )
+
+
+class EngineServer:
+    """Serves one predictor graph (GraphEngine) over REST."""
+
+    def __init__(
+        self,
+        engine,
+        metrics: Optional[EngineMetrics] = None,
+        name: str = "predictor",
+    ):
+        self.engine = engine
+        self.name = name
+        self.metrics = metrics or getattr(engine, "metrics", None) or EngineMetrics()
+        self.paused = False
+        self._inflight = 0
+
+    # ---- handlers -----------------------------------------------------
+    async def predictions(self, request: web.Request) -> web.Response:
+        if self.paused:
+            return web.Response(
+                status=503, text=_err_json(503, "paused"), content_type="application/json"
+            )
+        t0 = time.perf_counter()
+        payload = await _payload_json(request)
+        msg = _parse_msg(payload)
+        self._inflight += 1
+        try:
+            out = await self.engine.predict(msg)
+        finally:
+            self._inflight -= 1
+        code = out.status.code if out.status and out.status.status == "FAILURE" else 200
+        if self.metrics is not None:
+            self.metrics.observe_request(self.name, time.perf_counter() - t0, code)
+        return _msg_response(out)
+
+    async def feedback(self, request: web.Request) -> web.Response:
+        payload = await _payload_json(request)
+        try:
+            fb = Feedback.from_dict(payload)
+        except Exception as e:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, f"bad Feedback: {e}"),
+                content_type="application/json",
+            )
+        out = await self.engine.send_feedback(fb)
+        return _msg_response(out)
+
+    async def ready(self, request: web.Request) -> web.Response:
+        # drain semantics per reference /ready + preStop pause
+        if self.paused:
+            return web.Response(status=503, text="paused")
+        return web.Response(text="ready")
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.Response(text="live")
+
+    async def pause(self, request: web.Request) -> web.Response:
+        """Stop accepting traffic, then wait for in-flight requests to drain
+        (bounded), mirroring the reference preStop `curl /pause && sleep 5`
+        hook (``SeldonDeploymentOperatorImpl.java:144-148``) but actually
+        observing in-flight count instead of sleeping blind."""
+        self.paused = True
+        deadline = time.monotonic() + float(request.query.get("timeout", 10.0))
+        while self._inflight > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        return web.Response(text=f"paused inflight={self._inflight}")
+
+    async def unpause(self, request: web.Request) -> web.Response:
+        self.paused = False
+        return web.Response(text="unpaused")
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.metrics.render() if self.metrics else "",
+            content_type="text/plain",
+        )
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_post("/api/v0.1/predictions", self.predictions)
+        app.router.add_post("/api/v1.0/predictions", self.predictions)  # alias
+        app.router.add_post("/api/v0.1/feedback", self.feedback)
+        app.router.add_get("/ready", self.ready)
+        app.router.add_get("/live", self.live)
+        app.router.add_get("/pause", self.pause)
+        app.router.add_get("/unpause", self.unpause)
+        app.router.add_get("/metrics", self.prometheus)
+
+
+class ComponentServer:
+    """Serves one component (ComponentHandle) over the internal microservice
+    API, wire-compatible with the reference engine's southbound calls."""
+
+    def __init__(self, handle, metrics: Optional[EngineMetrics] = None):
+        self.handle = handle
+        self.metrics = metrics or EngineMetrics()
+
+    async def _run(self, fn, *args):
+        try:
+            res = fn(*args)
+            if asyncio.iscoroutine(res):
+                res = await res
+            return res
+        except web.HTTPException:
+            raise
+        except Exception as e:
+            logger.exception("component %s failed", self.handle.name)
+            return SeldonMessage(status=Status.failure(500, f"{type(e).__name__}: {e}"))
+
+    async def predict(self, request: web.Request) -> web.Response:
+        msg = _parse_msg(await _payload_json(request))
+        return _msg_response(await self._run(self.handle.predict, msg))
+
+    async def transform_input(self, request: web.Request) -> web.Response:
+        msg = _parse_msg(await _payload_json(request))
+        return _msg_response(await self._run(self.handle.transform_input, msg))
+
+    async def transform_output(self, request: web.Request) -> web.Response:
+        msg = _parse_msg(await _payload_json(request))
+        return _msg_response(await self._run(self.handle.transform_output, msg))
+
+    async def route(self, request: web.Request) -> web.Response:
+        import numpy as np
+
+        msg = _parse_msg(await _payload_json(request))
+        branch = await self._run(self.handle.route, msg)
+        if isinstance(branch, SeldonMessage):  # error path
+            return _msg_response(branch)
+        # reference routers answer with a 1x1 tensor
+        # (wrappers/python/router_microservice.py:20-40)
+        return _msg_response(
+            SeldonMessage(data=np.array([[branch]], dtype=np.int32), encoding="ndarray")
+        )
+
+    async def aggregate(self, request: web.Request) -> web.Response:
+        payload = await _payload_json(request)
+        msgs = [
+            _parse_msg(m) for m in payload.get("seldonMessages", [])
+        ]  # SeldonMessageList, prediction.proto:50-52
+        return _msg_response(await self._run(self.handle.aggregate, msgs))
+
+    async def send_feedback(self, request: web.Request) -> web.Response:
+        payload = await _payload_json(request)
+        try:
+            fb = Feedback.from_dict(payload)
+        except Exception as e:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, f"bad Feedback: {e}"),
+                content_type="application/json",
+            )
+        ret = await self._run(self.handle.send_feedback, fb)
+        if isinstance(ret, SeldonMessage) and ret.status and ret.status.status == "FAILURE":
+            return _msg_response(ret)
+        return _msg_response(ret if isinstance(ret, SeldonMessage) else SeldonMessage(status=Status()))
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_post("/predict", self.predict)
+        app.router.add_post("/transform-input", self.transform_input)
+        app.router.add_post("/transform-output", self.transform_output)
+        app.router.add_post("/route", self.route)
+        app.router.add_post("/aggregate", self.aggregate)
+        app.router.add_post("/send-feedback", self.send_feedback)
+        app.router.add_get("/health/status", self.health)
+        app.router.add_get("/metrics", self.prometheus)
+
+
+def build_app(
+    engine=None, component=None, metrics: Optional[EngineMetrics] = None
+) -> web.Application:
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+    if engine is not None:
+        EngineServer(engine, metrics=metrics).register(app)
+    if component is not None:
+        ComponentServer(component, metrics=metrics).register(app)
+    return app
+
+
+async def start_server(app: web.Application, host: str = "0.0.0.0", port: int = 8000):
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

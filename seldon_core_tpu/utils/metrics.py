@@ -1,0 +1,167 @@
+"""Prometheus-style metrics registry (no external deps).
+
+Replaces the reference's micrometer stack with the same externally-visible
+scheme: engine request timers tagged by deployment/predictor/node
+(``engine/.../metrics/SeldonRestTemplateExchangeTagsProvider.java:40-141``),
+custom COUNTER/GAUGE/TIMER metrics forwarded from component responses
+(``CustomMetricsManager.java:30-43``), feedback counters
+(``PredictiveUnitBean.java:283-286``).  Exposed in Prometheus text format at
+``GET /metrics`` (the operator-side scrape annotations are emitted by the
+control plane, see operator/compile.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Iterable, Optional
+
+from seldon_core_tpu.messages import Metric, MetricType
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge/histogram registry with label support."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._hist_counts: dict[tuple, list[int]] = {}
+        self._hist_sum: dict[tuple, float] = defaultdict(float)
+        self._hist_total: dict[tuple, int] = defaultdict(int)
+        self._help: dict[str, str] = {}
+
+    def _key(self, name: str, labels: Optional[dict]) -> tuple:
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def counter_inc(self, name: str, labels: Optional[dict] = None, value: float = 1.0):
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def gauge_set(self, name: str, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float, labels: Optional[dict] = None):
+        """Histogram observation (seconds for timers)."""
+        key = self._key(name, labels)
+        with self._lock:
+            if key not in self._hist_counts:
+                self._hist_counts[key] = [0] * (len(_DEFAULT_BUCKETS) + 1)
+            counts = self._hist_counts[key]
+            for i, b in enumerate(_DEFAULT_BUCKETS):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._hist_sum[key] += value
+            self._hist_total[key] += 1
+
+    def timer(self, name: str, labels: Optional[dict] = None):
+        registry = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.observe(name, time.perf_counter() - self.t0, labels)
+
+        return _Timer()
+
+    # ---- exposition ----------------------------------------------------
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            seen_types: set[str] = set()
+            for (name, labels), v in sorted(self._counters.items()):
+                if name not in seen_types:
+                    lines.append(f"# TYPE {name} counter")
+                    seen_types.add(name)
+                lines.append(f"{name}{_fmt_labels(dict(labels))} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                if name not in seen_types:
+                    lines.append(f"# TYPE {name} gauge")
+                    seen_types.add(name)
+                lines.append(f"{name}{_fmt_labels(dict(labels))} {v}")
+            for key in sorted(self._hist_counts):
+                name, labels = key
+                ld = dict(labels)
+                if name not in seen_types:
+                    lines.append(f"# TYPE {name} histogram")
+                    seen_types.add(name)
+                cum = 0
+                for i, b in enumerate(_DEFAULT_BUCKETS):
+                    cum += self._hist_counts[key][i]
+                    lines.append(
+                        f'{name}_bucket{_fmt_labels({**ld, "le": repr(b)})} {cum}'
+                    )
+                cum += self._hist_counts[key][-1]
+                lines.append(f'{name}_bucket{_fmt_labels({**ld, "le": "+Inf"})} {cum}')
+                lines.append(f"{name}_sum{_fmt_labels(ld)} {self._hist_sum[key]}")
+                lines.append(f"{name}_count{_fmt_labels(ld)} {self._hist_total[key]}")
+        return "\n".join(lines) + "\n"
+
+
+class EngineMetrics:
+    """The sink consumed by GraphEngine — reference metric-name parity:
+    ``seldon_api_executor_*`` timers and custom-metric passthrough."""
+
+    def __init__(
+        self, registry: Optional[MetricsRegistry] = None, deployment: str = ""
+    ):
+        self.registry = registry or MetricsRegistry()
+        self.deployment = deployment
+
+    def observe_node(self, predictor: str, node: str, seconds: float) -> None:
+        self.registry.observe(
+            "seldon_api_executor_client_requests_seconds",
+            seconds,
+            {"deployment_name": self.deployment, "predictor_name": predictor,
+             "model_name": node},
+        )
+
+    def observe_request(self, predictor: str, seconds: float, code: int = 200) -> None:
+        self.registry.observe(
+            "seldon_api_executor_server_requests_seconds",
+            seconds,
+            {"deployment_name": self.deployment, "predictor_name": predictor,
+             "code": str(code)},
+        )
+
+    def merge_custom(self, node: str, metrics: Iterable[Metric]) -> None:
+        for m in metrics:
+            labels = {"model_name": node, **m.tags}
+            if m.type == MetricType.COUNTER:
+                self.registry.counter_inc(m.key, labels, m.value)
+            elif m.type == MetricType.GAUGE:
+                self.registry.gauge_set(m.key, m.value, labels)
+            else:  # TIMER: reference semantics are milliseconds
+                self.registry.observe(m.key, m.value / 1000.0, labels)
+
+    def observe_feedback(self, predictor: str, reward: float) -> None:
+        labels = {"deployment_name": self.deployment, "predictor_name": predictor}
+        self.registry.counter_inc("seldon_api_model_feedback_total", labels)
+        self.registry.counter_inc("seldon_api_model_feedback_reward_total", labels, reward)
+
+    def render(self) -> str:
+        return self.registry.render()

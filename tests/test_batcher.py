@@ -1,0 +1,211 @@
+"""Dynamic batcher tests: coalescing, bucketing/padding, splitting, lanes."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.batcher import (
+    BatchedModel,
+    BatcherConfig,
+    DynamicBatcher,
+    default_buckets,
+)
+from seldon_core_tpu.runtime.component import ComponentHandle
+
+
+def test_default_buckets():
+    assert default_buckets(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert default_buckets(48) == [1, 2, 4, 8, 16, 32, 48]
+
+
+def test_concurrent_requests_coalesce_into_one_batch():
+    calls = []
+
+    def fn(batch):
+        calls.append(batch.shape)
+        return batch * 2.0
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=8, max_delay_ms=20.0))
+
+    async def main():
+        outs = await asyncio.gather(*(b(np.full((1, 3), i, np.float32)) for i in range(4)))
+        return outs
+
+    outs = asyncio.run(main())
+    assert len(calls) == 1  # one fused batch
+    assert calls[0] == (4, 3)  # padded to bucket 4
+    for i, y in enumerate(outs):
+        np.testing.assert_array_equal(y, np.full((1, 3), 2.0 * i))
+
+
+def test_full_batch_flushes_immediately():
+    calls = []
+
+    def fn(batch):
+        calls.append(batch.shape[0])
+        return batch
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=4, max_delay_ms=10_000.0))
+
+    async def main():
+        return await asyncio.gather(*(b(np.ones((1, 2))) for _ in range(4)))
+
+    asyncio.run(main())  # would hang for 10s if the size trigger didn't fire
+    assert calls == [4]
+
+
+def test_multirow_requests_split_correctly():
+    def fn(batch):
+        return np.cumsum(batch, axis=0)
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=8, max_delay_ms=5.0))
+
+    async def main():
+        a, c = await asyncio.gather(b(np.ones((2, 1))), b(np.ones((3, 1))))
+        return a, c
+
+    a, c = asyncio.run(main())
+    assert a.shape == (2, 1) and c.shape == (3, 1)
+    np.testing.assert_array_equal(a.ravel(), [1, 2])
+    np.testing.assert_array_equal(c.ravel(), [3, 4, 5])
+
+
+def test_shape_lanes_are_independent():
+    shapes = []
+
+    def fn(batch):
+        shapes.append(batch.shape)
+        return batch
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=4, max_delay_ms=5.0))
+
+    async def main():
+        return await asyncio.gather(b(np.ones((1, 2))), b(np.ones((1, 5))))
+
+    asyncio.run(main())
+    assert sorted(s[1] for s in shapes) == [2, 5]
+
+
+def test_oversized_request_runs_alone():
+    calls = []
+
+    def fn(batch):
+        calls.append(batch.shape[0])
+        return batch
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=4, max_delay_ms=1.0))
+    out = asyncio.run(b(np.ones((9, 1))))
+    assert out.shape == (9, 1)
+    assert calls == [9]
+
+
+def test_error_propagates_to_all_waiters():
+    def fn(batch):
+        raise RuntimeError("device OOM")
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=8, max_delay_ms=5.0))
+
+    async def main():
+        res = await asyncio.gather(
+            b(np.ones((1, 1))), b(np.ones((1, 1))), return_exceptions=True
+        )
+        return res
+
+    res = asyncio.run(main())
+    assert all(isinstance(r, RuntimeError) for r in res)
+
+
+def test_jax_fn_with_padding_buckets():
+    import jax
+    import jax.numpy as jnp
+
+    traces = []
+
+    @jax.jit
+    def fn(batch):
+        traces.append(batch.shape)  # records one entry per (re)trace
+        return batch + 1.0
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=8, max_delay_ms=5.0))
+    b.warmup(np.zeros((3,), np.float32))
+
+    async def main():
+        return await asyncio.gather(
+            *(b(np.zeros((1, 3), np.float32)) for _ in range(5))
+        )
+
+    outs = asyncio.run(main())
+    assert len(outs) == 5
+    # all traffic hit pre-compiled buckets: no new trace after warmup
+    assert len(traces) == len(b.buckets)
+
+
+def test_batched_model_wrapper():
+    class M:
+        def predict(self, X, names):
+            return np.asarray(X) + 1.0
+
+        def tags(self):
+            return {"m": 1}
+
+    bm = BatchedModel(
+        ComponentHandle(M(), name="m"), BatcherConfig(max_batch_size=4, max_delay_ms=5.0)
+    )
+
+    async def main():
+        return await asyncio.gather(
+            *(bm.predict(SeldonMessage.from_ndarray(np.zeros((1, 2)))) for _ in range(3))
+        )
+
+    outs = asyncio.run(main())
+    for o in outs:
+        np.testing.assert_array_equal(o.host_data(), [[1.0, 1.0]])
+        assert o.meta.tags == {"m": 1}
+
+
+def test_batched_model_aux_pairing_across_lanes():
+    """Meta/names must come from the request's own batch, not a later one."""
+
+    class M:
+        def predict(self, X, names):
+            X = np.asarray(X)
+            return X
+
+        def tags(self):
+            return {}
+
+        def metrics(self):
+            return []
+
+    class Wide:
+        class_names = ["w0", "w1", "w2"]
+
+        def predict(self, X, names):
+            return np.asarray(X)
+
+    bm = BatchedModel(
+        ComponentHandle(Wide(), name="m"),
+        BatcherConfig(max_batch_size=4, max_delay_ms=5.0),
+    )
+
+    async def main():
+        narrow = bm.predict(SeldonMessage.from_ndarray(np.zeros((1, 3))))
+        wide = bm.predict(SeldonMessage.from_ndarray(np.zeros((1, 5))))
+        return await asyncio.gather(narrow, wide)
+
+    a, b = asyncio.run(main())
+    assert a.host_data().shape == (1, 3)
+    assert b.host_data().shape == (1, 5)
+
+
+def test_batched_model_config_not_mutated():
+    class M:
+        def predict(self, X, names):
+            return np.asarray(X)
+
+    cfg = BatcherConfig(max_batch_size=4, name="shared")
+    BatchedModel(ComponentHandle(M(), name="m1"), cfg)
+    BatchedModel(ComponentHandle(M(), name="m2"), cfg)
+    assert cfg.name == "shared"

@@ -445,3 +445,39 @@ def test_pass_through_graph_does_not_return_request_object():
     out = run(eng.predict(req))
     assert out is not req
     assert req.status is None and req.meta.puid == ""
+
+
+def test_outlier_detector_as_transformer_node():
+    class OD:
+        def score(self, X, names):
+            return np.asarray(X).sum(axis=-1)
+
+    spec = {
+        "name": "od",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "type": "MODEL"}],
+    }
+
+    def resolve(unit):
+        if unit.name == "od":
+            return ComponentHandle(OD(), name="od", service_type="OUTLIER_DETECTOR")
+        return ComponentHandle(Identity(), name="m", service_type="MODEL")
+
+    eng = GraphEngine(spec, resolver=resolve)
+    out = run(eng.predict(SeldonMessage.from_ndarray(np.array([[1.0, 2.0], [3.0, 4.0]]))))
+    assert out.meta.tags["outlierScore"] == [3.0, 7.0]
+    np.testing.assert_array_equal(out.host_data(), [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_single_arg_predict_fn_with_unrelated_params_attr():
+    import jax.numpy as jnp
+
+    class C:
+        params = {"unrelated": 1}  # common attribute name; must not confuse arity
+
+        def predict_fn(self, X):
+            return jnp.asarray(X) * 2.0
+
+    h = ComponentHandle(C(), name="c")
+    out = h.predict(SeldonMessage.from_ndarray(np.ones((1, 2), np.float32)))
+    np.testing.assert_array_equal(np.asarray(out.data), [[2.0, 2.0]])

@@ -1,0 +1,163 @@
+"""REST serving tests: external + internal API parity over real sockets,
+driven with aiohttp test client (the analog of the reference's MockMvc
+full-stack tests, SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.component import ComponentHandle
+from seldon_core_tpu.serving.rest import build_app
+from seldon_core_tpu.utils.metrics import EngineMetrics, MetricsRegistry
+
+
+class PlusOne:
+    def predict(self, X, names):
+        return np.asarray(X) + 1.0
+
+    def metrics(self):
+        return [{"key": "my_counter", "type": "COUNTER", "value": 1}]
+
+
+@pytest.fixture
+def engine_app():
+    metrics = EngineMetrics(MetricsRegistry(), deployment="dep1")
+    eng = GraphEngine(
+        {"name": "m", "type": "MODEL"},
+        resolver=lambda u: ComponentHandle(PlusOne(), name="m"),
+        metrics_sink=metrics,
+    )
+    return build_app(engine=eng, metrics=metrics)
+
+
+async def _client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+@pytest.mark.asyncio
+async def test_external_predictions_roundtrip(engine_app):
+    client = await _client(engine_app)
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            json={"data": {"names": ["a"], "ndarray": [[1.0, 2.0]]}},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["data"]["ndarray"] == [[2.0, 3.0]]
+        assert body["status"]["status"] == "SUCCESS"
+        assert body["meta"]["puid"]
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_form_encoded_json_field(engine_app):
+    # reference engine posts form field json=... southbound
+    client = await _client(engine_app)
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data={"json": '{"data": {"ndarray": [[0.0]]}}'},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["data"]["ndarray"] == [[1.0]]
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_malformed_body_is_400_failure_status(engine_app):
+    client = await _client(engine_app)
+    try:
+        resp = await client.post("/api/v0.1/predictions", data=b"not json{")
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["status"]["status"] == "FAILURE"
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_feedback_endpoint(engine_app):
+    client = await _client(engine_app)
+    try:
+        resp = await client.post(
+            "/api/v0.1/feedback",
+            json={"reward": 1.0, "response": {"meta": {"routing": {}}}},
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_lifecycle_pause_drains_ready(engine_app):
+    client = await _client(engine_app)
+    try:
+        assert (await client.get("/ready")).status == 200
+        assert (await client.get("/pause")).status == 200
+        assert (await client.get("/ready")).status == 503
+        r = await client.post(
+            "/api/v0.1/predictions", json={"data": {"ndarray": [[0.0]]}}
+        )
+        assert r.status == 503
+        assert (await client.get("/unpause")).status == 200
+        assert (await client.get("/ready")).status == 200
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_metrics_exposition(engine_app):
+    client = await _client(engine_app)
+    try:
+        await client.post(
+            "/api/v0.1/predictions", json={"data": {"ndarray": [[0.0]]}}
+        )
+        text = await (await client.get("/metrics")).text()
+        assert "seldon_api_executor_server_requests_seconds" in text
+        assert 'my_counter{model_name="m"} 1.0' in text
+        assert "seldon_api_executor_client_requests_seconds" in text
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_internal_component_api():
+    app = build_app(component=ComponentHandle(PlusOne(), name="m"))
+    client = await _client(app)
+    try:
+        resp = await client.post("/predict", json={"data": {"ndarray": [[5.0]]}})
+        body = await resp.json()
+        assert body["data"]["ndarray"] == [[6.0]]
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_remote_component_through_engine():
+    """Distributed graph: engine in one app, component behind a RemoteComponent
+    client pointed at a second real HTTP server — the reference's
+    engine→microservice hop, but with pooled connections."""
+    from seldon_core_tpu.serving.client import RemoteComponent
+
+    comp_app = build_app(component=ComponentHandle(PlusOne(), name="m"))
+    comp_client = await _client(comp_app)
+    base = f"http://{comp_client.server.host}:{comp_client.server.port}"
+    remote = RemoteComponent(base, name="m")
+    try:
+        eng = GraphEngine(
+            {"name": "m", "type": "MODEL"}, resolver=lambda u: remote
+        )
+        out = await eng.predict(SeldonMessage.from_ndarray(np.array([[41.0]])))
+        assert out.status.status == "SUCCESS"
+        np.testing.assert_array_equal(out.host_data(), [[42.0]])
+    finally:
+        await remote.close()
+        await comp_client.close()
