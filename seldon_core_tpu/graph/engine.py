@@ -279,9 +279,14 @@ class GraphEngine:
             else:
                 targets = node.children
             await asyncio.gather(*(self._feedback_walk(c, fb) for c in targets))
-        # has() is authoritative for both local handles and remote clients
-        # (RemoteComponent without a declared methods list answers True)
-        if getattr(node.impl, "has", lambda m: False)("send_feedback"):
+        # has() is authoritative when present (ComponentHandle, RemoteComponent);
+        # duck-typed impls without has() get feedback iff they define the method
+        has = getattr(node.impl, "has", None)
+        if has is not None:
+            deliver = has("send_feedback")
+        else:
+            deliver = callable(getattr(node.impl, "send_feedback", None))
+        if deliver:
             await _maybe_await(node.impl.send_feedback(fb))
 
     # ------------------------------------------------------------------

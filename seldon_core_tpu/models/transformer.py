@@ -1,0 +1,442 @@
+"""Flagship model: mesh-sharded transformer LM (dense or MoE blocks).
+
+The reference serves arbitrary user models behind microservices
+(``integrations/``, ``wrappers/``); its flagship path is a GPU inference
+server proxy (``integrations/nvidia-inference-server/TRTProxy.py``).  The
+TPU-native replacement is a first-class compiled model: pure-JAX pytree
+params with explicit ``PartitionSpec``s so one definition serves every
+parallelism style over a ("dp", "pp", "tp") mesh:
+
+- **dp**: batch sharded over "dp"
+- **tp**: Megatron-pattern tensor parallelism — qkv/o and mlp in/out are
+  column/row-sharded over "tp"; XLA inserts the all-reduces
+- **sp**: long-context mode (``attention="ring"``) shards the *sequence*
+  over "tp" and runs ring attention (parallel/ring_attention.py)
+- **ep**: MoE expert dim sharded over "dp" (parallel/moe.py), composing
+  with tp-sharded expert FFNs
+- **pp**: layer stack sharded over "pp", GPipe microbatch schedule
+  (parallel/pipeline.py)
+
+Everything is jit-compiled with static shapes; rotary embeddings; RMSNorm;
+bfloat16 activations with float32 accumulation and parameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+)
+from seldon_core_tpu.parallel.pipeline import pipeline_apply
+from seldon_core_tpu.parallel.ring_attention import dense_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    n_experts: int = 0          # 0 → dense FFN; >0 → MoE every layer
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16   # activation dtype
+    attention: str = "dense"    # "dense" (tp over heads) | "ring" (sp over seq)
+    # Megatron-style sequence parallelism: residual stream + norms are
+    # sequence-sharded over "tp"; XLA inserts all-gather before qkv/mlp
+    # matmuls and reduce-scatter after the row-parallel projections.
+    # Note: "ring" attention cannot nest inside the pp pipeline's manual
+    # region (Shardy limitation); use seq_shard+dense with pp, ring when pp=1.
+    seq_shard: bool = True
+    remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+    def moe_cfg(self) -> MoEConfig:
+        return MoEConfig(
+            n_experts=self.n_experts,
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            d_model=self.d_model,
+            d_ff=self.d_ff,
+            expert_axis="dp",
+        )
+
+
+# ----------------------------------------------------------------------
+# init + shardings
+# ----------------------------------------------------------------------
+
+def init_params(key, cfg: TransformerConfig) -> dict:
+    """Float32 master params; blocks stacked with leading layer dim."""
+    k_embed, k_out, k_blocks = jax.random.split(key, 3)
+    D, H, Dh, F, L = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff, cfg.n_layers
+    s = D ** -0.5
+
+    def block_init(k):
+        ks = jax.random.split(k, 8)
+        p = {
+            "ln1": jnp.ones((D,), jnp.float32),
+            "ln2": jnp.ones((D,), jnp.float32),
+            "wq": jax.random.normal(ks[0], (D, H, Dh), jnp.float32) * s,
+            "wk": jax.random.normal(ks[1], (D, H, Dh), jnp.float32) * s,
+            "wv": jax.random.normal(ks[2], (D, H, Dh), jnp.float32) * s,
+            "wo": jax.random.normal(ks[3], (H, Dh, D), jnp.float32) * s,
+        }
+        if cfg.n_experts > 0:
+            p["moe"] = init_moe_params(ks[4], cfg.moe_cfg())
+        else:
+            p["w1"] = jax.random.normal(ks[5], (D, F), jnp.float32) * s
+            p["w2"] = jax.random.normal(ks[6], (F, D), jnp.float32) * (F ** -0.5)
+        return p
+
+    blocks = jax.vmap(block_init)(jax.random.split(k_blocks, L))
+    return {
+        "embed": jax.random.normal(k_embed, (cfg.vocab_size, D), jnp.float32) * s,
+        "blocks": blocks,
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "lm_head": jax.random.normal(k_out, (D, cfg.vocab_size), jnp.float32) * s,
+    }
+
+
+def param_specs(cfg: TransformerConfig, pp: int = 1) -> dict:
+    """PartitionSpecs per leaf.  Leading block dim sharded over "pp" when
+    pipelining; tp column/row sharding per Megatron pattern; MoE expert dim
+    over "dp"."""
+    b = "pp" if pp > 1 else None
+    block = {
+        "ln1": P(b, None),
+        "ln2": P(b, None),
+        "wq": P(b, None, "tp", None),
+        "wk": P(b, None, "tp", None),
+        "wv": P(b, None, "tp", None),
+        "wo": P(b, "tp", None, None),
+    }
+    if cfg.n_experts > 0:
+        block["moe"] = {
+            "router": P(b, None, None),
+            "w_in": P(b, "dp", None, "tp"),
+            "w_out": P(b, "dp", "tp", None),
+        }
+    else:
+        block["w1"] = P(b, None, "tp")
+        block["w2"] = P(b, "tp", None)
+    return {
+        "embed": P(None, None),
+        "blocks": block,
+        "ln_f": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_params(params: dict, mesh, cfg: TransformerConfig, pp: int = 1) -> dict:
+    specs = param_specs(cfg, pp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray),
+    )
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+def rmsnorm(x, scale, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def rope(x, positions, theta: float):
+    """Rotary embedding. x: [B, L, H, Dh]; positions: [B, L]."""
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _constrainer(mesh):
+    if mesh is None:
+        return lambda a, *s: a
+
+    def constrain(a, *spec):
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P(*spec)))
+
+    return constrain
+
+
+def _seq_axis(cfg: TransformerConfig):
+    return "tp" if cfg.seq_shard else None
+
+
+def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None):
+    """Causal self-attention.  dense: heads sharded over tp (+ Megatron SP on
+    the residual stream).  ring: sequence sharded over tp (long-context)."""
+    c = _constrainer(mesh)
+    h = rmsnorm(x, p["ln1"])
+    if cfg.attention != "ring":
+        # SP: norm ran on sequence shards; gather sequence for the matmuls
+        h = c(h, "dp", None, None)
+    q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(x.dtype))
+    k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(x.dtype))
+    v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(x.dtype))
+    q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
+    if cfg.attention == "ring" and mesh is not None and mesh.shape.get("tp", 1) > 1:
+        # manual only over tp (sequence axis); dp stays GSPMD-managed, so the
+        # spec may not mention it (partial-manual shard_map contract).
+        # When nested inside another manual region (the pp pipeline), the
+        # context mesh already marks pp Manual — pass mesh=None to adopt it.
+        ctx = jax.sharding.get_abstract_mesh()
+        spec = P(None, "tp", None, None)
+        attn = jax.shard_map(
+            partial(ring_attention, axis_name="tp", causal=True),
+            mesh=None if not ctx.empty else mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"tp"},
+            check_vma=False,
+        )(q, k, v)
+    else:
+        q = c(q, "dp", None, "tp", None)
+        k = c(k, "dp", None, "tp", None)
+        v = c(v, "dp", None, "tp", None)
+        attn = dense_attention(q, k, v, causal=True)
+    out = jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(x.dtype))
+    # SP: reduce-scatter the row-parallel output back to sequence shards
+    out = c(out, "dp", _seq_axis(cfg) if cfg.attention != "ring" else None, None)
+    return x + out
+
+
+def ffn_block(p, x, cfg: TransformerConfig, mesh=None):
+    c = _constrainer(mesh)
+    h = rmsnorm(x, p["ln2"])
+    h = c(h, "dp", None, None)  # SP gather before the column-parallel matmul
+    if cfg.n_experts > 0:
+        B, L, D = h.shape
+        flat = h.reshape(B * L, D)
+        y, aux = moe_forward(
+            {k: v.astype(x.dtype) for k, v in p["moe"].items()},
+            flat,
+            cfg.moe_cfg(),
+            constrain=c if mesh is not None else None,
+        )
+        y = c(y.reshape(B, L, D), "dp", _seq_axis(cfg), None)
+        return x + y, aux
+    h1 = jnp.einsum("bld,df->blf", h, p["w1"].astype(x.dtype))
+    h1 = c(jax.nn.gelu(h1), "dp", None, "tp")
+    out = jnp.einsum("blf,fd->bld", h1, p["w2"].astype(x.dtype))
+    out = c(out, "dp", _seq_axis(cfg), None)  # SP reduce-scatter
+    return x + out, jnp.zeros((), jnp.float32)
+
+
+def block_fn(p, x, positions, cfg: TransformerConfig, mesh=None):
+    x = attention_block(p, x, positions, cfg, mesh)
+    x, aux = ffn_block(p, x, cfg, mesh)
+    return x, aux
+
+
+def forward(
+    params: dict,
+    input_ids: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+    pp: int = 1,
+    n_microbatches: int = 1,
+):
+    """Logits [B, L, V] (+ summed MoE aux loss; aux is 0 when pp > 1 — the
+    pipeline carries activations only)."""
+    c = _constrainer(mesh)
+    B, L = input_ids.shape
+    x = params["embed"].astype(cfg.dtype)[input_ids]
+    # residual stream lives sequence-sharded (SP) between blocks
+    x = c(x, "dp", _seq_axis(cfg), None)
+    # [1, L]: broadcasts over any (micro)batch size inside the pipeline
+    positions = jnp.arange(L)[None, :]
+
+    aux_total = jnp.zeros((), jnp.float32)
+    if pp > 1 and mesh is not None:
+        def stage(p_local, act):
+            def scan_body(carry, p_layer):
+                y, _ = block_fn(p_layer, carry, positions, cfg, mesh)
+                return y, None
+
+            out, _ = jax.lax.scan(scan_body, act, p_local)
+            return out
+
+        x = pipeline_apply(
+            stage, params["blocks"], x, mesh, n_microbatches=n_microbatches
+        )
+    else:
+        def scan_body(carry, p_layer):
+            y, aux = block_fn(p_layer, carry, positions, cfg, mesh)
+            return y, aux
+
+        if cfg.remat:
+            # rematerialize each block on backward: HBM for FLOPs
+            scan_body = jax.checkpoint(scan_body)
+        x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
+        aux_total = auxes.sum()
+
+    x = rmsnorm(x, params["ln_f"])
+    x = c(x, "dp", None, None)  # gather sequence for the vocab projection
+    logits = jnp.einsum("bld,dv->blv", x, params["lm_head"].astype(cfg.dtype))
+    logits = c(logits, "dp", None, "tp")
+    return logits.astype(jnp.float32), aux_total
+
+
+# ----------------------------------------------------------------------
+# loss + train step
+# ----------------------------------------------------------------------
+
+def lm_loss(
+    params, batch: dict, cfg: TransformerConfig, mesh=None, pp: int = 1,
+    n_microbatches: int = 1, aux_weight: float = 0.01,
+):
+    """Next-token cross-entropy.  batch: input_ids [B,L], targets [B,L],
+    mask [B,L] (1 = real token)."""
+    logits, aux = forward(
+        params, batch["input_ids"], cfg, mesh, pp, n_microbatches
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tgt = jax.nn.one_hot(batch["targets"], cfg.vocab_size, dtype=logp.dtype)
+    nll = -(logp * tgt).sum(-1)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    loss = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss + aux_weight * aux
+
+
+def make_train_step(cfg: TransformerConfig, mesh=None, pp: int = 1,
+                    n_microbatches: int = 1, learning_rate: float = 1e-3):
+    """Returns (init_opt_state, train_step).  AdamW via optax; the whole
+    step (fwd+bwd+update) is one jit program over the mesh."""
+    import optax
+
+    opt = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def init_opt(params):
+        return opt.init(params)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, batch, cfg, mesh, pp, n_microbatches)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_opt, jax.jit(step, donate_argnums=(0, 1))
+
+
+# ----------------------------------------------------------------------
+# decode (serving path): KV-cache incremental generation
+# ----------------------------------------------------------------------
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None):
+    max_len = max_len or cfg.max_seq
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.d_head)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
+    """One incremental decode step.  token_ids [B]; returns (logits [B, V],
+    cache).  Static shapes: attention reads the full cache with a position
+    mask (XLA-friendly; no dynamic slices on the length axis)."""
+    c = _constrainer(mesh)
+    B = token_ids.shape[0]
+    pos = cache["pos"]                       # [B]
+    x = params["embed"].astype(cfg.dtype)[token_ids][:, None, :]  # [B,1,D]
+    positions = pos[:, None]
+
+    new_k_layers, new_v_layers = [], []
+    T = cache["k"].shape[2]
+    for i in range(cfg.n_layers):
+        p = jax.tree.map(lambda l: l[i], params["blocks"])
+        h = rmsnorm(x, p["ln1"])
+        q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(x.dtype))
+        k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(x.dtype))
+        v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(x.dtype))
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        kc = jax.vmap(
+            lambda buf, new, at: jax.lax.dynamic_update_slice(
+                buf, new, (at, 0, 0)
+            )
+        )(cache["k"][i], k, pos)
+        vc = jax.vmap(
+            lambda buf, new, at: jax.lax.dynamic_update_slice(
+                buf, new, (at, 0, 0)
+            )
+        )(cache["v"][i], v, pos)
+        new_k_layers.append(kc)
+        new_v_layers.append(vc)
+        s = jnp.einsum("blhk,bmhk->bhlm", q, kc,
+                       preferred_element_type=jnp.float32) * (cfg.d_head ** -0.5)
+        valid = (jnp.arange(T)[None, :] <= pos[:, None])[:, None, None, :]
+        s = jnp.where(valid, s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhlm,bmhk->blhk", a, vc.astype(a.dtype))
+        x = x + jnp.einsum("blhk,hkd->bld", attn.astype(x.dtype),
+                           p["wo"].astype(x.dtype))
+        x, _ = ffn_block(p, x, cfg, mesh)
+
+    x = rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("bld,dv->blv", x, params["lm_head"].astype(cfg.dtype))
+    cache = {
+        "k": jnp.stack(new_k_layers),
+        "v": jnp.stack(new_v_layers),
+        "pos": pos + 1,
+    }
+    return logits[:, 0, :].astype(jnp.float32), cache
+
+
+def generate(params, prompt_ids, n_new: int, cfg: TransformerConfig,
+             mesh=None, temperature: float = 0.0, key=None):
+    """Greedy/temperature sampling with a jitted decode step."""
+    B, L0 = prompt_ids.shape
+    cache = init_cache(cfg, B, max_len=L0 + n_new)
+    step = jax.jit(partial(decode_step, cfg=cfg, mesh=mesh))
+    # prefill token-by-token (simple; batched prefill is a future optimization)
+    logits = None
+    for t in range(L0):
+        logits, cache = step(params, cache, prompt_ids[:, t])
+    out = [prompt_ids]
+    tok = None
+    for t in range(n_new):
+        if temperature > 0.0 and key is not None:
+            key, sub = jax.random.split(key)
+            tok = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            tok = jnp.argmax(logits, axis=-1)
+        out.append(tok[:, None])
+        if t < n_new - 1:
+            logits, cache = step(params, cache, tok)
+    return jnp.concatenate(out, axis=1)

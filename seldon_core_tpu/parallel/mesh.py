@@ -1,0 +1,119 @@
+"""Device-mesh topology management.
+
+No reference counterpart: Seldon Core's only parallelism is k8s replica
+fan-out (SURVEY.md §2.7).  On TPU, a predictor graph is placed onto a slice
+and models are sharded over a ``jax.sharding.Mesh`` whose axes carry the
+five parallelism styles:
+
+- ``dp``  data parallel (batch)           — also hosts expert-parallel groups
+- ``pp``  pipeline parallel (layer stages, ppermute microbatch schedule)
+- ``tp``  tensor parallel (heads/hidden)  — also hosts Megatron-style
+          sequence parallelism and ring attention for long-context
+- ``sp``/``ep`` materialize as shardings over those axes (see
+  parallel/ring_attention.py, parallel/moe.py, parallel/pipeline.py)
+
+The factorization policy prefers tp ≤ 8 within an ICI domain (v5e tray),
+pp next, dp outermost — collectives that move the most bytes (tp
+all-reduce/all-gather) stay on the shortest ICI hops.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "pp", "tp")
+
+
+@dataclass
+class MeshPlan:
+    """A named factorization of a device count into mesh axes."""
+
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.tp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "tp": self.tp}
+
+
+def plan_mesh(
+    n_devices: int,
+    tp: Optional[int] = None,
+    pp: Optional[int] = None,
+    max_tp: int = 8,
+) -> MeshPlan:
+    """Factor ``n_devices`` into (dp, pp, tp).
+
+    Defaults: largest power-of-two tp ≤ min(max_tp, n), pp=1, rest dp.
+    Explicit tp/pp must divide n_devices.
+    """
+    if n_devices < 1:
+        raise ValueError("n_devices must be >= 1")
+    if tp is None:
+        tp = 1
+        while tp * 2 <= min(max_tp, n_devices) and n_devices % (tp * 2) == 0:
+            tp *= 2
+    if n_devices % tp != 0:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    rem = n_devices // tp
+    if pp is None:
+        pp = 1
+    if rem % pp != 0:
+        raise ValueError(f"pp={pp} does not divide {rem}")
+    return MeshPlan(dp=rem // pp, pp=pp, tp=tp)
+
+
+def make_mesh(
+    plan: Optional[MeshPlan] = None,
+    devices: Optional[Sequence] = None,
+    n_devices: Optional[int] = None,
+    **plan_kw,
+):
+    """Build a ``jax.sharding.Mesh`` with axes ("dp", "pp", "tp")."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if plan is None:
+        plan = plan_mesh(len(devices), **plan_kw)
+    if plan.n_devices != len(devices):
+        raise ValueError(
+            f"plan wants {plan.n_devices} devices, have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.tp)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def single_axis_mesh(axis: str = "sp", n_devices: Optional[int] = None):
+    """A 1-D mesh, used by ring attention / standalone SP tests."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def pspec(*axes):
+    """Shorthand PartitionSpec constructor accepting None entries."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*axes)
+
+
+def named_sharding(mesh, *axes):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*axes))

@@ -1,0 +1,108 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.7).  The layer stack is split into
+``pp`` stages whose parameters are sharded over the ``pp`` mesh axis (leading
+stage dimension).  Activations move stage→stage with ``lax.ppermute`` over
+ICI; each device runs the same compiled program (SPMD), processing one
+microbatch per tick with bubbles at fill/drain — the standard GPipe schedule,
+expressed as a ``lax.fori_loop`` under ``shard_map`` so XLA compiles one
+program instead of per-stage executables.  Differentiable: AD transposes the
+ppermutes, so the same code serves training (dryrun_multichip) and serving.
+
+Composition: ``shard_map(axis_names={"pp"})`` keeps dp/tp/ep under GSPMD
+inside the stage function (hybrid manual/automatic sharding).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh,
+    n_microbatches: int,
+    axis: str = "pp",
+):
+    """Run ``x`` through ``pp`` pipeline stages.
+
+    - ``stage_params``: pytree whose leaves have a leading layer/stage dim
+      divisible by pp, sharded over ``axis`` — each device receives its local
+      slice (e.g. [n_layers/pp, ...]).
+    - ``stage_fn(local_params, act) -> act`` applies one stage's worth of
+      layers (typically a ``lax.scan`` over the local leading dim).
+    - ``x``: [batch, ...] global input; batch must divide n_microbatches.
+
+    Returns [batch, ...] output of the last stage, replicated over ``axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    pp = mesh.shape[axis]
+    if pp == 1:
+        # degenerate single-stage pipeline: apply the whole stack locally
+        return stage_fn(stage_params, x)
+
+    B = x.shape[0]
+    assert B % n_microbatches == 0, (B, n_microbatches)
+    mb = B // n_microbatches
+    xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+
+    def local_fn(p_local, xm_local):
+        # p_local leaves: [layers_per_stage, ...] local slice
+        stage = lax.axis_index(axis)
+        n_ticks = n_microbatches + pp - 1
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        state0 = jnp.zeros((mb,) + xm_local.shape[2:], xm_local.dtype)
+        outs0 = jnp.zeros_like(xm_local)
+
+        def tick(t, carry):
+            state, outs = carry
+            # stage 0 ingests microbatch t (clamped; bubbles compute garbage)
+            mb_in = jnp.clip(t, 0, n_microbatches - 1)
+            inp = jnp.where(stage == 0, xm_local[mb_in], state)
+            out = stage_fn(p_local, inp)
+            # last stage emits microbatch t-(pp-1)
+            mb_out = t - (pp - 1)
+            valid = (stage == pp - 1) & (mb_out >= 0)
+            outs = lax.cond(
+                valid,
+                lambda o: o.at[jnp.clip(mb_out, 0, n_microbatches - 1)].set(out),
+                lambda o: o,
+                outs,
+            )
+            state = lax.ppermute(out, axis, fwd)
+            return state, outs
+
+        _, outs = lax.fori_loop(0, n_ticks, tick, (state0, outs0))
+        # replicate the result: only the last stage holds real outputs
+        outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
+        outs = lax.psum(outs, axis)
+        return outs
+
+    sm = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )
+    # partial-manual shard_map only lowers under jit (the eager path cannot
+    # represent manual-over-a-subset values)
+    ym = jax.jit(sm)(stage_params, xm)
+    return ym.reshape((B,) + ym.shape[2:])
+
+
+def stack_stage_params(per_stage: list[Any]) -> Any:
+    """Stack a list of per-stage pytrees into one pytree with leading stage
+    dim (the layout pipeline_apply expects)."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *per_stage)

@@ -1,0 +1,134 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context serving has no reference counterpart (SURVEY.md §5.7 — the
+reference has no concept of sequence length).  This implements blockwise ring
+attention (Liu et al. 2023-style): the sequence axis is sharded over a mesh
+axis; K/V blocks rotate around the ring via ``lax.ppermute`` over ICI while
+each device accumulates its queries' attention with an online-softmax
+(flash-style) update.  Memory per device is O(L/n), comms are N-1 K/V block
+rotations riding neighbor ICI links.
+
+Numerics: accumulation in float32 regardless of input dtype; masked blocks
+contribute exactly zero.  Exactness is tested against dense attention on a
+virtual CPU mesh (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, scale):
+    """One (q-block, kv-block) flash update ingredient set.
+
+    Shapes: q [B,Lq,H,D], k/v [B,Lk,H,D].  Returns (s, mask) with
+    s [B,H,Lq,Lk] scaled scores and bool mask of valid positions.
+    """
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[1])
+        k_pos = k_off + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
+        mask = mask[None, None, :, :]
+        s = jnp.where(mask, s, NEG_INF)
+        return s, mask
+    return s, None
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map``.  q/k/v: [B, L_local, H, D] (the local
+    sequence shard).  Returns [B, L_local, H, D] in q.dtype.
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    qf = q.astype(jnp.float32)
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        o, l, m, kc, vc = carry
+        src = (rank - t) % n  # origin rank of the kv block currently held
+        s, mask = _block_attn(
+            qf, kc.astype(jnp.float32), vc.astype(jnp.float32),
+            rank * Lq, src * Lk, causal, scale,
+        )
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard: rows with no valid key yet keep m == NEG_INF; exp(0)=1 would
+        # poison them, so zero masked contributions explicitly
+        p = jnp.exp(s - m_new[..., None])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None].transpose(0, 2, 1, 3) + jnp.einsum(
+            "bhlm,bmhd->blhd", p, vc.astype(jnp.float32)
+        )
+        # rotate kv to the next rank (final rotation restores original owner)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, l, m_new, kc, vc)
+
+    o, l, m, _, _ = lax.fori_loop(0, n, body, (o0, l0, m0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    axis: str = "tp",
+    causal: bool = True,
+    batch_axis: Optional[str] = "dp",
+):
+    """shard_map wrapper: q/k/v are global [B, L, H, D]; L sharded on
+    ``axis`` (and optionally B on ``batch_axis`` if the mesh has it)."""
+    from jax.sharding import PartitionSpec as P
+
+    b = batch_axis if batch_axis and batch_axis in mesh.axis_names else None
+    spec = P(b, axis, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
+def dense_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Reference dense attention (for tests and single-device fallback)."""
+    D = q.shape[-1]
+    if scale is None:
+        scale = D ** -0.5
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        L, M = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((L, M), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p, v.astype(p.dtype)).astype(q.dtype)

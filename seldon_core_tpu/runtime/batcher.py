@@ -87,8 +87,14 @@ class DynamicBatcher:
         if self.config.buckets is None:
             self.config.buckets = default_buckets(self.config.max_batch_size)
         self.buckets = sorted(self.config.buckets)
+        if self.buckets[-1] < self.config.max_batch_size:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} < max_batch_size "
+                f"{self.config.max_batch_size}: batches could exceed the pad"
+            )
         self.metrics = metrics
         self._lanes: dict[tuple, _Lane] = {}
+        self.max_lanes = 64
 
     # ------------------------------------------------------------------
     def bucket_for(self, rows: int) -> int:
@@ -119,6 +125,13 @@ class DynamicBatcher:
         key = (tuple(arr.shape[1:]), str(arr.dtype))
         lane = self._lanes.get(key)
         if lane is None:
+            if len(self._lanes) >= self.max_lanes:
+                # evict an idle lane so varied-shape traffic can't grow
+                # per-lane state without bound
+                for k, ln in list(self._lanes.items()):
+                    if not ln.pending and ln.flush_handle is None:
+                        del self._lanes[k]
+                        break
             lane = self._lanes[key] = _Lane(self, key)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()

@@ -102,10 +102,12 @@ class EngineServer:
                 status=503, text=_err_json(503, "paused"), content_type="application/json"
             )
         t0 = time.perf_counter()
-        payload = await _payload_json(request)
-        msg = _parse_msg(payload)
+        # count in-flight from acceptance (before the body read) so /pause
+        # drain can't report empty while an accepted request is still parsing
         self._inflight += 1
         try:
+            payload = await _payload_json(request)
+            msg = _parse_msg(payload)
             out = await self.engine.predict(msg)
         finally:
             self._inflight -= 1
@@ -176,15 +178,22 @@ class ComponentServer:
         self.metrics = metrics or EngineMetrics()
 
     async def _run(self, fn, *args):
+        t0 = time.perf_counter()
         try:
             res = fn(*args)
             if asyncio.iscoroutine(res):
                 res = await res
+            if isinstance(res, SeldonMessage) and res.meta.metrics:
+                self.metrics.merge_custom(self.handle.name, res.meta.metrics)
+            self.metrics.observe_request(self.handle.name, time.perf_counter() - t0)
             return res
         except web.HTTPException:
             raise
         except Exception as e:
             logger.exception("component %s failed", self.handle.name)
+            self.metrics.observe_request(
+                self.handle.name, time.perf_counter() - t0, 500
+            )
             return SeldonMessage(status=Status.failure(500, f"{type(e).__name__}: {e}"))
 
     async def predict(self, request: web.Request) -> web.Response:
@@ -229,9 +238,9 @@ class ComponentServer:
                 content_type="application/json",
             )
         ret = await self._run(self.handle.send_feedback, fb)
-        if isinstance(ret, SeldonMessage) and ret.status and ret.status.status == "FAILURE":
-            return _msg_response(ret)
-        return _msg_response(ret if isinstance(ret, SeldonMessage) else SeldonMessage(status=Status()))
+        return _msg_response(
+            ret if isinstance(ret, SeldonMessage) else SeldonMessage(status=Status())
+        )
 
     async def health(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")
@@ -247,7 +256,12 @@ class ComponentServer:
         app.router.add_post("/aggregate", self.aggregate)
         app.router.add_post("/send-feedback", self.send_feedback)
         app.router.add_get("/health/status", self.health)
-        app.router.add_get("/metrics", self.prometheus)
+        # an EngineServer registered first may already own /metrics
+        if not any(
+            getattr(r.resource, "canonical", "") == "/metrics"
+            for r in app.router.routes()
+        ):
+            app.router.add_get("/metrics", self.prometheus)
 
 
 def build_app(

@@ -19,6 +19,13 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
+# The axon TPU plugin in this image force-appends itself to jax_platforms even
+# when JAX_PLATFORMS=cpu is set, so pin the platform via jax.config before any
+# backend initialization.  Tests must run on the 8-device virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def pytest_collection_modifyitems(items):
     # allow `async def` tests without pytest-asyncio (not in this image)

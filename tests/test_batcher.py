@@ -209,3 +209,26 @@ def test_batched_model_config_not_mutated():
     BatchedModel(ComponentHandle(M(), name="m1"), cfg)
     BatchedModel(ComponentHandle(M(), name="m2"), cfg)
     assert cfg.name == "shared"
+
+
+def test_buckets_smaller_than_max_batch_rejected():
+    def fn(batch):
+        return batch
+
+    with pytest.raises(ValueError):
+        DynamicBatcher(fn, BatcherConfig(max_batch_size=64, buckets=[2, 4]))
+
+
+def test_lane_eviction_bounds_memory():
+    def fn(batch):
+        return batch
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=2, max_delay_ms=1.0))
+    b.max_lanes = 4
+
+    async def main():
+        for w in range(10):  # 10 distinct shapes
+            await b(np.ones((1, w + 1)))
+
+    asyncio.run(main())
+    assert len(b._lanes) <= 4

@@ -481,3 +481,18 @@ def test_single_arg_predict_fn_with_unrelated_params_attr():
     h = ComponentHandle(C(), name="c")
     out = h.predict(SeldonMessage.from_ndarray(np.ones((1, 2), np.float32)))
     np.testing.assert_array_equal(np.asarray(out.data), [[2.0, 2.0]])
+
+
+def test_feedback_delivered_to_ducktyped_impl_without_has():
+    rewards = []
+
+    class Duck:
+        def predict(self, msg):
+            return msg
+
+        def send_feedback(self, fb):
+            rewards.append(fb.reward)
+
+    eng = GraphEngine({"name": "m", "type": "MODEL"}, resolver=lambda u: Duck())
+    run(eng.send_feedback(Feedback(reward=0.9)))
+    assert rewards == [0.9]

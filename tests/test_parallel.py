@@ -1,0 +1,176 @@
+"""Parallel-layer tests on the virtual 8-device CPU mesh: ring attention
+exactness, MoE dispatch correctness, pipeline schedule equivalence, mesh
+planning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.parallel.mesh import (
+    make_mesh,
+    plan_mesh,
+    single_axis_mesh,
+)
+from seldon_core_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_forward_dense_reference,
+)
+from seldon_core_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from seldon_core_tpu.parallel.ring_attention import (
+    dense_attention,
+    ring_attention_sharded,
+)
+
+
+def test_plan_mesh_factorization():
+    assert plan_mesh(8).axis_sizes() == {"dp": 1, "pp": 1, "tp": 8}
+    assert plan_mesh(8, tp=2).axis_sizes() == {"dp": 4, "pp": 1, "tp": 2}
+    assert plan_mesh(8, tp=2, pp=2).axis_sizes() == {"dp": 2, "pp": 2, "tp": 2}
+    assert plan_mesh(1).axis_sizes() == {"dp": 1, "pp": 1, "tp": 1}
+    with pytest.raises(ValueError):
+        plan_mesh(8, tp=3)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(n_devices=8, tp=2, pp=2)
+    assert mesh.axis_names == ("dp", "pp", "tp")
+    assert mesh.shape["dp"] == 2
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    mesh = single_axis_mesh("sp", 4)
+    B, L, H, D = 2, 32, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, L, H, D), jnp.float32) for kk in ks)
+    out = ring_attention_sharded(q, k, v, mesh, axis="sp", causal=causal,
+                                 batch_axis=None)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_grad_flows():
+    mesh = single_axis_mesh("sp", 4)
+    B, L, H, D = 1, 16, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (B, L, H, D), jnp.float32) for kk in ks)
+
+    def loss_ring(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, axis="sp", causal=True,
+                                      batch_axis=None).sum()
+
+    def loss_dense(q, k, v):
+        return dense_attention(q, k, v, causal=True).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=2e-4)
+
+
+def test_moe_matches_dense_reference_when_no_drops():
+    cfg = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0, d_model=16, d_ff=32)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (24, 16), jnp.float32)
+    y, aux = moe_forward(params, x, cfg)
+    y_ref = moe_forward_dense_reference(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+    assert float(aux) > 0.0
+
+
+def test_moe_capacity_drops_tokens_gracefully():
+    cfg = MoEConfig(n_experts=2, top_k=1, capacity_factor=0.5, d_model=8, d_ff=16)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8), jnp.float32)
+    y, _ = moe_forward(params, x, cfg)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    # with tiny capacity some tokens must be dropped (zero output rows)
+    dropped = np.asarray((jnp.abs(y).sum(-1) == 0))
+    assert dropped.any()
+
+
+def test_moe_sharded_on_mesh_matches_unsharded():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(n_devices=8, tp=2, pp=1)  # dp=4
+    cfg = MoEConfig(n_experts=4, top_k=2, capacity_factor=8.0, d_model=16,
+                    d_ff=32, expert_axis="dp")
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    y_ref, _ = moe_forward(params, x, cfg)
+
+    def constrain(a, *axes):
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P(*axes)))
+
+    from seldon_core_tpu.parallel.moe import moe_param_specs
+
+    specs = moe_param_specs(cfg)
+    p_sh = {k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()}
+    x_sh = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+
+    @jax.jit
+    def f(p, x):
+        return moe_forward(p, x, cfg, constrain=constrain)[0]
+
+    y = f(p_sh, x_sh)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh(n_devices=8, tp=2, pp=2)  # pp=2
+
+    def stage_fn(p, a):  # local slice has leading dim 1 (one layer/stage)
+        return jnp.tanh(a @ p["w"][0] + p["b"][0])
+
+    d = 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    per_stage = [
+        {"w": jax.random.normal(ks[2 * i], (d, d)) * 0.5, "b": jnp.zeros((d,))}
+        for i in range(2)
+    ]
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(ks[3], (8, d), jnp.float32)
+
+    y = pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=4)
+    y_ref = x
+    for p in per_stage:
+        y_ref = jnp.tanh(y_ref @ p["w"] + p["b"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+
+def test_pipeline_single_stage_degenerate():
+    mesh = make_mesh(n_devices=8, tp=8, pp=1)
+
+    def stage_fn(p, a):
+        return a * p["s"][0]
+
+    stacked = {"s": jnp.ones((1,)) * 3.0}
+    x = jnp.ones((4, 2))
+    y = pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(y), 3.0 * np.ones((4, 2)))
+
+
+def test_pipeline_is_differentiable():
+    mesh = make_mesh(n_devices=8, tp=1, pp=2)  # dp=4, pp=2
+
+    def stage_fn(p, a):
+        return a @ p["w"][0]
+
+    d = 4
+    per_stage = [
+        {"w": jnp.eye(d) * (i + 1.0)} for i in range(2)
+    ]
+    stacked = stack_stage_params(per_stage)
+    x = jnp.ones((4, d))
+
+    def loss(params):
+        return pipeline_apply(stage_fn, params, x, mesh, n_microbatches=2).sum()
+
+    g = jax.grad(loss)(stacked)
+    # d(sum)/dw0 = sum over batch of x^T @ (w1 ones) -> each entry 2*4? check finite & nonzero
+    assert np.isfinite(np.asarray(g["w"])).all()
+    assert np.abs(np.asarray(g["w"])).sum() > 0

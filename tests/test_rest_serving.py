@@ -161,3 +161,16 @@ async def test_remote_component_through_engine():
     finally:
         await remote.close()
         await comp_client.close()
+
+
+@pytest.mark.asyncio
+async def test_component_server_metrics_populated():
+    app = build_app(component=ComponentHandle(PlusOne(), name="m"))
+    client = await _client(app)
+    try:
+        await client.post("/predict", json={"data": {"ndarray": [[5.0]]}})
+        text = await (await client.get("/metrics")).text()
+        assert "seldon_api_executor_server_requests_seconds" in text
+        assert 'my_counter{model_name="m"} 1.0' in text
+    finally:
+        await client.close()
