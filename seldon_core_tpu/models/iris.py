@@ -1,0 +1,57 @@
+"""Iris classifier — BASELINE.md config 1 (reference: sklearn_iris example,
+``examples/models/sklearn_iris/IrisClassifier.py`` — a pickled sklearn
+LogisticRegression behind the python wrapper).
+
+TPU-native equivalent: multinomial logistic regression as a compiled JAX fn
+with coefficients trained in-process at construction (no pickle, no sklearn).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _iris_data():
+    """A compact, deterministic stand-in for the iris dataset: three
+    Gaussian-ish clusters with the classic feature scales."""
+    rng = np.random.default_rng(0)
+    means = np.array(
+        [[5.0, 3.4, 1.5, 0.2], [5.9, 2.8, 4.3, 1.3], [6.6, 3.0, 5.6, 2.1]]
+    )
+    X = np.concatenate(
+        [rng.normal(m, [0.35, 0.35, 0.3, 0.15], (50, 4)) for m in means]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(3), 50)
+    return X, y
+
+
+class IrisClassifier:
+    class_names = ["setosa", "versicolor", "virginica"]
+
+    def __init__(self, epochs: int = 200, lr: float = 0.1, seed: int = 0):
+        X, y = _iris_data()
+        key = jax.random.PRNGKey(seed)
+        w = jax.random.normal(key, (4, 3), jnp.float32) * 0.01
+        b = jnp.zeros((3,), jnp.float32)
+        Xj, yj = jnp.asarray(X), jax.nn.one_hot(y, 3)
+
+        @jax.jit
+        def step(w, b):
+            def loss(w, b):
+                logits = Xj @ w + b
+                return -(yj * jax.nn.log_softmax(logits)).sum(-1).mean()
+
+            gw, gb = jax.grad(loss, argnums=(0, 1))(w, b)
+            return w - lr * gw, b - lr * gb
+
+        for _ in range(epochs):
+            w, b = step(w, b)
+        self.params = {"w": w, "b": b}
+
+    def predict_fn(self, params, X):
+        return jax.nn.softmax(jnp.asarray(X, jnp.float32) @ params["w"] + params["b"])
+
+    def tags(self):
+        return {"model": "iris-logreg"}

@@ -1,0 +1,51 @@
+"""MNIST MLP — BASELINE.md config 2 (reference: Keras/TF MNIST example
+``examples/models/keras_mnist``/``deep_mnist`` served via the python wrapper).
+
+Here it's a compiled-JAX component: ``predict_fn`` + ``params`` trigger the
+ComponentHandle jit fast path, so serving goes straight to the TPU through the
+dynamic batcher.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_params(key, sizes=(784, 512, 256, 10), dtype=jnp.float32):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (m, n) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        params.append(
+            {
+                "w": jax.random.normal(k, (m, n), dtype) * (m ** -0.5),
+                "b": jnp.zeros((n,), dtype),
+            }
+        )
+    return params
+
+
+def mlp_apply(params, x):
+    x = x.reshape(x.shape[0], -1)
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    last = params[-1]
+    return jax.nn.softmax(x @ last["w"] + last["b"], axis=-1)
+
+
+class MNISTMLP:
+    """Graph MODEL component.  Duck-type contract per
+    ``wrappers/python/model_microservice.py:32-43``."""
+
+    class_names = [f"class:{i}" for i in range(10)]
+
+    def __init__(self, seed: int = 0, hidden: int = 512):
+        self.params = init_mlp_params(
+            jax.random.PRNGKey(seed), (784, hidden, hidden // 2, 10)
+        )
+
+    def predict_fn(self, params, X):
+        return mlp_apply(params, jnp.asarray(X, jnp.float32))
+
+    def tags(self):
+        return {"model": "mnist-mlp"}

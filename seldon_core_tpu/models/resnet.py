@@ -1,0 +1,86 @@
+"""ResNet50 — the benchmark model (BASELINE.md config 3; replaces the
+reference's ``integrations/nvidia-inference-server`` TensorRT ResNet50 path).
+
+Flax Linen implementation (v1.5 bottleneck layout), served as a compiled
+component: bfloat16 activations feed the MXU; inference-mode BatchNorm uses
+folded running statistics so the whole forward is one fused XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=True, momentum=0.9, dtype=self.dtype
+        )
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="proj",
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet50
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(64 * 2 ** i, strides=strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+
+
+class ResNet50Model:
+    """Graph MODEL component serving ResNet50 on [B, H, W, 3] images."""
+
+    def __init__(self, seed: int = 0, num_classes: int = 1000,
+                 image_size: int = 224, dtype: str = "bfloat16"):
+        self.module = ResNet(num_classes=num_classes, dtype=jnp.dtype(dtype))
+        self.image_size = image_size
+        self.params = self.module.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, image_size, image_size, 3), jnp.float32),
+        )
+        self.class_names = [f"class:{i}" for i in range(num_classes)]
+
+    def predict_fn(self, variables, X):
+        return self.module.apply(variables, jnp.asarray(X))
+
+    def tags(self):
+        return {"model": "resnet50", "image_size": self.image_size}

@@ -128,10 +128,10 @@ def param_specs(cfg: TransformerConfig, pp: int = 1) -> dict:
         "wo": P(b, "tp", None, None),
     }
     if cfg.n_experts > 0:
+        from seldon_core_tpu.parallel.moe import moe_param_specs
+
         block["moe"] = {
-            "router": P(b, None, None),
-            "w_in": P(b, "dp", None, "tp"),
-            "w_out": P(b, "dp", "tp", None),
+            k: P(b, *s) for k, s in moe_param_specs(cfg.moe_cfg()).items()
         }
     else:
         block["w1"] = P(b, None, "tp")
@@ -278,11 +278,20 @@ def forward(
 
     aux_total = jnp.zeros((), jnp.float32)
     if pp > 1 and mesh is not None:
+        if cfg.attention == "ring":
+            raise ValueError(
+                "attention='ring' cannot run inside the pp pipeline (nested "
+                "manual shard_map is unsupported by Shardy); use "
+                "seq_shard=True with attention='dense', or pp=1"
+            )
+
         def stage(p_local, act):
             def scan_body(carry, p_layer):
                 y, _ = block_fn(p_layer, carry, positions, cfg, mesh)
                 return y, None
 
+            if cfg.remat:
+                scan_body = jax.checkpoint(scan_body)
             out, _ = jax.lax.scan(scan_body, act, p_local)
             return out
 
@@ -422,6 +431,8 @@ def generate(params, prompt_ids, n_new: int, cfg: TransformerConfig,
              mesh=None, temperature: float = 0.0, key=None):
     """Greedy/temperature sampling with a jitted decode step."""
     B, L0 = prompt_ids.shape
+    if temperature > 0.0 and key is None:
+        key = jax.random.PRNGKey(0)
     cache = init_cache(cfg, B, max_len=L0 + n_new)
     step = jax.jit(partial(decode_step, cfg=cfg, mesh=mesh))
     # prefill token-by-token (simple; batched prefill is a future optimization)

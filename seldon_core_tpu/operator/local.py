@@ -1,0 +1,224 @@
+"""Local runtime: boot a SeldonDeployment's predictor graphs in-process.
+
+Two jobs:
+
+1. **The engine-pod entrypoint**: inside a colocated pod the engine process
+   reads ``ENGINE_PREDICTOR`` (base64 JSON, reference
+   ``EnginePredictor.java:57-107``), instantiates every LOCAL graph node
+   in-process (user classes via the ``model_class`` parameter,
+   ``module:Class``), wires remote nodes through RemoteComponent clients,
+   wraps MODEL nodes in the dynamic batcher per annotations, and serves REST.
+2. **Dev/test harness**: the same code boots whole deployments (all
+   predictors, traffic split) in one process — the TPU analog of the
+   reference's full-stack tests with mocked transports (SURVEY.md §4.1),
+   except nothing is mocked.
+"""
+
+from __future__ import annotations
+
+import importlib
+import random
+from typing import Any, Optional
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.graph.spec import PredictiveUnit
+from seldon_core_tpu.operator.compile import defaulting
+from seldon_core_tpu.operator.spec import (
+    PredictorSpec,
+    SeldonDeployment,
+    validate_deployment,
+)
+from seldon_core_tpu.runtime.batcher import BatchedModel, BatcherConfig
+from seldon_core_tpu.runtime.component import ComponentHandle, load_component
+from seldon_core_tpu.utils.metrics import EngineMetrics, MetricsRegistry
+
+
+def resolve_component(
+    unit: PredictiveUnit,
+    annotations: Optional[dict] = None,
+    metrics: Optional[MetricsRegistry] = None,
+):
+    """Instantiate one graph node's implementation.
+
+    Resolution order (built-ins are handled by GraphEngine itself):
+    1. ``model_class`` parameter ``pkg.module:Class`` → import + construct
+       with the node's remaining parameters (the in-process analog of the
+       reference's s2i `MODEL_NAME` boot, ``microservice.py:209-216``).
+    2. remote endpoint → pooled RemoteComponent client.
+    """
+    ann = annotations or {}
+    model_class = unit.parameters.get("model_class")
+    if model_class:
+        mod_name, _, cls_name = model_class.partition(":")
+        params = {k: v for k, v in unit.parameters.items() if k != "model_class"}
+        handle = load_component(
+            mod_name, cls_name or None, params, service_type=unit.resolved_type
+        )
+        handle.name = unit.name
+        if unit.resolved_type == "MODEL" and _batching_enabled(ann):
+            return BatchedModel(handle, _batcher_config(ann), metrics=metrics)
+        return handle
+    if unit.endpoint.service_host and unit.endpoint.type != "LOCAL":
+        from seldon_core_tpu.serving.client import RemoteComponent
+
+        scheme_port = unit.endpoint.service_port or 8000
+        return RemoteComponent(
+            f"http://{unit.endpoint.service_host}:{scheme_port}",
+            name=unit.name,
+            methods=unit.methods,
+        )
+    raise ValueError(
+        f"node {unit.name!r}: no implementation, model_class, or endpoint"
+    )
+
+
+def _batching_enabled(ann: dict) -> bool:
+    return ann.get("seldon.io/batching", "true").lower() != "false"
+
+
+def _batcher_config(ann: dict) -> BatcherConfig:
+    return BatcherConfig(
+        max_batch_size=int(ann.get("seldon.io/batch-max-size", "64")),
+        max_delay_ms=float(ann.get("seldon.io/batch-max-delay-ms", "2.0")),
+    )
+
+
+class LocalPredictor:
+    """One predictor graph, compiled to a GraphEngine with live components."""
+
+    def __init__(
+        self,
+        dep: SeldonDeployment,
+        pred: PredictorSpec,
+        metrics: Optional[EngineMetrics] = None,
+    ):
+        self.spec = pred
+        self.metrics = metrics or EngineMetrics(deployment=dep.name)
+        ann = {**dep.annotations, **pred.annotations}
+        self.engine = GraphEngine(
+            pred.graph,
+            resolver=lambda u: resolve_component(u, ann, self.metrics.registry),
+            name=pred.name,
+            metrics_sink=self.metrics,
+        )
+
+
+class LocalDeployment:
+    """All predictors of one SeldonDeployment + replica-ratio traffic split
+    (reference: predictors share one Service, traffic ∝ replicas —
+    ``SeldonDeploymentOperatorImpl.java:619-626``)."""
+
+    def __init__(self, dep: SeldonDeployment, seed: Optional[int] = None):
+        validate_deployment(dep)
+        defaulting(dep)
+        self.spec = dep
+        self.metrics = EngineMetrics(MetricsRegistry(), deployment=dep.name)
+        self.predictors = [LocalPredictor(dep, p, self.metrics) for p in dep.predictors]
+        self._rng = random.Random(seed)
+        weights = [max(p.spec.replicas, 0) * max(p.spec.traffic, 0)
+                   for p in self.predictors]
+        total = sum(weights) or len(weights)
+        self._weights = [w / total if total else 1 / len(weights) for w in weights]
+
+    def pick(self) -> LocalPredictor:
+        if len(self.predictors) == 1:
+            return self.predictors[0]
+        r = self._rng.random()
+        acc = 0.0
+        for p, w in zip(self.predictors, self._weights):
+            acc += w
+            if r <= acc:
+                return p
+        return self.predictors[-1]
+
+    async def predict(self, msg):
+        return await self.pick().engine.predict(msg)
+
+    async def send_feedback(self, fb):
+        # feedback goes to every predictor (each replays its own routing)
+        out = None
+        for p in self.predictors:
+            out = await p.engine.send_feedback(fb)
+        return out
+
+
+def load_deployment_file(path: str) -> SeldonDeployment:
+    import json as _json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        d = _json.loads(text)
+    except ValueError:
+        import re
+
+        try:
+            import yaml  # type: ignore
+
+            d = yaml.safe_load(text)
+        except ImportError as e:
+            raise ValueError(f"{path}: not JSON and no yaml module") from e
+    return SeldonDeployment.from_dict(d)
+
+
+def _honor_jax_platforms_env() -> None:
+    """Some TPU plugin images force-append their platform to jax_platforms,
+    silently overriding JAX_PLATFORMS=cpu; re-assert the user's choice."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
+def engine_main(argv: Optional[list] = None) -> None:
+    """Engine-pod entrypoint: ``python -m seldon_core_tpu.operator.local
+    [--graph spec.json] [--port 8000]``.  Without --graph, reads
+    ``ENGINE_PREDICTOR`` (base64 JSON) like the reference engine."""
+    import argparse
+    import asyncio
+    import base64
+    import json as _json
+    import os
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--graph", help="path to SeldonDeployment or graph JSON")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("ENGINE_SERVER_PORT", "8000")))
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    _honor_jax_platforms_env()
+
+    if args.graph:
+        dep = load_deployment_file(args.graph)
+    else:
+        raw = os.environ.get("ENGINE_PREDICTOR")
+        if not raw:
+            raise SystemExit("need --graph or ENGINE_PREDICTOR env")
+        pred = _json.loads(base64.b64decode(raw))
+        dep = SeldonDeployment(
+            name=os.environ.get("SELDON_DEPLOYMENT_ID", "deployment"),
+            predictors=[PredictorSpec.from_dict(pred)],
+        )
+
+    local = LocalDeployment(dep)
+
+    async def serve():
+        from seldon_core_tpu.serving.rest import build_app, start_server
+
+        app = build_app(engine=local, metrics=local.metrics)
+        await start_server(app, args.host, args.port)
+        print(f"serving deployment {dep.name!r} on {args.host}:{args.port}",
+              flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    engine_main()

@@ -1,0 +1,164 @@
+"""SeldonDeployment spec: the CRD data model.
+
+Schema parity with ``/root/reference/proto/seldon_deployment.proto:10-125``:
+``SeldonDeployment{apiVersion, kind, metadata, spec{name, oauth_key,
+oauth_secret, annotations, predictors[]{name, graph, componentSpecs[],
+replicas, annotations, labels}}, status}``.  Users' existing deployment JSON
+parses unchanged; TPU-specific knobs ride annotations (the reference's own
+extension mechanism, ``docs/annotations.md``).
+
+TPU annotations (all optional):
+- ``seldon.io/tpu-chips``: chips this predictor's graph needs (e.g. "8")
+- ``seldon.io/tpu-topology``: explicit topology (e.g. "2x4")
+- ``seldon.io/colocate-graph``: "true" (default) — place the whole graph in
+  one pod on one slice so edges stay in HBM; "false" → one pod per component
+  (the reference's layout)
+- ``seldon.io/batch-max-size`` / ``seldon.io/batch-max-delay-ms``: dynamic
+  batcher config for MODEL nodes
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from seldon_core_tpu.graph.spec import (
+    GraphValidationError,
+    PredictiveUnit,
+    parse_graph,
+    validate_graph,
+)
+
+API_VERSION = "machinelearning.seldon.io/v1alpha3"
+KIND = "SeldonDeployment"
+
+
+@dataclass
+class PredictorSpec:
+    name: str
+    graph: PredictiveUnit
+    replicas: int = 1
+    annotations: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    component_specs: list[dict] = field(default_factory=list)  # k8s PodTemplateSpec dicts
+    traffic: int = 100  # canary traffic weight (reference: replica-ratio only)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PredictorSpec":
+        return cls(
+            name=d.get("name", ""),
+            graph=parse_graph(d.get("graph", {})),
+            replicas=int(d.get("replicas", 1)),
+            annotations=dict(d.get("annotations", {})),
+            labels=dict(d.get("labels", {})),
+            component_specs=list(d.get("componentSpecs", []) or []),
+            traffic=int(d.get("traffic", 100)),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "graph": self.graph.to_dict(),
+            "replicas": self.replicas,
+            "annotations": self.annotations,
+            "labels": self.labels,
+            "componentSpecs": self.component_specs,
+            "traffic": self.traffic,
+        }
+
+
+@dataclass
+class SeldonDeployment:
+    name: str
+    predictors: list[PredictorSpec] = field(default_factory=list)
+    oauth_key: str = ""
+    oauth_secret: str = ""
+    annotations: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    namespace: str = "default"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SeldonDeployment":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        return cls(
+            name=spec.get("name") or meta.get("name", ""),
+            predictors=[PredictorSpec.from_dict(p) for p in spec.get("predictors", [])],
+            oauth_key=spec.get("oauth_key", ""),
+            oauth_secret=spec.get("oauth_secret", ""),
+            annotations=dict(spec.get("annotations", {})),
+            labels=dict(meta.get("labels", {})),
+            namespace=meta.get("namespace", "default"),
+        )
+
+    @classmethod
+    def from_json(cls, s) -> "SeldonDeployment":
+        return cls.from_dict(json.loads(s))
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": KIND,
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": self.labels,
+            },
+            "spec": {
+                "name": self.name,
+                "oauth_key": self.oauth_key,
+                "oauth_secret": self.oauth_secret,
+                "annotations": self.annotations,
+                "predictors": [p.to_dict() for p in self.predictors],
+            },
+        }
+
+
+class DeploymentValidationError(Exception):
+    pass
+
+
+def validate_deployment(dep: SeldonDeployment) -> None:
+    """Operator-side validation, mirroring
+    ``SeldonDeploymentOperatorImpl.java:426-469``: non-empty predictors,
+    unique predictor names, valid graphs, and every non-builtin graph node
+    resolvable to a container/implementation."""
+    if not dep.name:
+        raise DeploymentValidationError("deployment has no name")
+    if not dep.predictors:
+        raise DeploymentValidationError("deployment has no predictors")
+    seen = set()
+    for p in dep.predictors:
+        if p.name in seen:
+            raise DeploymentValidationError(f"duplicate predictor {p.name!r}")
+        seen.add(p.name)
+        if p.replicas < 0:
+            raise DeploymentValidationError(f"{p.name}: negative replicas")
+        try:
+            validate_graph(p.graph)
+        except GraphValidationError as e:
+            raise DeploymentValidationError(f"{p.name}: {e}") from e
+        containers = _container_names(p)
+        for unit in p.graph.walk():
+            if unit.implementation:
+                continue
+            if (
+                not unit.parameters.get("model_class")
+                and unit.name not in containers
+                and not unit.endpoint.service_host
+            ):
+                raise DeploymentValidationError(
+                    f"{p.name}: graph node {unit.name!r} has no implementation, "
+                    "no matching container, no model_class parameter, and no "
+                    "endpoint"
+                )
+
+
+def _container_names(p: PredictorSpec) -> set[str]:
+    names = set()
+    for cs in p.component_specs:
+        for c in (cs.get("spec", {}) or {}).get("containers", []) or []:
+            if c.get("name"):
+                names.add(c["name"])
+    return names

@@ -1,0 +1,100 @@
+"""Standalone component server CLI — reference python-wrapper parity.
+
+Reference: ``wrappers/python/microservice.py:18-263`` — the s2i `run` script
+execs ``python microservice.py $MODEL_NAME $API_TYPE --service-type
+$SERVICE_TYPE --parameters $PREDICTIVE_UNIT_PARAMETERS``.  Same CLI here:
+
+    python -m seldon_core_tpu.serving.microservice MyModel REST \
+        --service-type MODEL --parameters '[{"name":"x","value":"1","type":"INT"}]'
+
+Env parity: ``PREDICTIVE_UNIT_SERVICE_PORT``, ``PREDICTIVE_UNIT_PARAMETERS``,
+``PREDICTIVE_UNIT_ID``.  Annotations are read from the downward-API file
+``/etc/podinfo/annotations`` when present (``microservice.py:171-188``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+from seldon_core_tpu.graph.spec import _coerce_param
+from seldon_core_tpu.runtime.component import load_component
+from seldon_core_tpu.utils.metrics import EngineMetrics, MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+ANNOTATIONS_FILE = "/etc/podinfo/annotations"
+
+
+def parse_parameters(raw: Optional[str]) -> dict:
+    """Reference format: JSON list of {name, value, type}
+    (``microservice.py:155-169``)."""
+    if not raw:
+        return {}
+    out = {}
+    for p in json.loads(raw):
+        out[p["name"]] = _coerce_param(p.get("value"), p.get("type", "STRING"))
+    return out
+
+
+def load_annotations(path: str = ANNOTATIONS_FILE) -> dict:
+    """Downward-API annotations file: `key="value"` lines."""
+    ann = {}
+    if not os.path.exists(path):
+        return ann
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            k, _, v = line.partition("=")
+            ann[k] = v.strip().strip('"')
+    return ann
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("interface_name", help="module or module:Class of the user component")
+    ap.add_argument("api_type", nargs="?", default="REST", choices=["REST", "GRPC"])
+    ap.add_argument("--service-type", default=os.environ.get("SERVICE_TYPE", "MODEL"))
+    ap.add_argument("--parameters",
+                    default=os.environ.get("PREDICTIVE_UNIT_PARAMETERS", "[]"))
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "9000")))
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    from seldon_core_tpu.operator.local import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+    params = parse_parameters(args.parameters)
+    annotations = load_annotations()
+    mod, _, cls = args.interface_name.partition(":")
+    handle = load_component(mod, cls or None, params, service_type=args.service_type)
+    handle.name = os.environ.get("PREDICTIVE_UNIT_ID", handle.name)
+    metrics = EngineMetrics(MetricsRegistry(), deployment=handle.name)
+
+    async def serve():
+        from seldon_core_tpu.serving.rest import build_app, start_server
+
+        app = build_app(component=handle, metrics=metrics)
+        await start_server(app, args.host, args.port)
+        logger.info("component %s serving on :%d", handle.name, args.port)
+        print(f"component {handle.name!r} serving on {args.host}:{args.port}",
+              flush=True)
+        await asyncio.Event().wait()
+
+    if args.api_type == "GRPC":
+        from seldon_core_tpu.serving.grpc_server import serve_grpc_component
+
+        asyncio.run(serve_grpc_component(handle, args.host, args.port,
+                                         annotations=annotations))
+    else:
+        asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

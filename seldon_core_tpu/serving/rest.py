@@ -268,6 +268,9 @@ def build_app(
     engine=None, component=None, metrics: Optional[EngineMetrics] = None
 ) -> web.Application:
     app = web.Application(client_max_size=256 * 1024 * 1024)
+    if metrics is None and engine is not None and component is not None:
+        # one shared registry so the single /metrics endpoint serves both
+        metrics = EngineMetrics()
     if engine is not None:
         EngineServer(engine, metrics=metrics).register(app)
     if component is not None:

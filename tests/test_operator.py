@@ -1,0 +1,189 @@
+"""Control-plane tests: CRD parsing, validation, defaulting, manifest
+compilation with TPU placement, and the local runtime booting a full
+deployment (the analog of the reference operator's pure-function tests,
+SURVEY.md §4.1 SeldonDeploymentDefaulting/ValidationTest)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+from seldon_core_tpu.operator.compile import compile_deployment, defaulting
+from seldon_core_tpu.operator.local import LocalDeployment
+from seldon_core_tpu.operator.spec import (
+    DeploymentValidationError,
+    SeldonDeployment,
+    validate_deployment,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# reference layout: helm-charts/seldon-single-model/templates/model.json
+SINGLE_MODEL = {
+    "apiVersion": "machinelearning.seldon.io/v1alpha2",
+    "kind": "SeldonDeployment",
+    "metadata": {"name": "iris-dep", "labels": {"app": "seldon"}},
+    "spec": {
+        "name": "iris-dep",
+        "oauth_key": "key",
+        "oauth_secret": "secret",
+        "predictors": [
+            {
+                "name": "main",
+                "replicas": 1,
+                "graph": {
+                    "name": "classifier",
+                    "type": "MODEL",
+                    "parameters": [
+                        {
+                            "name": "model_class",
+                            "value": "seldon_core_tpu.models.iris:IrisClassifier",
+                            "type": "STRING",
+                        }
+                    ],
+                },
+            }
+        ],
+    },
+}
+
+
+def test_parse_reference_crd_layout():
+    dep = SeldonDeployment.from_dict(SINGLE_MODEL)
+    assert dep.name == "iris-dep"
+    assert dep.oauth_key == "key"
+    assert dep.predictors[0].graph.name == "classifier"
+    validate_deployment(dep)
+
+
+def test_validation_errors():
+    with pytest.raises(DeploymentValidationError):
+        validate_deployment(SeldonDeployment(name="", predictors=[]))
+    d = SeldonDeployment.from_dict(SINGLE_MODEL)
+    d.predictors = []
+    with pytest.raises(DeploymentValidationError):
+        validate_deployment(d)
+    # node with no impl/model_class/container/endpoint
+    bad = SeldonDeployment.from_dict(json.loads(json.dumps(SINGLE_MODEL)))
+    bad.predictors[0].graph.parameters = {}
+    with pytest.raises(DeploymentValidationError):
+        validate_deployment(bad)
+
+
+def test_defaulting_colocated_marks_local_endpoints():
+    dep = SeldonDeployment.from_dict(SINGLE_MODEL)
+    defaulting(dep)
+    assert dep.predictors[0].graph.endpoint.type == "LOCAL"
+
+
+def test_compile_colocated_tpu_pod():
+    d = json.loads(json.dumps(SINGLE_MODEL))
+    d["spec"]["predictors"][0]["annotations"] = {"seldon.io/tpu-chips": "8"}
+    manifests = compile_deployment(SeldonDeployment.from_dict(d))
+    deployments = [m for m in manifests if m["kind"] == "Deployment"]
+    assert len(deployments) == 1  # whole graph in ONE pod
+    pod = deployments[0]["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    eng = pod["containers"][0]
+    assert eng["resources"]["limits"]["google.com/tpu"] == "8"
+    env = {e["name"]: e.get("value") for e in eng["env"]}
+    assert "ENGINE_PREDICTOR" in env  # base64 graph handoff
+    assert eng["readinessProbe"]["httpGet"]["path"] == "/ready"
+    svc = [m for m in manifests if m["kind"] == "Service"]
+    assert svc and "getambassador.io/config" in svc[0]["metadata"]["annotations"]
+
+
+def test_compile_distributed_layout_matches_reference_shape():
+    d = json.loads(json.dumps(SINGLE_MODEL))
+    d["spec"]["annotations"] = {"seldon.io/colocate-graph": "false"}
+    manifests = compile_deployment(SeldonDeployment.from_dict(d))
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in manifests]
+    # engine deployment + component deployment + component svc + dep svc
+    assert ("Deployment", "iris-dep-main-engine") in kinds
+    assert ("Deployment", "iris-dep-main-classifier") in kinds
+    assert ("Service", "iris-dep-main-classifier") in kinds
+    comp = next(
+        m for m in manifests
+        if m["metadata"]["name"] == "iris-dep-main-classifier"
+        and m["kind"] == "Deployment"
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in comp["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["PREDICTIVE_UNIT_ID"] == "classifier"
+    assert "PREDICTIVE_UNIT_PARAMETERS" in env
+
+
+def test_multihost_slice_replication():
+    d = json.loads(json.dumps(SINGLE_MODEL))
+    d["spec"]["predictors"][0]["annotations"] = {
+        "seldon.io/tpu-chips": "16", "seldon.io/tpu-topology": "4x4",
+    }
+    manifests = compile_deployment(SeldonDeployment.from_dict(d))
+    dep = [m for m in manifests if m["kind"] == "Deployment"][0]
+    assert dep["spec"]["replicas"] == 2  # 16 chips / 8 per host
+
+
+def test_local_deployment_end_to_end():
+    local = LocalDeployment(SeldonDeployment.from_dict(SINGLE_MODEL))
+    out = run(
+        local.predict(
+            SeldonMessage.from_ndarray(
+                np.array([[5.0, 3.4, 1.5, 0.2]], np.float32)
+            )
+        )
+    )
+    assert out.status.status == "SUCCESS"
+    assert out.names == ["setosa", "versicolor", "virginica"]
+    assert np.asarray(out.host_data()).argmax() == 0  # setosa cluster
+
+
+def test_local_deployment_canary_traffic_split():
+    d = json.loads(json.dumps(SINGLE_MODEL))
+    main = d["spec"]["predictors"][0]
+    canary = json.loads(json.dumps(main))
+    canary["name"] = "canary"
+    canary["traffic"] = 0
+    d["spec"]["predictors"].append(canary)
+    local = LocalDeployment(SeldonDeployment.from_dict(d), seed=0)
+    picks = {local.pick().spec.name for _ in range(50)}
+    assert picks == {"main"}  # zero-traffic canary gets nothing
+
+
+def test_local_deployment_mab_with_feedback():
+    dep_dict = {
+        "metadata": {"name": "mab-dep"},
+        "spec": {
+            "name": "mab-dep",
+            "predictors": [
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "eg",
+                        "implementation": "EPSILON_GREEDY",
+                        "parameters": [
+                            {"name": "n_branches", "value": "2", "type": "INT"},
+                            {"name": "epsilon", "value": "0.0", "type": "FLOAT"},
+                        ],
+                        "children": [
+                            {"name": "a", "implementation": "SIMPLE_MODEL"},
+                            {"name": "b", "implementation": "SIMPLE_MODEL"},
+                        ],
+                    },
+                }
+            ],
+        },
+    }
+    local = LocalDeployment(SeldonDeployment.from_dict(dep_dict))
+    resp = SeldonMessage()
+    resp.meta.routing["eg"] = 1
+    for _ in range(3):
+        run(local.send_feedback(Feedback(response=resp, reward=1.0)))
+    out = run(local.predict(SeldonMessage.from_ndarray(np.zeros((1, 2)))))
+    assert out.meta.routing["eg"] == 1
