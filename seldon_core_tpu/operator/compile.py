@@ -152,6 +152,13 @@ def _common_labels(dep: SeldonDeployment, p: Optional[PredictorSpec]) -> dict:
     return labels
 
 
+def _engine_labels(dep: SeldonDeployment, p: Optional[PredictorSpec]) -> dict:
+    """Engine pods carry a role label so the deployment-wide Service and the
+    engine Deployment selector never match component pods (whose labels are a
+    superset of the common labels)."""
+    return {**_common_labels(dep, p), "seldon-role": "engine"}
+
+
 def _colocated_predictor(
     dep: SeldonDeployment, p: PredictorSpec, chips: int
 ) -> list[dict]:
@@ -161,6 +168,7 @@ def _colocated_predictor(
     k8s Deployment with TPU_WORKER_ID from the pod ordinal (jax.distributed
     mesh spans them over ICI/DCN)."""
     hosts = max(1, (chips + CHIPS_PER_HOST - 1) // CHIPS_PER_HOST) if chips else 1
+    workload_name = f"{dep.name}-{p.name}"
     container: dict[str, Any] = {
         "name": "engine",
         "image": ENGINE_IMAGE,
@@ -187,31 +195,37 @@ def _colocated_predictor(
             "cloud.google.com/gke-tpu-topology": topology,
         }
         if hosts > 1:
-            container["env"].append(
-                {
-                    "name": "TPU_WORKER_ID",
-                    "valueFrom": {
-                        "fieldRef": {
-                            "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
-                        }
+            # StatefulSet pods (k8s >= 1.28) carry the pod-index label that
+            # supplies the jax.distributed worker ordinal; Deployments never
+            # set it, so multi-host slices MUST be StatefulSets.
+            container["env"].extend(
+                [
+                    {
+                        "name": "TPU_WORKER_ID",
+                        "valueFrom": {
+                            "fieldRef": {
+                                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
+                            }
+                        },
                     },
-                }
+                    {"name": "NUM_TPU_HOSTS", "value": str(hosts)},
+                ]
             )
-    deployment = {
+    labels = _engine_labels(dep, p)
+    workload: dict[str, Any] = {
         "apiVersion": "apps/v1",
-        "kind": "Deployment",
+        "kind": "StatefulSet" if hosts > 1 else "Deployment",
         "metadata": {
-            "name": f"{dep.name}-{p.name}",
+            "name": workload_name,
             "namespace": dep.namespace,
-            "labels": _common_labels(dep, p),
+            "labels": labels,
         },
         "spec": {
             "replicas": p.replicas * hosts,
-            "strategy": {"rollingUpdate": {"maxUnavailable": "10%"}},
-            "selector": {"matchLabels": _common_labels(dep, p)},
+            "selector": {"matchLabels": labels},
             "template": {
                 "metadata": {
-                    "labels": _common_labels(dep, p),
+                    "labels": labels,
                     "annotations": {
                         "prometheus.io/scrape": "true",
                         "prometheus.io/port": str(METRICS_PORT),
@@ -222,7 +236,26 @@ def _colocated_predictor(
             },
         },
     }
-    return [deployment]
+    if hosts > 1:
+        workload["spec"]["serviceName"] = f"{workload_name}-hosts"
+        workload["spec"]["podManagementPolicy"] = "Parallel"
+        headless = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"{workload_name}-hosts",
+                "namespace": dep.namespace,
+                "labels": labels,
+            },
+            "spec": {
+                "clusterIP": "None",
+                "selector": labels,
+                "ports": [{"port": ENGINE_PORT, "name": "http"}],
+            },
+        }
+        return [workload, headless]
+    workload["spec"]["strategy"] = {"rollingUpdate": {"maxUnavailable": "10%"}}
+    return [workload]
 
 
 def _distributed_predictor(
@@ -237,13 +270,13 @@ def _distributed_predictor(
         "metadata": {
             "name": f"{dep.name}-{p.name}-engine",
             "namespace": dep.namespace,
-            "labels": _common_labels(dep, p),
+            "labels": _engine_labels(dep, p),
         },
         "spec": {
             "replicas": p.replicas,
-            "selector": {"matchLabels": _common_labels(dep, p)},
+            "selector": {"matchLabels": _engine_labels(dep, p)},
             "template": {
-                "metadata": {"labels": _common_labels(dep, p)},
+                "metadata": {"labels": _engine_labels(dep, p)},
                 "spec": {
                     "containers": [
                         {
@@ -325,6 +358,9 @@ def _deployment_service(dep: SeldonDeployment) -> dict:
     """Deployment-wide Service fronting all predictors (traffic split by
     replica ratio, reference ``:738-764``) + Ambassador-style annotation."""
     labels = {"seldon-deployment-id": dep.name}
+    # select only engine pods — component pods share the deployment-id label
+    # but must not receive north-bound traffic
+    selector = {**labels, "seldon-role": "engine"}
     return {
         "apiVersion": "v1",
         "kind": "Service",
@@ -345,7 +381,7 @@ def _deployment_service(dep: SeldonDeployment) -> dict:
             },
         },
         "spec": {
-            "selector": labels,
+            "selector": selector,
             "ports": [
                 {"port": ENGINE_PORT, "targetPort": ENGINE_PORT, "name": "http"},
                 {"port": GRPC_PORT, "targetPort": GRPC_PORT, "name": "grpc"},

@@ -59,6 +59,13 @@ def resolve_component(
             return BatchedModel(handle, _batcher_config(ann), metrics=metrics)
         return handle
     if unit.endpoint.service_host and unit.endpoint.type != "LOCAL":
+        if unit.endpoint.type == "GRPC":
+            from seldon_core_tpu.serving.grpc_api import GrpcComponentClient
+
+            return GrpcComponentClient(
+                f"{unit.endpoint.service_host}:{unit.endpoint.service_port or 5000}",
+                methods=unit.methods,
+            )
         from seldon_core_tpu.serving.client import RemoteComponent
 
         scheme_port = unit.endpoint.service_port or 8000
@@ -190,6 +197,12 @@ def engine_main(argv: Optional[list] = None) -> None:
     ap.add_argument("--graph", help="path to SeldonDeployment or graph JSON")
     ap.add_argument("--port", type=int,
                     default=int(os.environ.get("ENGINE_SERVER_PORT", "8000")))
+    ap.add_argument("--grpc-port", type=int,
+                    default=int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "5000")),
+                    help="Seldon gRPC service port (0 disables); env name "
+                         "matches the operator-injected ENGINE_SERVER_GRPC_PORT"
+                         " (compile.py); reference engine gRPC is port 5000 "
+                         "(SeldonGrpcServer.java:37)")
     ap.add_argument("--host", default="0.0.0.0")
     args = ap.parse_args(argv)
     _honor_jax_platforms_env()
@@ -213,6 +226,19 @@ def engine_main(argv: Optional[list] = None) -> None:
 
         app = build_app(engine=local, metrics=local.metrics)
         await start_server(app, args.host, args.port)
+        if args.grpc_port:
+            from seldon_core_tpu.serving.grpc_api import (
+                GrpcServer,
+                seldon_service_handler,
+            )
+
+            gserver = GrpcServer(
+                [seldon_service_handler(local)], port=args.grpc_port,
+                host=args.host,
+            )
+            await gserver.start()
+            print(f"gRPC Seldon service on {args.host}:{gserver.port}",
+                  flush=True)
         print(f"serving deployment {dep.name!r} on {args.host}:{args.port}",
               flush=True)
         await asyncio.Event().wait()

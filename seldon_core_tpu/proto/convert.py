@@ -1,0 +1,268 @@
+"""Converters: dataclass data model (messages.py) ↔ wire protobuf.
+
+The proto mirrors the reference's ``prediction.proto`` message shapes (so the
+JSON produced by ``google.protobuf.json_format`` on a reference client matches
+our REST wire format) while adding the dtype-rich ``binTensor`` branch.
+
+Encoding policy (mirrors ``SeldonMessage.encoding`` on the JSON path):
+- ``ndarray`` → ``google.protobuf.ListValue`` nested lists,
+- ``tensor``  → reference-parity double LegacyTensor,
+- ``binTensor`` (default for non-float64 arrays) → raw buffer + dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+from google.protobuf import struct_pb2
+
+from seldon_core_tpu.messages import (
+    Feedback,
+    Meta,
+    Metric,
+    MetricType,
+    SeldonMessage,
+    Status,
+)
+from seldon_core_tpu.messages import _np_dtype  # dtype-name resolution incl. bfloat16
+from seldon_core_tpu.proto import prediction_pb2 as pb
+
+__all__ = [
+    "message_to_proto",
+    "message_from_proto",
+    "feedback_to_proto",
+    "feedback_from_proto",
+]
+
+_METRIC_TYPE_TO_PB = {
+    MetricType.COUNTER: pb.Metric.COUNTER,
+    MetricType.GAUGE: pb.Metric.GAUGE,
+    MetricType.TIMER: pb.Metric.TIMER,
+}
+_METRIC_TYPE_FROM_PB = {v: k for k, v in _METRIC_TYPE_TO_PB.items()}
+
+
+# ---------------------------------------------------------------------------
+# meta / status
+# ---------------------------------------------------------------------------
+
+
+def _meta_to_proto(meta: Meta, out: pb.Meta) -> None:
+    out.puid = meta.puid
+    for k, v in meta.tags.items():
+        out.tags[k].CopyFrom(_value_to_pb(v))
+    for k, v in meta.routing.items():
+        out.routing[k] = int(v)
+    for k, v in meta.request_path.items():
+        out.requestPath[k] = str(v)
+    for m in meta.metrics:
+        pm = out.metrics.add()
+        pm.key = m.key
+        pm.type = _METRIC_TYPE_TO_PB[m.type]
+        pm.value = float(m.value)
+        for tk, tv in m.tags.items():
+            pm.tags[tk] = str(tv)
+
+
+def _meta_from_proto(p: pb.Meta) -> Meta:
+    return Meta(
+        puid=p.puid,
+        tags={k: _value_from_pb(v) for k, v in p.tags.items()},
+        routing={k: int(v) for k, v in p.routing.items()},
+        request_path=dict(p.requestPath),
+        metrics=[
+            Metric(
+                key=m.key,
+                type=_METRIC_TYPE_FROM_PB.get(m.type, MetricType.COUNTER),
+                value=float(m.value),
+                tags=dict(m.tags),
+            )
+            for m in p.metrics
+        ],
+    )
+
+
+def _status_to_proto(s: Status, out: pb.Status) -> None:
+    out.code = s.code
+    out.info = s.info
+    out.reason = s.reason
+    out.status = pb.Status.FAILURE if s.status == "FAILURE" else pb.Status.SUCCESS
+
+
+def _status_from_proto(p: pb.Status) -> Status:
+    return Status(
+        code=p.code,
+        info=p.info,
+        reason=p.reason,
+        status="FAILURE" if p.status == pb.Status.FAILURE else "SUCCESS",
+    )
+
+
+# ---------------------------------------------------------------------------
+# google.protobuf.Value helpers
+# ---------------------------------------------------------------------------
+
+
+def _value_to_pb(v: Any) -> struct_pb2.Value:
+    out = struct_pb2.Value()
+    if v is None:
+        out.null_value = 0
+    elif isinstance(v, bool):
+        out.bool_value = v
+    elif isinstance(v, (int, float, np.integer, np.floating)):
+        out.number_value = float(v)
+    elif isinstance(v, str):
+        out.string_value = v
+    elif isinstance(v, (list, tuple)):
+        out.list_value.values.extend(_value_to_pb(x) for x in v)
+    elif isinstance(v, dict):
+        for k, x in v.items():
+            out.struct_value.fields[str(k)].CopyFrom(_value_to_pb(x))
+    else:
+        out.string_value = str(v)
+    return out
+
+
+def _value_from_pb(v: struct_pb2.Value) -> Any:
+    kind = v.WhichOneof("kind")
+    if kind == "null_value":
+        return None
+    if kind == "bool_value":
+        return v.bool_value
+    if kind == "number_value":
+        # protobuf Struct numbers are doubles; keep them as floats so a
+        # value's type never silently changes between REST and gRPC paths.
+        return v.number_value
+    if kind == "string_value":
+        return v.string_value
+    if kind == "list_value":
+        return [_value_from_pb(x) for x in v.list_value.values]
+    if kind == "struct_value":
+        return {k: _value_from_pb(x) for k, x in v.struct_value.fields.items()}
+    return None
+
+
+def _nested_to_listvalue(arr: np.ndarray) -> struct_pb2.ListValue:
+    out = struct_pb2.ListValue()
+    _fill_listvalue(out, arr.tolist())
+    return out
+
+
+def _fill_listvalue(lv: struct_pb2.ListValue, rows: Sequence) -> None:
+    for item in rows:
+        v = lv.values.add()
+        if isinstance(item, list):
+            _fill_listvalue(v.list_value, item)
+        elif isinstance(item, bool):
+            v.bool_value = item
+        elif isinstance(item, (int, float)):
+            v.number_value = float(item)
+        elif isinstance(item, str):
+            v.string_value = item
+        else:
+            v.null_value = 0
+
+
+def _listvalue_to_ndarray(lv: struct_pb2.ListValue) -> np.ndarray:
+    return np.asarray([_value_from_pb(v) for v in lv.values])
+
+
+# ---------------------------------------------------------------------------
+# SeldonMessage
+# ---------------------------------------------------------------------------
+
+
+def message_to_proto(
+    msg: SeldonMessage, out: Optional[pb.SeldonMessage] = None
+) -> pb.SeldonMessage:
+    p = out if out is not None else pb.SeldonMessage()
+    if msg.status is not None:
+        _status_to_proto(msg.status, p.status)
+    md = msg.meta
+    if md.puid or md.tags or md.routing or md.request_path or md.metrics:
+        _meta_to_proto(md, p.meta)
+    if msg.data is not None:
+        arr = msg.host_data()
+        p.data.names.extend(msg.names)
+        if msg.encoding == "tensor":
+            p.data.tensor.shape.extend(int(s) for s in arr.shape)
+            p.data.tensor.values.extend(arr.astype(np.float64).ravel().tolist())
+        elif msg.encoding == "ndarray":
+            p.data.ndarray.CopyFrom(_nested_to_listvalue(arr))
+        else:  # binTensor — the dtype-rich default
+            buf = np.ascontiguousarray(arr)
+            p.data.binTensor.dtype = buf.dtype.name
+            p.data.binTensor.shape.extend(int(s) for s in buf.shape)
+            p.data.binTensor.raw = buf.tobytes()
+    elif msg.bin_data is not None:
+        p.binData = msg.bin_data
+    elif msg.str_data is not None:
+        p.strData = msg.str_data
+    elif msg.json_data is not None:
+        p.jsonData.CopyFrom(_value_to_pb(msg.json_data))
+    return p
+
+
+def message_from_proto(p: pb.SeldonMessage) -> SeldonMessage:
+    msg = SeldonMessage()
+    if p.HasField("status"):
+        msg.status = _status_from_proto(p.status)
+    if p.HasField("meta"):
+        msg.meta = _meta_from_proto(p.meta)
+    which = p.WhichOneof("data_oneof")
+    if which == "data":
+        msg.names = list(p.data.names)
+        dwhich = p.data.WhichOneof("data_oneof")
+        if dwhich == "tensor":
+            t = p.data.tensor
+            msg.data = np.asarray(t.values, dtype=np.float64).reshape(list(t.shape))
+            msg.encoding = "tensor"
+        elif dwhich == "ndarray":
+            msg.data = _listvalue_to_ndarray(p.data.ndarray)
+            msg.encoding = "ndarray"
+        elif dwhich == "binTensor":
+            t = p.data.binTensor
+            dtype = _np_dtype(t.dtype or "float32")
+            msg.data = np.frombuffer(t.raw, dtype=dtype).reshape(list(t.shape))
+            msg.encoding = "binTensor"
+        elif dwhich == "device":
+            raise ValueError(
+                "DeviceTensorRef crossed a transport boundary; the sender "
+                "must downgrade device-resident payloads to binTensor"
+            )
+    elif which == "binData":
+        msg.bin_data = p.binData
+    elif which == "strData":
+        msg.str_data = p.strData
+    elif which == "jsonData":
+        msg.json_data = _value_from_pb(p.jsonData)
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# Feedback
+# ---------------------------------------------------------------------------
+
+
+def feedback_to_proto(
+    fb: Feedback, out: Optional[pb.Feedback] = None
+) -> pb.Feedback:
+    p = out if out is not None else pb.Feedback()
+    if fb.request is not None:
+        message_to_proto(fb.request, p.request)
+    if fb.response is not None:
+        message_to_proto(fb.response, p.response)
+    if fb.truth is not None:
+        message_to_proto(fb.truth, p.truth)
+    p.reward = float(fb.reward)
+    return p
+
+
+def feedback_from_proto(p: pb.Feedback) -> Feedback:
+    return Feedback(
+        request=message_from_proto(p.request) if p.HasField("request") else None,
+        response=message_from_proto(p.response) if p.HasField("response") else None,
+        reward=float(p.reward),
+        truth=message_from_proto(p.truth) if p.HasField("truth") else None,
+    )

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate prediction_pb2.py.  The gRPC service stubs are hand-written in
+# grpc_api.py (this image has protoc but not the grpc python codegen plugin).
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=. prediction.proto
+# rewrite the import so the module lives inside the package
+sed -i 's/^import prediction_pb2/from seldon_core_tpu.proto import prediction_pb2/' *_pb2.py 2>/dev/null || true
+echo "generated prediction_pb2.py"

@@ -1,0 +1,464 @@
+"""gRPC API: per-role internal services + external ``Seldon`` service.
+
+Reference surface (``/root/reference/proto/prediction.proto:89-123``):
+``Model{Predict,SendFeedback}``, ``Router{Route,SendFeedback}``,
+``Transformer{TransformInput}``, ``OutputTransformer{TransformOutput}``,
+``Combiner{Aggregate}``, ``Generic`` (all five), and external
+``Seldon{Predict,SendFeedback}`` (``engine/.../grpc/SeldonGrpcServer.java:37-127``,
+``api-frontend/.../grpc/SeldonGrpcServer.java``).
+
+The service/method stubs are hand-written (this image has no grpc python
+codegen plugin): each method is registered as a ``unary_unary`` handler with
+protobuf (de)serializers, and clients build ``channel.unary_unary`` callables
+for the same paths.  Wire-compatible with reference clients/servers.
+
+Unlike the reference's southbound client — which opens a NEW ManagedChannel
+per call (``engine/.../service/InternalPredictionService.java:317-320``, a
+noted hot-spot) — ``GrpcComponentClient`` holds one persistent aio channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+from typing import Any, Optional, Sequence
+
+import grpc
+import grpc.aio
+import numpy as np
+
+from seldon_core_tpu.messages import Feedback, SeldonMessage, Status
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.convert import (
+    feedback_from_proto,
+    feedback_to_proto,
+    message_from_proto,
+    message_to_proto,
+)
+
+logger = logging.getLogger(__name__)
+
+_PKG = "seldon.tpu"
+
+# service → method → (request proto class, response proto class)
+SERVICE_METHODS: dict[str, dict[str, tuple[Any, Any]]] = {
+    "Model": {
+        "Predict": (pb.SeldonMessage, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+    "Router": {
+        "Route": (pb.SeldonMessage, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+    "Transformer": {
+        "TransformInput": (pb.SeldonMessage, pb.SeldonMessage),
+    },
+    "OutputTransformer": {
+        "TransformOutput": (pb.SeldonMessage, pb.SeldonMessage),
+    },
+    "Combiner": {
+        "Aggregate": (pb.SeldonMessageList, pb.SeldonMessage),
+    },
+    "Generic": {
+        "TransformInput": (pb.SeldonMessage, pb.SeldonMessage),
+        "TransformOutput": (pb.SeldonMessage, pb.SeldonMessage),
+        "Route": (pb.SeldonMessage, pb.SeldonMessage),
+        "Aggregate": (pb.SeldonMessageList, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+    "Seldon": {
+        "Predict": (pb.SeldonMessage, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+}
+
+# gRPC channel/server options for big tensor payloads; the reference exposes
+# this as the grpc-max-message-size annotation (docs/grpc_max_message_size.md).
+DEFAULT_MAX_MESSAGE_SIZE = 100 * 1024 * 1024
+
+
+def grpc_options(max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE) -> list:
+    return [
+        ("grpc.max_send_message_length", max_message_size),
+        ("grpc.max_receive_message_length", max_message_size),
+    ]
+
+
+async def _maybe_await(x):
+    if inspect.isawaitable(x):
+        return await x
+    return x
+
+
+def _branch_message(branch: int) -> SeldonMessage:
+    """Router wire convention: branch int as a 1x1 ndarray
+    (reference ``wrappers/python/router_microservice.py:20-45``)."""
+    return SeldonMessage(data=np.asarray([[int(branch)]]), encoding="ndarray")
+
+
+def _extract_branch(msg: SeldonMessage) -> int:
+    arr = msg.host_data()
+    if arr is None:
+        return -1
+    return int(np.asarray(arr).ravel()[0])
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+
+class _ComponentRpc:
+    """Adapts a ComponentHandle (runtime/component.py) to rpc semantics."""
+
+    def __init__(self, handle: Any):
+        self.handle = handle
+
+    async def call(self, method: str, request_pb):
+        h = self.handle
+        try:
+            if method == "Predict":
+                out = await _maybe_await(h.predict(message_from_proto(request_pb)))
+            elif method == "TransformInput":
+                out = await _maybe_await(
+                    h.transform_input(message_from_proto(request_pb))
+                )
+            elif method == "TransformOutput":
+                out = await _maybe_await(
+                    h.transform_output(message_from_proto(request_pb))
+                )
+            elif method == "Route":
+                branch = await _maybe_await(h.route(message_from_proto(request_pb)))
+                out = _branch_message(int(branch))
+            elif method == "Aggregate":
+                msgs = [message_from_proto(m) for m in request_pb.seldonMessages]
+                out = await _maybe_await(h.aggregate(msgs))
+            elif method == "SendFeedback":
+                fb = feedback_from_proto(request_pb)
+                out = await _maybe_await(h.send_feedback(fb))
+                if out is None:
+                    out = SeldonMessage(status=Status())
+            else:
+                raise ValueError(f"unknown method {method}")
+        except Exception as e:  # component error → wire FAILURE status
+            logger.exception("gRPC component method %s failed", method)
+            code = getattr(e, "status_code", 500)
+            out = SeldonMessage(
+                status=Status.failure(code, f"{type(e).__name__}: {e}", "INTERNAL")
+            )
+        return message_to_proto(out)
+
+
+def _unary_handler(rpc: Any, method: str, req_cls, resp_cls):
+    async def handler(request_pb, context):
+        return await rpc.call(method, request_pb)
+
+    return grpc.unary_unary_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+def component_service_handlers(handle: Any, service_type: str = "") -> list:
+    """Generic handlers for a component: registers the role-specific service
+    (from ``service_type``) plus ``Generic``, exposing only the methods the
+    component actually implements (mirrors the wrapper's service-type dispatch,
+    ``wrappers/python/microservice.py:218-263``)."""
+    rpc = _ComponentRpc(handle)
+    role_by_type = {
+        "MODEL": "Model",
+        "ROUTER": "Router",
+        "TRANSFORMER": "Transformer",
+        "OUTPUT_TRANSFORMER": "OutputTransformer",
+        "COMBINER": "Combiner",
+        "OUTLIER_DETECTOR": "Transformer",
+    }
+    method_to_attr = {
+        "Predict": "predict",
+        "TransformInput": "transform_input",
+        "TransformOutput": "transform_output",
+        "Route": "route",
+        "Aggregate": "aggregate",
+        "SendFeedback": "send_feedback",
+    }
+    has = getattr(handle, "has", None)
+
+    def supported(method: str) -> bool:
+        attr = method_to_attr[method]
+        if has is not None:
+            return bool(has(attr))
+        return callable(getattr(handle, attr, None))
+
+    services = {"Generic"}
+    role = role_by_type.get(service_type.upper())
+    if role:
+        services.add(role)
+    out = []
+    for svc in sorted(services):
+        methods = {
+            m: _unary_handler(rpc, m, req, resp)
+            for m, (req, resp) in SERVICE_METHODS[svc].items()
+            if supported(m)
+        }
+        if methods:
+            out.append(
+                grpc.method_handlers_generic_handler(f"{_PKG}.{svc}", methods)
+            )
+    return out
+
+
+def seldon_service_handler(deployment: Any, auth: Optional[Any] = None) -> Any:
+    """External ``Seldon`` service over an engine/deployment object with async
+    ``predict(msg)`` / ``send_feedback(fb)``.
+
+    ``auth``: optional callable ``(metadata_dict) -> principal_or_None``;
+    mirrors the apife ``oauth_token`` metadata interceptor
+    (``api-frontend/.../grpc/HeaderServerInterceptor.java:37-53``).
+    """
+
+    async def _check(context) -> bool:
+        if auth is None:
+            return True
+        md = {k: v for k, v in (context.invocation_metadata() or [])}
+        principal = auth(md)
+        if principal is None:
+            await context.abort(
+                grpc.StatusCode.UNAUTHENTICATED, "invalid or missing oauth_token"
+            )
+            return False
+        return True
+
+    async def predict(request_pb, context):
+        if not await _check(context):
+            return pb.SeldonMessage()
+        out = await deployment.predict(message_from_proto(request_pb))
+        return message_to_proto(out)
+
+    async def send_feedback(request_pb, context):
+        if not await _check(context):
+            return pb.SeldonMessage()
+        out = await deployment.send_feedback(feedback_from_proto(request_pb))
+        return message_to_proto(out)
+
+    return grpc.method_handlers_generic_handler(
+        f"{_PKG}.Seldon",
+        {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict,
+                request_deserializer=pb.SeldonMessage.FromString,
+                response_serializer=pb.SeldonMessage.SerializeToString,
+            ),
+            "SendFeedback": grpc.unary_unary_rpc_method_handler(
+                send_feedback,
+                request_deserializer=pb.Feedback.FromString,
+                response_serializer=pb.SeldonMessage.SerializeToString,
+            ),
+        },
+    )
+
+
+class GrpcServer:
+    """Thin aio server wrapper used by both the microservice CLI (component
+    mode) and the engine/gateway (Seldon mode)."""
+
+    def __init__(
+        self,
+        handlers: Sequence[Any],
+        port: int = 5000,
+        host: str = "0.0.0.0",
+        max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE,
+    ):
+        self.server = grpc.aio.server(options=grpc_options(max_message_size))
+        for h in handlers:
+            self.server.add_generic_rpc_handlers((h,))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+
+    async def start(self) -> int:
+        await self.server.start()
+        return self.port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        await self.server.stop(grace)
+
+    async def wait(self) -> None:
+        await self.server.wait_for_termination()
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+class _Stub:
+    """Hand-rolled stub: unary-unary callables per method path."""
+
+    def __init__(self, channel: grpc.aio.Channel, service: str):
+        self._calls = {}
+        for method, (req_cls, resp_cls) in SERVICE_METHODS[service].items():
+            self._calls[method] = channel.unary_unary(
+                f"/{_PKG}.{service}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+
+    def __getattr__(self, item):
+        try:
+            return self._calls[item]
+        except KeyError:
+            raise AttributeError(item)
+
+
+class GrpcComponentClient:
+    """Southbound engine→component client over gRPC.
+
+    Same async surface as the REST ``RemoteComponent`` (serving/client.py) so
+    the engine resolver can pick either per node (``Endpoint.type`` in the
+    reference CRD, ``proto/seldon_deployment.proto:93-100``).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        methods: Sequence[str] = (),
+        timeout_s: float = 30.0,
+        max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE,
+    ):
+        self._channel = grpc.aio.insecure_channel(
+            target, options=grpc_options(max_message_size)
+        )
+        self._stubs: dict[str, _Stub] = {}
+        self._methods = set(methods) or {
+            "predict",
+            "route",
+            "aggregate",
+            "transform_input",
+            "transform_output",
+            "send_feedback",
+        }
+        self.timeout = timeout_s
+
+    def has(self, method: str) -> bool:
+        return method in self._methods
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def _unary(self, service: str, method: str, req_pb):
+        stub = self._stubs.get(service)
+        if stub is None:
+            stub = self._stubs[service] = _Stub(self._channel, service)
+        resp = await getattr(stub, method)(req_pb, timeout=self.timeout)
+        return resp
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        resp = await self._unary("Model", "Predict", message_to_proto(msg))
+        return self._ok(message_from_proto(resp))
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        resp = await self._unary(
+            "Transformer", "TransformInput", message_to_proto(msg)
+        )
+        return self._ok(message_from_proto(resp))
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        resp = await self._unary(
+            "OutputTransformer", "TransformOutput", message_to_proto(msg)
+        )
+        return self._ok(message_from_proto(resp))
+
+    async def route(self, msg: SeldonMessage) -> int:
+        resp = await self._unary("Router", "Route", message_to_proto(msg))
+        return _extract_branch(self._ok(message_from_proto(resp)))
+
+    async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        lst = pb.SeldonMessageList()
+        for m in msgs:
+            message_to_proto(m, lst.seldonMessages.add())
+        resp = await self._unary("Combiner", "Aggregate", lst)
+        return self._ok(message_from_proto(resp))
+
+    async def send_feedback(self, fb: Feedback) -> Optional[SeldonMessage]:
+        # Generic is registered for every component role (unlike Model),
+        # so feedback reaches routers/combiners too.
+        resp = await self._unary("Generic", "SendFeedback", feedback_to_proto(fb))
+        return message_from_proto(resp)
+
+    @staticmethod
+    def _ok(msg: SeldonMessage) -> SeldonMessage:
+        if msg.status is not None and msg.status.status == "FAILURE":
+            from seldon_core_tpu.runtime.component import SeldonComponentError
+
+            raise SeldonComponentError(
+                msg.status.info, status_code=msg.status.code or 500,
+                reason=msg.status.reason,
+            )
+        return msg
+
+
+async def serve_grpc_component(
+    handle: Any,
+    host: str = "0.0.0.0",
+    port: int = 9000,
+    annotations: Optional[dict] = None,
+) -> None:
+    """Microservice GRPC mode (reference ``model_microservice.py:113-167``).
+
+    Honors the reference's grpc-max-message-size annotation
+    (``docs/grpc_max_message_size.md``)."""
+    ann = annotations or {}
+    max_size = int(
+        ann.get("seldon.io/grpc-max-message-size", DEFAULT_MAX_MESSAGE_SIZE)
+    )
+    server = GrpcServer(
+        component_service_handlers(handle, getattr(handle, "service_type", "")),
+        port=port,
+        host=host,
+        max_message_size=max_size,
+    )
+    bound = await server.start()
+    logger.info("gRPC component %s serving on :%d", getattr(handle, "name", "?"), bound)
+    print(f"component {getattr(handle, 'name', '?')!r} serving gRPC on "
+          f"{host}:{bound}", flush=True)
+    await server.wait()
+
+
+class SeldonGrpcClient:
+    """External client for the ``Seldon`` service (gateway or engine).
+
+    ``token``: OAuth token sent as ``oauth_token`` metadata, matching the
+    reference client convention (``HeaderServerInterceptor.java:37-53``).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        token: str = "",
+        timeout_s: float = 30.0,
+        max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE,
+    ):
+        self._channel = grpc.aio.insecure_channel(
+            target, options=grpc_options(max_message_size)
+        )
+        self._stub = _Stub(self._channel, "Seldon")
+        self.token = token
+        self.timeout = timeout_s
+
+    def _metadata(self):
+        return (("oauth_token", self.token),) if self.token else ()
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        resp = await self._stub.Predict(
+            message_to_proto(msg), timeout=self.timeout, metadata=self._metadata()
+        )
+        return message_from_proto(resp)
+
+    async def send_feedback(self, fb: Feedback) -> SeldonMessage:
+        resp = await self._stub.SendFeedback(
+            feedback_to_proto(fb), timeout=self.timeout, metadata=self._metadata()
+        )
+        return message_from_proto(resp)

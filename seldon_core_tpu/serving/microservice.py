@@ -88,7 +88,7 @@ def main(argv: Optional[list] = None) -> None:
         await asyncio.Event().wait()
 
     if args.api_type == "GRPC":
-        from seldon_core_tpu.serving.grpc_server import serve_grpc_component
+        from seldon_core_tpu.serving.grpc_api import serve_grpc_component
 
         asyncio.run(serve_grpc_component(handle, args.host, args.port,
                                          annotations=annotations))

@@ -38,7 +38,7 @@ def pytest_collection_modifyitems(items):
 
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
-    fn = pyfuncitem.function
+    fn = pyfuncitem.obj  # bound method for class-based tests
     if inspect.iscoroutinefunction(fn):
         kwargs = {
             name: pyfuncitem.funcargs[name]

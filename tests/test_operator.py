@@ -126,8 +126,20 @@ def test_multihost_slice_replication():
         "seldon.io/tpu-chips": "16", "seldon.io/tpu-topology": "4x4",
     }
     manifests = compile_deployment(SeldonDeployment.from_dict(d))
-    dep = [m for m in manifests if m["kind"] == "Deployment"][0]
-    assert dep["spec"]["replicas"] == 2  # 16 chips / 8 per host
+    # multi-host slices need pod ordinals for jax.distributed worker ids →
+    # StatefulSet (Deployments never set the pod-index label) + headless svc
+    sts = [m for m in manifests if m["kind"] == "StatefulSet"][0]
+    assert sts["spec"]["replicas"] == 2  # 16 chips / 8 per host
+    env = sts["spec"]["template"]["spec"]["containers"][0]["env"]
+    by_name = {e["name"]: e for e in env}
+    assert "TPU_WORKER_ID" in by_name and "NUM_TPU_HOSTS" in by_name
+    assert by_name["NUM_TPU_HOSTS"]["value"] == "2"
+    headless = [
+        m for m in manifests
+        if m["kind"] == "Service" and m["spec"].get("clusterIP") == "None"
+    ]
+    assert len(headless) == 1
+    assert sts["spec"]["serviceName"] == headless[0]["metadata"]["name"]
 
 
 def test_local_deployment_end_to_end():
