@@ -1,0 +1,292 @@
+"""Gateway server: REST + gRPC front door with OAuth and firehose.
+
+Reference call path (``api-frontend/.../RestClientController.java:128-177``):
+resolve OAuth principal → look up deployment → forward the RAW json string to
+the engine's k8s Service (no parse on the hot path,
+``service/InternalPredictionService.java:112-185``) → fire-and-forget
+firehose publish → metrics.  The gRPC server forwards to the engine's gRPC
+port with a channel cache per deployment
+(``api-frontend/.../grpc/SeldonGrpcServer.java``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from seldon_core_tpu.gateway.firehose import NullFirehose, make_firehose
+from seldon_core_tpu.gateway.oauth import OAuthProvider, TokenStore
+from seldon_core_tpu.gateway.store import DeploymentStore
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+WATCH_INTERVAL_S = 5.0  # reference @Scheduled(fixedDelay=5000)
+
+
+class Gateway:
+    def __init__(
+        self,
+        store: DeploymentStore,
+        firehose=None,
+        token_spill: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.store = store
+        self.oauth = OAuthProvider(store, TokenStore(token_spill))
+        self.firehose = firehose or NullFirehose()
+        self.registry = registry or MetricsRegistry()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._grpc_channels: dict[str, object] = {}
+
+    # ------------------------------------------------------------------
+    # shared forwarding client (pooled, apife parity: 150 conns)
+    # ------------------------------------------------------------------
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=150),
+                timeout=aiohttp.ClientTimeout(total=30.0),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        for ch in self._grpc_channels.values():
+            await ch.close()
+        self._grpc_channels.clear()
+
+    # ------------------------------------------------------------------
+    # REST app
+    # ------------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_post("/oauth/token", self._handle_token)
+        app.router.add_post("/api/v0.1/predictions", self._handle_predict)
+        app.router.add_post("/api/v0.1/feedback", self._handle_feedback)
+        app.router.add_get("/ready", self._handle_ready)
+        app.router.add_get("/live", self._handle_ready)
+        app.router.add_get("/metrics", self._handle_metrics)
+        return app
+
+    async def _handle_token(self, request: web.Request) -> web.Response:
+        form = dict(await request.post())
+        status, body = self.oauth.token_request(
+            request.headers.get("Authorization"), form
+        )
+        return web.json_response(body, status=status)
+
+    def _principal(self, request: web.Request) -> Optional[str]:
+        return self.oauth.principal_for_bearer(request.headers.get("Authorization"))
+
+    async def _forward(
+        self, request: web.Request, path: str
+    ) -> web.Response:
+        t0 = time.perf_counter()
+        principal = self._principal(request)
+        if principal is None:
+            return web.json_response(
+                {"error": "invalid_token",
+                 "error_description": "missing or expired bearer token"},
+                status=401,
+            )
+        rec = self.store.by_oauth_key(principal)
+        if rec is None or not rec.engine_url:
+            return web.json_response(
+                {"status": {"code": 404, "status": "FAILURE",
+                            "info": f"no deployment for client {principal}"}},
+                status=404,
+            )
+        body = await request.read()
+        sess = await self.session()
+        try:
+            async with sess.post(
+                rec.engine_url.rstrip("/") + path,
+                data=body,
+                headers={"Content-Type": request.headers.get(
+                    "Content-Type", "application/json")},
+            ) as resp:
+                out_body = await resp.read()
+                out_status = resp.status
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"status": {"code": 503, "status": "FAILURE",
+                            "info": f"engine unreachable: {e}"}},
+                status=503,
+            )
+        if path.endswith("/predictions") and not isinstance(
+            self.firehose, NullFirehose
+        ):
+            # parse only for the firehose, never on the forward path, and
+            # publish off the event loop — fire-and-forget like the
+            # reference's 20ms-max-block Kafka send
+            # (apife RestClientController.java:165)
+            def _publish(principal=principal, body=body, out_body=out_body):
+                try:
+                    self.firehose.publish(
+                        principal, json.loads(body), json.loads(out_body)
+                    )
+                except Exception:
+                    logger.exception("firehose publish failed")
+
+            asyncio.get_running_loop().run_in_executor(None, _publish)
+        # apife metric parity: seldon_api_server_ingress_* timer tagged by
+        # deployment (metrics/AuthorizedWebMvcTagsProvider.java)
+        self.registry.observe(
+            "seldon_api_server_ingress_seconds",
+            time.perf_counter() - t0,
+            {"deployment": rec.name, "path": path},
+        )
+        return web.Response(
+            body=out_body, status=out_status, content_type="application/json"
+        )
+
+    async def _handle_predict(self, request: web.Request) -> web.Response:
+        return await self._forward(request, "/api/v0.1/predictions")
+
+    async def _handle_feedback(self, request: web.Request) -> web.Response:
+        return await self._forward(request, "/api/v0.1/feedback")
+
+    async def _handle_ready(self, request: web.Request) -> web.Response:
+        return web.Response(text="ready")
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.registry.render(), content_type="text/plain"
+        )
+
+    # ------------------------------------------------------------------
+    # gRPC front (Seldon service, forwards to engine gRPC)
+    # ------------------------------------------------------------------
+    def grpc_handler(self):
+        import grpc
+        import grpc.aio
+
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.serving.grpc_api import _PKG, _Stub, grpc_options
+
+        def _target(md: dict) -> Optional[str]:
+            principal = self.oauth.principal_for_token(md.get("oauth_token"))
+            if principal is None:
+                return None
+            rec = self.store.by_oauth_key(principal)
+            if rec is None or not rec.engine_grpc:
+                return None
+            return rec.engine_grpc
+
+        stubs: dict[str, _Stub] = {}
+
+        def _stub(target: str) -> _Stub:
+            # one channel+stub per engine target (reference apife keeps a
+            # channel cache per deployment, grpc/SeldonGrpcServer.java)
+            stub = stubs.get(target)
+            if stub is None:
+                ch = grpc.aio.insecure_channel(target, options=grpc_options())
+                self._grpc_channels[target] = ch
+                stub = stubs[target] = _Stub(ch, "Seldon")
+            return stub
+
+        async def _forward_unary(method, resp_cls, request_pb, context):
+            md = {k: v for k, v in (context.invocation_metadata() or [])}
+            target = _target(md)
+            if target is None:
+                await context.abort(
+                    grpc.StatusCode.UNAUTHENTICATED,
+                    "invalid oauth_token or unknown deployment",
+                )
+                return resp_cls()
+            return await getattr(_stub(target), method)(request_pb, timeout=30.0)
+
+        async def predict(request_pb, context):
+            return await _forward_unary(
+                "Predict", pb.SeldonMessage, request_pb, context
+            )
+
+        async def send_feedback(request_pb, context):
+            return await _forward_unary(
+                "SendFeedback", pb.SeldonMessage, request_pb, context
+            )
+
+        return grpc.method_handlers_generic_handler(
+            f"{_PKG}.Seldon",
+            {
+                "Predict": grpc.unary_unary_rpc_method_handler(
+                    predict,
+                    request_deserializer=pb.SeldonMessage.FromString,
+                    response_serializer=pb.SeldonMessage.SerializeToString,
+                ),
+                "SendFeedback": grpc.unary_unary_rpc_method_handler(
+                    send_feedback,
+                    request_deserializer=pb.Feedback.FromString,
+                    response_serializer=pb.SeldonMessage.SerializeToString,
+                ),
+            },
+        )
+
+    # ------------------------------------------------------------------
+    # store refresh loop (the CRD-watch analog)
+    # ------------------------------------------------------------------
+    async def watch_loop(self) -> None:
+        while True:
+            try:
+                self.store.refresh()
+            except Exception:
+                logger.exception("deployment store refresh failed")
+            await asyncio.sleep(WATCH_INTERVAL_S)
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(description="seldon-core-tpu API gateway")
+    ap.add_argument("--config", required=True,
+                    help="deployments JSON (see DeploymentStore.refresh)")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("GATEWAY_PORT", "8080")))
+    ap.add_argument("--grpc-port", type=int,
+                    default=int(os.environ.get("GATEWAY_GRPC_PORT", "5000")))
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--firehose", choices=["none", "jsonl", "memory"],
+                    default="none")
+    ap.add_argument("--firehose-dir", default="./firehose")
+    ap.add_argument("--token-spill", default="")
+    args = ap.parse_args(argv)
+
+    store = DeploymentStore(args.config)
+    gw = Gateway(
+        store,
+        firehose=make_firehose(
+            args.firehose if args.firehose != "none" else "", args.firehose_dir
+        ),
+        token_spill=args.token_spill or None,
+    )
+
+    async def serve():
+        runner = web.AppRunner(gw.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, args.port)
+        await site.start()
+        if args.grpc_port:
+            from seldon_core_tpu.serving.grpc_api import GrpcServer
+
+            gserver = GrpcServer([gw.grpc_handler()], port=args.grpc_port,
+                                 host=args.host)
+            await gserver.start()
+            print(f"gateway gRPC on {args.host}:{gserver.port}", flush=True)
+        print(f"gateway REST on {args.host}:{args.port} "
+              f"({len(store.names())} deployments)", flush=True)
+        await gw.watch_loop()
+
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

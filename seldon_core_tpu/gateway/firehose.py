@@ -1,0 +1,75 @@
+"""Request/response firehose — the reference's Kafka logging path.
+
+Reference: every gateway prediction is published fire-and-forget to a Kafka
+topic named after the client, as a ``RequestResponse`` proto
+(``api-frontend/.../kafka/KafkaRequestResponseProducer.java:68-75``, enabled
+by ``seldon.kafka.enable``).  No Kafka client exists in this image, so the
+sink is pluggable: JSONL file per client (consumable by any log shipper), an
+in-memory ring (tests/inspection), or a user-provided sink object.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional, Protocol
+
+
+class FirehoseSink(Protocol):
+    def publish(self, client_id: str, request: dict, response: dict) -> None: ...
+
+
+class MemoryFirehose:
+    """Bounded in-memory ring per client."""
+
+    def __init__(self, maxlen: int = 1000):
+        self._rings: dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self.maxlen = maxlen
+
+    def publish(self, client_id: str, request: dict, response: dict) -> None:
+        with self._lock:
+            ring = self._rings.setdefault(client_id, deque(maxlen=self.maxlen))
+            ring.append(
+                {"ts": time.time(), "request": request, "response": response}
+            )
+
+    def records(self, client_id: str) -> list[dict]:
+        with self._lock:
+            return list(self._rings.get(client_id, ()))
+
+
+class JsonlFirehose:
+    """One append-only ``<client_id>.jsonl`` per client under ``base_dir`` —
+    the topic-per-client layout, durable and shipper-friendly."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def publish(self, client_id: str, request: dict, response: dict) -> None:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in client_id)
+        line = json.dumps(
+            {"ts": time.time(), "request": request, "response": response},
+            separators=(",", ":"),
+        )
+        with self._lock:
+            with open(os.path.join(self.base_dir, f"{safe}.jsonl"), "a") as f:
+                f.write(line + "\n")
+
+
+class NullFirehose:
+    def publish(self, client_id: str, request: dict, response: dict) -> None:
+        pass
+
+
+def make_firehose(kind: str = "", base_dir: Optional[str] = None):
+    if kind == "jsonl":
+        return JsonlFirehose(base_dir or "./firehose")
+    if kind == "memory":
+        return MemoryFirehose()
+    return NullFirehose()

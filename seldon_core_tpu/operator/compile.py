@@ -212,50 +212,82 @@ def _colocated_predictor(
                 ]
             )
     labels = _engine_labels(dep, p)
-    workload: dict[str, Any] = {
-        "apiVersion": "apps/v1",
-        "kind": "StatefulSet" if hosts > 1 else "Deployment",
-        "metadata": {
-            "name": workload_name,
-            "namespace": dep.namespace,
-            "labels": labels,
-        },
-        "spec": {
-            "replicas": p.replicas * hosts,
-            "selector": {"matchLabels": labels},
-            "template": {
-                "metadata": {
-                    "labels": labels,
-                    "annotations": {
-                        "prometheus.io/scrape": "true",
-                        "prometheus.io/port": str(METRICS_PORT),
-                        "prometheus.io/path": "/metrics",
-                    },
-                },
-                "spec": pod_spec,
-            },
-        },
-    }
-    if hosts > 1:
-        workload["spec"]["serviceName"] = f"{workload_name}-hosts"
-        workload["spec"]["podManagementPolicy"] = "Parallel"
-        headless = {
-            "apiVersion": "v1",
-            "kind": "Service",
+
+    def _pod_template(tmpl_labels: dict) -> dict:
+        return {
             "metadata": {
-                "name": f"{workload_name}-hosts",
-                "namespace": dep.namespace,
-                "labels": labels,
+                "labels": tmpl_labels,
+                "annotations": {
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/port": str(METRICS_PORT),
+                    "prometheus.io/path": "/metrics",
+                },
             },
-            "spec": {
-                "clusterIP": "None",
-                "selector": labels,
-                "ports": [{"port": ENGINE_PORT, "name": "http"}],
-            },
+            "spec": pod_spec,
         }
-        return [workload, headless]
-    workload["spec"]["strategy"] = {"rollingUpdate": {"maxUnavailable": "10%"}}
-    return [workload]
+
+    if hosts <= 1:
+        return [
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {
+                    "name": workload_name,
+                    "namespace": dep.namespace,
+                    "labels": labels,
+                },
+                "spec": {
+                    "replicas": p.replicas,
+                    "strategy": {"rollingUpdate": {"maxUnavailable": "10%"}},
+                    "selector": {"matchLabels": labels},
+                    "template": _pod_template(labels),
+                },
+            }
+        ]
+
+    # Multi-host slice: ONE StatefulSet PER slice replica, each with
+    # replicas == hosts, so every pod-index is a valid jax.distributed
+    # worker id in [0, hosts) (a single hosts*replicas StatefulSet would
+    # hand out ordinals >= NUM_TPU_HOSTS).
+    out: list[dict] = []
+    for r in range(p.replicas):
+        sts_name = workload_name if p.replicas == 1 else f"{workload_name}-r{r}"
+        rlabels = {**labels, "seldon-slice-replica": str(r)}
+        out.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "StatefulSet",
+                "metadata": {
+                    "name": sts_name,
+                    "namespace": dep.namespace,
+                    "labels": rlabels,
+                },
+                "spec": {
+                    "replicas": hosts,
+                    "serviceName": f"{sts_name}-hosts",
+                    "podManagementPolicy": "Parallel",
+                    "selector": {"matchLabels": rlabels},
+                    "template": _pod_template(rlabels),
+                },
+            }
+        )
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {
+                    "name": f"{sts_name}-hosts",
+                    "namespace": dep.namespace,
+                    "labels": rlabels,
+                },
+                "spec": {
+                    "clusterIP": "None",
+                    "selector": rlabels,
+                    "ports": [{"port": ENGINE_PORT, "name": "http"}],
+                },
+            }
+        )
+    return out
 
 
 def _distributed_predictor(

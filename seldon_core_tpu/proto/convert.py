@@ -224,7 +224,12 @@ def message_from_proto(p: pb.SeldonMessage) -> SeldonMessage:
         elif dwhich == "binTensor":
             t = p.data.binTensor
             dtype = _np_dtype(t.dtype or "float32")
-            msg.data = np.frombuffer(t.raw, dtype=dtype).reshape(list(t.shape))
+            # bytearray keeps the array writable (np.frombuffer over bytes is
+            # read-only, which would break components that mutate X in place
+            # and behave differently from the REST path)
+            msg.data = np.frombuffer(bytearray(t.raw), dtype=dtype).reshape(
+                list(t.shape)
+            )
             msg.encoding = "binTensor"
         elif dwhich == "device":
             raise ValueError(

@@ -1,0 +1,331 @@
+"""Gateway tests: OAuth token dance, principal routing, firehose, gRPC front.
+
+Reference analog: apife tests with FakeEngineServer
+(api-frontend/src/test/java/io/seldon/apife/grpc/FakeEngineServer.java) and
+the OAuth flow in util/loadtester/scripts/predict_rest_locust.py:70-80.
+"""
+
+import asyncio
+import base64
+import json
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.gateway.app import Gateway
+from seldon_core_tpu.gateway.firehose import JsonlFirehose, MemoryFirehose
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.messages import SeldonMessage
+
+
+def basic_auth(key: str, secret: str) -> str:
+    return "Basic " + base64.b64encode(f"{key}:{secret}".encode()).decode()
+
+
+async def fake_engine_app():
+    """Canned engine: echoes the parsed data back with a marker tag."""
+
+    async def predict(request):
+        body = await request.json()
+        return web.json_response(
+            {"meta": {"tags": {"engine": "fake"}},
+             "data": body.get("data", {}),
+             "status": {"code": 200, "status": "SUCCESS"}}
+        )
+
+    async def feedback(request):
+        return web.json_response({"status": {"code": 200, "status": "SUCCESS"}})
+
+    app = web.Application()
+    app.router.add_post("/api/v0.1/predictions", predict)
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    return app
+
+
+async def make_gateway(firehose=None, engine_url=""):
+    store = DeploymentStore()
+    store.put(
+        DeploymentRecord(
+            name="dep1", oauth_key="key1", oauth_secret="sec1",
+            engine_url=engine_url,
+        )
+    )
+    gw = Gateway(store, firehose=firehose)
+    client = TestClient(TestServer(gw.build_app()))
+    await client.start_server()
+    return gw, client, store
+
+
+async def get_token(client, key="key1", secret="sec1") -> str:
+    resp = await client.post(
+        "/oauth/token",
+        data={"grant_type": "client_credentials"},
+        headers={"Authorization": basic_auth(key, secret)},
+    )
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["token_type"] == "bearer"
+    return body["access_token"]
+
+
+class TestOAuth:
+    async def test_token_and_predict(self):
+        engine = TestClient(TestServer(await fake_engine_app()))
+        await engine.start_server()
+        url = f"http://127.0.0.1:{engine.port}"
+        gw, client, _ = await make_gateway(engine_url=url)
+        try:
+            token = await get_token(client)
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0, 2.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["meta"]["tags"]["engine"] == "fake"
+            assert body["data"]["ndarray"] == [[1.0, 2.0]]
+
+            fb = await client.post(
+                "/api/v0.1/feedback",
+                json={"reward": 1.0},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert fb.status == 200
+        finally:
+            await client.close()
+            await engine.close()
+            await gw.close()
+
+    async def test_bad_credentials(self):
+        gw, client, _ = await make_gateway()
+        try:
+            resp = await client.post(
+                "/oauth/token",
+                data={"grant_type": "client_credentials"},
+                headers={"Authorization": basic_auth("key1", "WRONG")},
+            )
+            assert resp.status == 401
+            assert (await resp.json())["error"] == "invalid_client"
+
+            resp = await client.post(
+                "/oauth/token",
+                data={"grant_type": "password", "client_id": "key1",
+                      "client_secret": "sec1"},
+            )
+            assert resp.status == 400
+        finally:
+            await client.close()
+            await gw.close()
+
+    async def test_empty_secret_never_authenticates(self):
+        store = DeploymentStore()
+        store.put(DeploymentRecord(name="d", oauth_key="k", oauth_secret=""))
+        gw = Gateway(store)
+        client = TestClient(TestServer(gw.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/oauth/token",
+                data={"grant_type": "client_credentials", "client_id": "k",
+                      "client_secret": ""},
+            )
+            assert resp.status == 401
+        finally:
+            await client.close()
+            await gw.close()
+
+    async def test_form_credentials(self):
+        gw, client, _ = await make_gateway()
+        try:
+            resp = await client.post(
+                "/oauth/token",
+                data={"grant_type": "client_credentials",
+                      "client_id": "key1", "client_secret": "sec1"},
+            )
+            assert resp.status == 200
+        finally:
+            await client.close()
+            await gw.close()
+
+    async def test_predict_requires_token(self):
+        gw, client, _ = await make_gateway()
+        try:
+            resp = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[1]]}}
+            )
+            assert resp.status == 401
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": "Bearer bogus"},
+            )
+            assert resp.status == 401
+        finally:
+            await client.close()
+            await gw.close()
+
+    async def test_expired_token(self):
+        gw, client, _ = await make_gateway()
+        try:
+            token, _ = gw.oauth.tokens.issue("key1", ttl_s=-1.0)
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert resp.status == 401
+        finally:
+            await client.close()
+            await gw.close()
+
+    async def test_engine_unreachable_503(self):
+        gw, client, _ = await make_gateway(engine_url="http://127.0.0.1:1")
+        try:
+            token = await get_token(client)
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert resp.status == 503
+        finally:
+            await client.close()
+            await gw.close()
+
+
+async def poll(predicate, timeout_s=3.0):
+    """Firehose publishes are offloaded to the executor; wait for them."""
+    t_end = asyncio.get_event_loop().time() + timeout_s
+    while asyncio.get_event_loop().time() < t_end:
+        if predicate():
+            return True
+        await asyncio.sleep(0.02)
+    return predicate()
+
+
+class TestFirehose:
+    async def test_memory_firehose_records(self):
+        engine = TestClient(TestServer(await fake_engine_app()))
+        await engine.start_server()
+        fh = MemoryFirehose()
+        gw, client, _ = await make_gateway(
+            firehose=fh, engine_url=f"http://127.0.0.1:{engine.port}"
+        )
+        try:
+            token = await get_token(client)
+            for _ in range(3):
+                await client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[7.0]]}},
+                    headers={"Authorization": f"Bearer {token}"},
+                )
+            assert await poll(lambda: len(fh.records("key1")) == 3)
+            recs = fh.records("key1")
+            assert recs[0]["request"]["data"]["ndarray"] == [[7.0]]
+            assert recs[0]["response"]["meta"]["tags"]["engine"] == "fake"
+        finally:
+            await client.close()
+            await engine.close()
+            await gw.close()
+
+    async def test_jsonl_firehose(self, tmp_path):
+        engine = TestClient(TestServer(await fake_engine_app()))
+        await engine.start_server()
+        fh = JsonlFirehose(str(tmp_path))
+        gw, client, _ = await make_gateway(
+            firehose=fh, engine_url=f"http://127.0.0.1:{engine.port}"
+        )
+        try:
+            token = await get_token(client)
+            await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            target = tmp_path / "key1.jsonl"
+            assert await poll(target.exists)
+            lines = target.read_text().strip().splitlines()
+            assert len(lines) == 1
+            assert json.loads(lines[0])["request"]["data"]["ndarray"] == [[1.0]]
+        finally:
+            await client.close()
+            await engine.close()
+            await gw.close()
+
+
+class TestStore:
+    def test_config_refresh(self, tmp_path):
+        cfg = tmp_path / "deps.json"
+        cfg.write_text(json.dumps({"deployments": [
+            {"name": "a", "oauth_key": "ka", "oauth_secret": "sa",
+             "engine_url": "http://a:8000"},
+        ]}))
+        store = DeploymentStore(str(cfg))
+        assert store.by_oauth_key("ka").name == "a"
+        # mutate: replace a with b
+        import os
+        cfg.write_text(json.dumps({"deployments": [
+            {"name": "b", "oauth_key": "kb", "oauth_secret": "sb",
+             "engine_url": "http://b:8000"},
+        ]}))
+        os.utime(str(cfg), (0, 4102444800))  # force mtime change
+        assert store.refresh()
+        assert store.by_oauth_key("ka") is None
+        assert store.by_oauth_key("kb").name == "b"
+
+    def test_key_rotation(self):
+        store = DeploymentStore()
+        store.put(DeploymentRecord(name="d", oauth_key="k1", oauth_secret="s"))
+        store.put(DeploymentRecord(name="d", oauth_key="k2", oauth_secret="s"))
+        assert store.by_oauth_key("k1") is None
+        assert store.by_oauth_key("k2").name == "d"
+
+
+class TestGrpcGateway:
+    async def test_grpc_forward_with_oauth(self):
+        """gateway gRPC → engine gRPC, full Seldon service chain."""
+        from seldon_core_tpu.graph.engine import GraphEngine
+        from seldon_core_tpu.serving.grpc_api import (
+            GrpcServer,
+            SeldonGrpcClient,
+            seldon_service_handler,
+        )
+
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        engine_server = GrpcServer(
+            [seldon_service_handler(eng)], port=0, host="127.0.0.1"
+        )
+        engine_port = await engine_server.start()
+
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name="dep1", oauth_key="key1", oauth_secret="sec1",
+            engine_grpc=f"127.0.0.1:{engine_port}",
+        ))
+        gw = Gateway(store)
+        gw_server = GrpcServer([gw.grpc_handler()], port=0, host="127.0.0.1")
+        gw_port = await gw_server.start()
+        try:
+            token, _ = gw.oauth.tokens.issue("key1")
+            client = SeldonGrpcClient(f"127.0.0.1:{gw_port}", token=token)
+            out = await client.predict(
+                SeldonMessage(data=np.array([[1.0, 2.0]]), names=["a", "b"])
+            )
+            assert out.status.status == "SUCCESS"
+            assert out.meta.puid
+            await client.close()
+
+            import grpc
+
+            bad = SeldonGrpcClient(f"127.0.0.1:{gw_port}", token="nope")
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await bad.predict(SeldonMessage(data=np.zeros((1, 2))))
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            await bad.close()
+        finally:
+            await gw.close()
+            await gw_server.stop()
+            await engine_server.stop()

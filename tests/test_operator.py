@@ -142,6 +142,25 @@ def test_multihost_slice_replication():
     assert sts["spec"]["serviceName"] == headless[0]["metadata"]["name"]
 
 
+def test_multihost_with_replicas_gets_statefulset_per_replica():
+    # pod ordinals must stay in [0, hosts) per slice replica, so each
+    # replica is its own StatefulSet
+    d = json.loads(json.dumps(SINGLE_MODEL))
+    d["spec"]["predictors"][0]["replicas"] = 2
+    d["spec"]["predictors"][0]["annotations"] = {
+        "seldon.io/tpu-chips": "16", "seldon.io/tpu-topology": "4x4",
+    }
+    manifests = compile_deployment(SeldonDeployment.from_dict(d))
+    stss = [m for m in manifests if m["kind"] == "StatefulSet"]
+    assert len(stss) == 2
+    for sts in stss:
+        assert sts["spec"]["replicas"] == 2  # hosts per slice, not total pods
+    selectors = [
+        tuple(sorted(s["spec"]["selector"]["matchLabels"].items())) for s in stss
+    ]
+    assert len(set(selectors)) == 2  # disjoint selectors per replica
+
+
 def test_local_deployment_end_to_end():
     local = LocalDeployment(SeldonDeployment.from_dict(SINGLE_MODEL))
     out = run(
