@@ -1,0 +1,180 @@
+/* Tensor frame codec.  Layout (all little-endian, offsets from frame start):
+ *
+ *   0   u32  magic "SELF"
+ *   4   u8   version
+ *   5   u8   msg_type
+ *   6   u16  flags
+ *   8   u32  meta_len
+ *   12  u16  n_tensors
+ *   14  u16  reserved
+ *   16  u64  frame_len
+ *   24  tensor headers, n_tensors x 24 bytes:
+ *         u8  dtype, u8 ndim, u16 pad, u32 pad, u64 nbytes, u64 payload_off
+ *       ...then i64 shape dims for all tensors, concatenated
+ *   meta JSON bytes
+ *   payloads, each 64-byte aligned relative to frame start
+ *
+ * The header is fixed-width and the payload offsets are explicit, so a
+ * receiver can index tensors without touching the payload bytes at all —
+ * the numpy/jax view is created straight over the socket buffer.
+ */
+#include "seldon_native.h"
+
+#include <string.h>
+
+namespace {
+
+constexpr uint64_t kFixedHeader = 24;
+constexpr uint64_t kTensorHeader = 24;
+
+inline uint64_t align_up(uint64_t x) {
+  return (x + (SN_ALIGN - 1)) & ~static_cast<uint64_t>(SN_ALIGN - 1);
+}
+
+inline void put_u16(uint8_t *p, uint16_t v) { memcpy(p, &v, 2); }
+inline void put_u32(uint8_t *p, uint32_t v) { memcpy(p, &v, 4); }
+inline void put_u64(uint8_t *p, uint64_t v) { memcpy(p, &v, 8); }
+inline uint16_t get_u16(const uint8_t *p) { uint16_t v; memcpy(&v, p, 2); return v; }
+inline uint32_t get_u32(const uint8_t *p) { uint32_t v; memcpy(&v, p, 4); return v; }
+inline uint64_t get_u64(const uint8_t *p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+}  // namespace
+
+extern "C" {
+
+int sn_dtype_itemsize(uint8_t dtype) {
+  switch (dtype) {
+    case SN_DT_FLOAT32: return 4;
+    case SN_DT_FLOAT64: return 8;
+    case SN_DT_BFLOAT16: return 2;
+    case SN_DT_FLOAT16: return 2;
+    case SN_DT_INT8: return 1;
+    case SN_DT_INT16: return 2;
+    case SN_DT_INT32: return 4;
+    case SN_DT_INT64: return 8;
+    case SN_DT_UINT8: return 1;
+    case SN_DT_BOOL: return 1;
+    default: return -1;
+  }
+}
+
+uint64_t sn_frame_size(uint32_t meta_len, uint16_t n_tensors,
+                       const uint8_t *ndims, const uint64_t *nbytes) {
+  if (n_tensors > SN_MAX_TENSORS) return 0;
+  uint64_t off = kFixedHeader + (uint64_t)n_tensors * kTensorHeader;
+  for (uint16_t i = 0; i < n_tensors; i++) {
+    if (ndims[i] > SN_MAX_NDIM) return 0;
+    off += (uint64_t)ndims[i] * 8;
+  }
+  off += meta_len;
+  for (uint16_t i = 0; i < n_tensors; i++) {
+    off = align_up(off) + nbytes[i];
+  }
+  return off;
+}
+
+uint64_t sn_frame_encode(uint8_t *buf, uint64_t buf_len, uint8_t msg_type,
+                         uint16_t flags, const uint8_t *meta,
+                         uint32_t meta_len, uint16_t n_tensors,
+                         const uint8_t *dtypes, const uint8_t *ndims,
+                         const int64_t *shapes_flat,
+                         const uint8_t *const *payloads,
+                         const uint64_t *nbytes) {
+  uint64_t need = sn_frame_size(meta_len, n_tensors, ndims, nbytes);
+  if (need == 0 || need > buf_len) return 0;
+
+  put_u32(buf + 0, SN_MAGIC);
+  buf[4] = SN_VERSION;
+  buf[5] = msg_type;
+  put_u16(buf + 6, flags);
+  put_u32(buf + 8, meta_len);
+  put_u16(buf + 12, n_tensors);
+  put_u16(buf + 14, 0);
+  put_u64(buf + 16, need);
+
+  /* shape region follows all tensor headers */
+  uint64_t shape_off = kFixedHeader + (uint64_t)n_tensors * kTensorHeader;
+  uint64_t payload_cursor = shape_off;
+  {
+    uint64_t total_dims = 0;
+    for (uint16_t i = 0; i < n_tensors; i++) total_dims += ndims[i];
+    payload_cursor += total_dims * 8 + meta_len;
+  }
+
+  const int64_t *shape_p = shapes_flat;
+  uint64_t shape_cursor = shape_off;
+  for (uint16_t i = 0; i < n_tensors; i++) {
+    uint8_t *th = buf + kFixedHeader + (uint64_t)i * kTensorHeader;
+    uint64_t poff = align_up(payload_cursor);
+    th[0] = dtypes[i];
+    th[1] = ndims[i];
+    put_u16(th + 2, 0);
+    put_u32(th + 4, 0);
+    put_u64(th + 8, nbytes[i]);
+    put_u64(th + 16, poff);
+    for (uint8_t d = 0; d < ndims[i]; d++) {
+      put_u64(buf + shape_cursor, (uint64_t)(*shape_p++));
+      shape_cursor += 8;
+    }
+    /* zero the alignment gap so frames are deterministic bytes */
+    memset(buf + payload_cursor, 0, poff - payload_cursor);
+    if (payloads && payloads[i]) {
+      memcpy(buf + poff, payloads[i], nbytes[i]);
+    }
+    payload_cursor = poff + nbytes[i];
+  }
+  if (meta_len) memcpy(buf + shape_cursor, meta, meta_len);
+  return need;
+}
+
+int sn_frame_parse(const uint8_t *buf, uint64_t buf_len, sn_frame_view *view) {
+  if (buf_len < kFixedHeader) return -1;
+  if (get_u32(buf) != SN_MAGIC) return -2;
+  if (buf[4] != SN_VERSION) return -3;
+  uint64_t frame_len = get_u64(buf + 16);
+  if (frame_len > buf_len) return -4;
+  uint16_t n_tensors = get_u16(buf + 12);
+  if (n_tensors > SN_MAX_TENSORS) return -5;
+
+  view->msg_type = buf[5];
+  view->flags = get_u16(buf + 6);
+  view->meta_len = get_u32(buf + 8);
+  view->n_tensors = n_tensors;
+  view->frame_len = frame_len;
+
+  uint64_t shape_cursor = kFixedHeader + (uint64_t)n_tensors * kTensorHeader;
+  if (shape_cursor > frame_len) return -6;
+  for (uint16_t i = 0; i < n_tensors; i++) {
+    const uint8_t *th = buf + kFixedHeader + (uint64_t)i * kTensorHeader;
+    sn_tensor_desc *t = &view->tensors[i];
+    t->dtype = th[0];
+    t->ndim = th[1];
+    if (t->ndim > SN_MAX_NDIM) return -7;
+    t->nbytes = get_u64(th + 8);
+    t->payload_offset = get_u64(th + 16);
+    /* ordered checks so attacker-chosen u64s cannot wrap the sum */
+    if (t->payload_offset > frame_len) return -8;
+    if (t->nbytes > frame_len - t->payload_offset) return -8;
+    if (t->payload_offset % SN_ALIGN != 0) return -9;
+    if (sn_dtype_itemsize(t->dtype) < 0) return -10;
+    if (shape_cursor + (uint64_t)t->ndim * 8 > frame_len) return -11;
+    uint64_t nelem = 1;
+    for (uint8_t d = 0; d < t->ndim; d++) {
+      t->shape[d] = (int64_t)get_u64(buf + shape_cursor);
+      shape_cursor += 8;
+      if (t->shape[d] < 0) return -12;
+      if (__builtin_mul_overflow(nelem, (uint64_t)t->shape[d], &nelem))
+        return -12;
+    }
+    uint64_t expect;
+    if (__builtin_mul_overflow(nelem, (uint64_t)sn_dtype_itemsize(t->dtype),
+                               &expect) ||
+        expect != t->nbytes)
+      return -13;
+  }
+  view->meta_offset = shape_cursor;
+  if (view->meta_offset + view->meta_len > frame_len) return -14;
+  return 0;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,177 @@
+/* seldon_native: native runtime core for the TPU-native serving framework.
+ *
+ * Three subsystems, all exported with a plain-C ABI for ctypes:
+ *
+ *  1. Tensor frame codec ("SELF" frames) — the low-overhead binary transport
+ *     that replaces the reference's experimental FlatBuffers path
+ *     (reference: fbs/prediction.fbs, wrappers/python/seldon_flatbuffers.py).
+ *     Zero-copy parse: payload pointers land 64-byte aligned inside the
+ *     receive buffer so they can be wrapped by numpy / dlpack and fed to the
+ *     device without an intermediate copy.
+ *
+ *  2. Dynamic-batching queue core — deadline + bucket admission logic for the
+ *     server-side batcher (reference has no batcher; this is the TPU-native
+ *     obligation from BASELINE.json).  Thread-safe; designed to be polled or
+ *     blocked on from a device-feeding worker thread.
+ *
+ *  3. Epoll TCP server — event loop for the framed protocol.  The handler is
+ *     a function pointer (a ctypes callback in the Python runtime, or the
+ *     built-in echo handler for transport benchmarking).
+ */
+#ifndef SELDON_NATIVE_H
+#define SELDON_NATIVE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------------------------------------------------------- framing */
+
+#define SN_MAGIC 0x464C4553u /* "SELF" little-endian */
+#define SN_VERSION 1
+#define SN_MAX_TENSORS 16
+#define SN_MAX_NDIM 8
+#define SN_ALIGN 64
+
+/* msg_type values */
+enum {
+  SN_MSG_PREDICT = 1,
+  SN_MSG_RESPONSE = 2,
+  SN_MSG_FEEDBACK = 3,
+  SN_MSG_ERROR = 4,
+  SN_MSG_PING = 5,
+};
+
+/* dtype codes (superset of the reference's double-only Tensor —
+ * proto/prediction.proto:31-34) */
+enum {
+  SN_DT_FLOAT32 = 0,
+  SN_DT_FLOAT64 = 1,
+  SN_DT_BFLOAT16 = 2,
+  SN_DT_FLOAT16 = 3,
+  SN_DT_INT8 = 4,
+  SN_DT_INT16 = 5,
+  SN_DT_INT32 = 6,
+  SN_DT_INT64 = 7,
+  SN_DT_UINT8 = 8,
+  SN_DT_BOOL = 9,
+};
+
+typedef struct {
+  uint8_t dtype;
+  uint8_t ndim;
+  int64_t shape[SN_MAX_NDIM];
+  uint64_t nbytes;
+  /* parse output: offset of the payload from frame start (64-byte aligned) */
+  uint64_t payload_offset;
+} sn_tensor_desc;
+
+typedef struct {
+  uint8_t msg_type;
+  uint16_t flags;
+  uint32_t meta_len;
+  uint64_t meta_offset; /* offset of meta JSON from frame start */
+  uint16_t n_tensors;
+  sn_tensor_desc tensors[SN_MAX_TENSORS];
+  uint64_t frame_len; /* total encoded length */
+} sn_frame_view;
+
+/* Size a frame would occupy. shapes is flattened (ndims[i] entries each).
+ * Returns total byte length, or 0 on invalid input. */
+uint64_t sn_frame_size(uint32_t meta_len, uint16_t n_tensors,
+                       const uint8_t *ndims, const uint64_t *nbytes);
+
+/* Encode a frame into buf (caller-sized via sn_frame_size).  payloads[i] may
+ * be NULL to leave the (aligned, zeroed-header) payload region for the caller
+ * to fill in place — used for true zero-copy sends.  Returns bytes written or
+ * 0 on error. */
+uint64_t sn_frame_encode(uint8_t *buf, uint64_t buf_len, uint8_t msg_type,
+                         uint16_t flags, const uint8_t *meta,
+                         uint32_t meta_len, uint16_t n_tensors,
+                         const uint8_t *dtypes, const uint8_t *ndims,
+                         const int64_t *shapes_flat,
+                         const uint8_t *const *payloads,
+                         const uint64_t *nbytes);
+
+/* Parse (validate + index) a frame.  No payload copies: view records offsets
+ * into buf.  Returns 0 on success, negative error code otherwise. */
+int sn_frame_parse(const uint8_t *buf, uint64_t buf_len, sn_frame_view *view);
+
+int sn_dtype_itemsize(uint8_t dtype);
+
+/* ---------------------------------------------------------------- batcher */
+
+typedef struct sn_batcher sn_batcher;
+
+typedef struct {
+  uint32_t max_batch_rows;  /* flush when accumulated rows reach this */
+  uint64_t max_delay_ns;    /* flush a non-empty lane this long after its
+                               oldest arrival */
+  uint32_t n_buckets;       /* padded-batch row buckets (sorted ascending);
+                               0 => single bucket of max_batch_rows */
+  uint32_t buckets[16];
+} sn_batcher_config;
+
+sn_batcher *sn_batcher_create(const sn_batcher_config *cfg);
+void sn_batcher_destroy(sn_batcher *b);
+
+/* Enqueue request `req_id` carrying `nrows` rows in shape-lane `lane`
+ * (callers hash padded feature-shape+dtype to a lane id).  arrival_ns is a
+ * monotonic clock reading.  Returns 0, or -1 if the queue is full. */
+int sn_batcher_submit(sn_batcher *b, uint64_t req_id, uint32_t nrows,
+                      uint32_t lane, uint64_t arrival_ns);
+
+/* Non-blocking: if some lane is ready (rows >= bucket target, or oldest
+ * arrival older than max_delay), pop one batch: fills out_ids/out_rows (cap
+ * entries), stores lane in *out_lane and the padded bucket size in
+ * *out_bucket.  Returns number of requests popped, 0 if nothing ready. */
+int sn_batcher_next(sn_batcher *b, uint64_t now_ns, uint64_t *out_ids,
+                    uint32_t *out_rows, uint32_t cap, uint32_t *out_lane,
+                    uint32_t *out_bucket);
+
+/* Blocking variant: waits up to timeout_ns for a ready batch. */
+int sn_batcher_wait_next(sn_batcher *b, uint64_t timeout_ns, uint64_t *out_ids,
+                         uint32_t *out_rows, uint32_t cap, uint32_t *out_lane,
+                         uint32_t *out_bucket);
+
+uint32_t sn_batcher_pending(sn_batcher *b);
+/* earliest deadline (arrival+max_delay) over all lanes; 0 if empty */
+uint64_t sn_batcher_next_deadline(sn_batcher *b);
+
+uint64_t sn_now_ns(void);
+
+/* -------------------------------------------------------------- tcpserver */
+
+typedef struct sn_server sn_server;
+
+/* Handler: consume a request frame, produce a response frame.
+ * resp buffer must be allocated with sn_buf_alloc; server frees it after the
+ * write completes.  Return 0 to keep the connection open, nonzero to close. */
+typedef int (*sn_handler_fn)(const uint8_t *req, uint64_t req_len,
+                             uint8_t **resp, uint64_t *resp_len, void *ud);
+
+uint8_t *sn_buf_alloc(uint64_t n);
+void sn_buf_free(uint8_t *p);
+
+sn_server *sn_server_create(const char *bind_addr, uint16_t port,
+                            sn_handler_fn handler, void *ud);
+/* Start the accept/IO loop on a background thread. Returns 0 on success. */
+int sn_server_start(sn_server *s);
+uint16_t sn_server_port(sn_server *s); /* resolved port (for port=0) */
+void sn_server_stop(sn_server *s);
+void sn_server_destroy(sn_server *s);
+uint64_t sn_server_requests(sn_server *s);
+
+/* Built-in echo handler (returns the request frame with msg_type=RESPONSE):
+ * lets the transport be benchmarked without crossing into Python. */
+int sn_echo_handler(const uint8_t *req, uint64_t req_len, uint8_t **resp,
+                    uint64_t *resp_len, void *ud);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SELDON_NATIVE_H */

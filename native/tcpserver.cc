@@ -1,0 +1,299 @@
+/* Epoll TCP server for the framed protocol.
+ *
+ * Wire format: u32 little-endian length prefix, then one "SELF" frame.
+ * One accept+IO thread; the handler runs inline on that thread.  When the
+ * handler is a Python ctypes callback the GIL serializes work anyway, so
+ * extra IO threads would only add contention; the pure-C echo handler path
+ * (transport benchmarking) saturates a core without it.
+ */
+#include "seldon_native.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 1u << 30; /* 1 GiB hard cap */
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> rbuf;
+  size_t rlen = 0;       /* valid bytes in rbuf */
+  std::vector<uint8_t> wbuf;
+  size_t woff = 0;       /* bytes of wbuf already written */
+  bool closing = false;
+};
+
+}  // namespace
+
+/* epoll_data sentinels: real connections carry their Conn* (always > 2) */
+constexpr uint64_t kListenTag = 1;
+constexpr uint64_t kWakeTag = 2;
+
+struct sn_server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1; /* eventfd to break the loop on stop */
+  uint16_t port = 0;
+  sn_handler_fn handler = nullptr;
+  void *ud = nullptr;
+  pthread_t thread{};
+  bool running = false;
+  volatile int stop_flag = 0;
+  uint64_t n_requests = 0;
+  std::unordered_map<int, Conn *> conns;
+};
+
+extern "C" {
+
+uint8_t *sn_buf_alloc(uint64_t n) {
+  return static_cast<uint8_t *>(malloc(n ? n : 1));
+}
+void sn_buf_free(uint8_t *p) { free(p); }
+
+int sn_echo_handler(const uint8_t *req, uint64_t req_len, uint8_t **resp,
+                    uint64_t *resp_len, void *) {
+  uint8_t *out = sn_buf_alloc(req_len);
+  if (!out) return 1;
+  memcpy(out, req, req_len);
+  if (req_len > 5) out[5] = SN_MSG_RESPONSE;
+  *resp = out;
+  *resp_len = req_len;
+  return 0;
+}
+
+}  /* extern "C" */
+
+namespace {
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void close_conn(sn_server *s, Conn *c) {
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->fd);
+  delete c;
+}
+
+void arm(sn_server *s, Conn *c) {
+  struct epoll_event ev;
+  ev.events = EPOLLIN | (c->wbuf.size() > c->woff ? (uint32_t)EPOLLOUT : 0u);
+  ev.data.ptr = c;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+/* flush pending writes; returns false if the connection died */
+bool do_write(sn_server *s, Conn *c) {
+  while (c->woff < c->wbuf.size()) {
+    ssize_t n = write(c->fd, c->wbuf.data() + c->woff, c->wbuf.size() - c->woff);
+    if (n > 0) {
+      c->woff += (size_t)n;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      arm(s, c);
+      return true;
+    } else {
+      close_conn(s, c);
+      return false;
+    }
+  }
+  c->wbuf.clear();
+  c->woff = 0;
+  if (c->closing) {
+    close_conn(s, c);
+    return false;
+  }
+  arm(s, c);
+  return true;
+}
+
+/* run handler over every complete frame in rbuf */
+bool drain_frames(sn_server *s, Conn *c) {
+  size_t off = 0;
+  while (c->rlen - off >= 4) {
+    uint32_t flen;
+    memcpy(&flen, c->rbuf.data() + off, 4);
+    if (flen > kMaxFrame) { close_conn(s, c); return false; }
+    if (c->rlen - off - 4 < flen) break;
+    uint8_t *resp = nullptr;
+    uint64_t resp_len = 0;
+    s->n_requests++;
+    int rc = s->handler(c->rbuf.data() + off + 4, flen, &resp, &resp_len, s->ud);
+    if (resp_len > kMaxFrame) { /* u32 prefix cannot carry it */
+      if (resp) sn_buf_free(resp);
+      close_conn(s, c);
+      return false;
+    }
+    if (resp && resp_len) {
+      uint32_t rl = (uint32_t)resp_len;
+      size_t pos = c->wbuf.size();
+      c->wbuf.resize(pos + 4 + resp_len);
+      memcpy(c->wbuf.data() + pos, &rl, 4);
+      memcpy(c->wbuf.data() + pos + 4, resp, resp_len);
+    }
+    if (resp) sn_buf_free(resp);
+    off += 4 + flen;
+    if (rc != 0) { c->closing = true; break; }
+  }
+  if (off) {
+    memmove(c->rbuf.data(), c->rbuf.data() + off, c->rlen - off);
+    c->rlen -= off;
+  }
+  if (!c->wbuf.empty() || c->closing) return do_write(s, c);
+  return true;
+}
+
+bool do_read(sn_server *s, Conn *c) {
+  for (;;) {
+    if (c->rbuf.size() - c->rlen < 65536) c->rbuf.resize(c->rlen + 262144);
+    ssize_t n = read(c->fd, c->rbuf.data() + c->rlen, c->rbuf.size() - c->rlen);
+    if (n > 0) {
+      c->rlen += (size_t)n;
+      if (!drain_frames(s, c)) return false;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return true;
+    } else { /* EOF or error */
+      close_conn(s, c);
+      return false;
+    }
+  }
+}
+
+void *loop(void *arg) {
+  sn_server *s = static_cast<sn_server *>(arg);
+  struct epoll_event evs[64];
+  while (!s->stop_flag) {
+    int n = epoll_wait(s->epoll_fd, evs, 64, 200);
+    for (int i = 0; i < n && !s->stop_flag; i++) {
+      if (evs[i].data.u64 == kWakeTag) {
+        uint64_t tmp;
+        ssize_t r = read(s->wake_fd, &tmp, 8);
+        (void)r;
+        continue;
+      }
+      if (evs[i].data.u64 == kListenTag) {
+        for (;;) {
+          int cfd = accept(s->listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn *c = new Conn();
+          c->fd = cfd;
+          s->conns[cfd] = c;
+          struct epoll_event cev;
+          cev.events = EPOLLIN;
+          cev.data.ptr = c;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, cfd, &cev);
+        }
+        continue;
+      }
+      Conn *c = static_cast<Conn *>(evs[i].data.ptr);
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) { close_conn(s, c); continue; }
+      if (evs[i].events & EPOLLOUT) {
+        if (!do_write(s, c)) continue;
+      }
+      if (evs[i].events & EPOLLIN) {
+        if (!do_read(s, c)) continue;
+      }
+    }
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+sn_server *sn_server_create(const char *bind_addr, uint16_t port,
+                            sn_handler_fn handler, void *ud) {
+  if (!handler) return nullptr;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  addr.sin_addr.s_addr =
+      bind_addr && *bind_addr ? inet_addr(bind_addr) : htonl(INADDR_LOOPBACK);
+  if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) < 0 ||
+      listen(fd, 512) < 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr *)&addr, &alen);
+  set_nonblock(fd);
+
+  sn_server *s = new sn_server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->handler = handler;
+  s->ud = ud;
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = kListenTag;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  struct epoll_event wev;
+  wev.events = EPOLLIN;
+  wev.data.u64 = kWakeTag;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &wev);
+  return s;
+}
+
+int sn_server_start(sn_server *s) {
+  if (!s || s->running) return -1;
+  s->stop_flag = 0;
+  if (pthread_create(&s->thread, nullptr, loop, s) != 0) return -1;
+  s->running = true;
+  return 0;
+}
+
+uint16_t sn_server_port(sn_server *s) { return s ? s->port : 0; }
+
+uint64_t sn_server_requests(sn_server *s) { return s ? s->n_requests : 0; }
+
+void sn_server_stop(sn_server *s) {
+  if (!s || !s->running) return;
+  s->stop_flag = 1;
+  uint64_t one = 1;
+  ssize_t r = write(s->wake_fd, &one, 8);
+  (void)r;
+  pthread_join(s->thread, nullptr);
+  s->running = false;
+}
+
+void sn_server_destroy(sn_server *s) {
+  if (!s) return;
+  sn_server_stop(s);
+  for (auto &kv : s->conns) {
+    close(kv.first);
+    delete kv.second;
+  }
+  s->conns.clear();
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  if (s->epoll_fd >= 0) close(s->epoll_fd);
+  if (s->wake_fd >= 0) close(s->wake_fd);
+  delete s;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,434 @@
+"""ctypes bindings for the native runtime core (``native/`` C++ library).
+
+The native layer provides the performance-critical runtime pieces that the
+reference implements in its JVM services (engine transport/event loop —
+``engine/src/main/java/io/seldon/engine/``) and its experimental FlatBuffers
+transport (``fbs/prediction.fbs``, ``wrappers/python/seldon_flatbuffers.py``):
+
+- :class:`FrameCodec` — zero-copy binary tensor framing ("SELF" frames),
+- :class:`NativeBatchQueue` — the dynamic batcher's admission core,
+- :class:`FramedServer` — epoll TCP server for the framed protocol.
+
+The shared library is built on demand with ``make`` (g++); import falls back
+gracefully (``HAVE_NATIVE = False``) so pure-Python deployments still work.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "HAVE_NATIVE",
+    "load",
+    "FrameCodec",
+    "Frame",
+    "NativeBatchQueue",
+    "FramedServer",
+    "MSG_PREDICT",
+    "MSG_RESPONSE",
+    "MSG_FEEDBACK",
+    "MSG_ERROR",
+    "MSG_PING",
+]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libseldon_native.so"))
+
+MSG_PREDICT, MSG_RESPONSE, MSG_FEEDBACK, MSG_ERROR, MSG_PING = 1, 2, 3, 4, 5
+
+MAX_TENSORS = 16
+MAX_NDIM = 8
+
+# dtype code <-> numpy mapping (mirrors seldon_native.h SN_DT_*)
+_DTYPES: list[tuple[int, str]] = [
+    (0, "float32"),
+    (1, "float64"),
+    (2, "bfloat16"),
+    (3, "float16"),
+    (4, "int8"),
+    (5, "int16"),
+    (6, "int32"),
+    (7, "int64"),
+    (8, "uint8"),
+    (9, "bool"),
+]
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+_CODE_TO_DTYPE = {code: name for code, name in _DTYPES}
+_DTYPE_TO_CODE = {name: code for code, name in _DTYPES}
+
+
+class _TensorDesc(C.Structure):
+    _fields_ = [
+        ("dtype", C.c_uint8),
+        ("ndim", C.c_uint8),
+        ("shape", C.c_int64 * MAX_NDIM),
+        ("nbytes", C.c_uint64),
+        ("payload_offset", C.c_uint64),
+    ]
+
+
+class _FrameView(C.Structure):
+    _fields_ = [
+        ("msg_type", C.c_uint8),
+        ("flags", C.c_uint16),
+        ("meta_len", C.c_uint32),
+        ("meta_offset", C.c_uint64),
+        ("n_tensors", C.c_uint16),
+        ("tensors", _TensorDesc * MAX_TENSORS),
+        ("frame_len", C.c_uint64),
+    ]
+
+
+class _BatcherConfig(C.Structure):
+    _fields_ = [
+        ("max_batch_rows", C.c_uint32),
+        ("max_delay_ns", C.c_uint64),
+        ("n_buckets", C.c_uint32),
+        ("buckets", C.c_uint32 * 16),
+    ]
+
+
+_HANDLER = C.CFUNCTYPE(
+    C.c_int,
+    C.POINTER(C.c_uint8),
+    C.c_uint64,
+    C.POINTER(C.POINTER(C.c_uint8)),
+    C.POINTER(C.c_uint64),
+    C.c_void_p,
+)
+
+_lib: Optional[C.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load() -> Optional[C.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        # Always (re)run make when sources are present: a no-op when the .so
+        # is current, and prevents silently loading a stale library after
+        # native/*.cc edits.
+        if os.path.isdir(_NATIVE_DIR):
+            try:
+                _build()
+            except Exception:
+                if not os.path.exists(_LIB_PATH):
+                    return None
+        elif not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = C.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        _bind(lib)
+        _lib = lib
+        return _lib
+
+
+def _bind(lib: C.CDLL) -> None:
+    u8p = C.POINTER(C.c_uint8)
+    lib.sn_frame_size.restype = C.c_uint64
+    lib.sn_frame_size.argtypes = [C.c_uint32, C.c_uint16, u8p, C.POINTER(C.c_uint64)]
+    lib.sn_frame_encode.restype = C.c_uint64
+    lib.sn_frame_encode.argtypes = [
+        u8p, C.c_uint64, C.c_uint8, C.c_uint16, C.c_char_p, C.c_uint32,
+        C.c_uint16, u8p, u8p, C.POINTER(C.c_int64), C.POINTER(u8p),
+        C.POINTER(C.c_uint64),
+    ]
+    lib.sn_frame_parse.restype = C.c_int
+    lib.sn_frame_parse.argtypes = [u8p, C.c_uint64, C.POINTER(_FrameView)]
+    lib.sn_dtype_itemsize.restype = C.c_int
+    lib.sn_dtype_itemsize.argtypes = [C.c_uint8]
+
+    lib.sn_batcher_create.restype = C.c_void_p
+    lib.sn_batcher_create.argtypes = [C.POINTER(_BatcherConfig)]
+    lib.sn_batcher_destroy.argtypes = [C.c_void_p]
+    lib.sn_batcher_submit.restype = C.c_int
+    lib.sn_batcher_submit.argtypes = [
+        C.c_void_p, C.c_uint64, C.c_uint32, C.c_uint32, C.c_uint64,
+    ]
+    for name in ("sn_batcher_next", "sn_batcher_wait_next"):
+        fn = getattr(lib, name)
+        fn.restype = C.c_int
+        fn.argtypes = [
+            C.c_void_p, C.c_uint64, C.POINTER(C.c_uint64),
+            C.POINTER(C.c_uint32), C.c_uint32, C.POINTER(C.c_uint32),
+            C.POINTER(C.c_uint32),
+        ]
+    lib.sn_batcher_pending.restype = C.c_uint32
+    lib.sn_batcher_pending.argtypes = [C.c_void_p]
+    lib.sn_batcher_next_deadline.restype = C.c_uint64
+    lib.sn_batcher_next_deadline.argtypes = [C.c_void_p]
+    lib.sn_now_ns.restype = C.c_uint64
+
+    lib.sn_buf_alloc.restype = C.POINTER(C.c_uint8)
+    lib.sn_buf_alloc.argtypes = [C.c_uint64]
+    lib.sn_buf_free.argtypes = [C.POINTER(C.c_uint8)]
+    lib.sn_server_create.restype = C.c_void_p
+    lib.sn_server_create.argtypes = [C.c_char_p, C.c_uint16, _HANDLER, C.c_void_p]
+    lib.sn_server_start.restype = C.c_int
+    lib.sn_server_start.argtypes = [C.c_void_p]
+    lib.sn_server_port.restype = C.c_uint16
+    lib.sn_server_port.argtypes = [C.c_void_p]
+    lib.sn_server_stop.argtypes = [C.c_void_p]
+    lib.sn_server_destroy.argtypes = [C.c_void_p]
+    lib.sn_server_requests.restype = C.c_uint64
+    lib.sn_server_requests.argtypes = [C.c_void_p]
+    lib.sn_echo_handler.restype = C.c_int
+
+
+HAVE_NATIVE = load() is not None
+
+
+class Frame:
+    """Parsed view of a SELF frame.  Tensor arrays are zero-copy views over
+    the receive buffer (kept alive by holding a reference to it)."""
+
+    def __init__(self, msg_type: int, meta: bytes, tensors: list[np.ndarray]):
+        self.msg_type = msg_type
+        self.meta = meta
+        self.tensors = tensors
+
+
+class FrameCodec:
+    """Encode/decode SELF frames via the native codec."""
+
+    def __init__(self):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+
+    def encode(
+        self,
+        msg_type: int,
+        meta: bytes = b"",
+        tensors: Sequence[np.ndarray] = (),
+        flags: int = 0,
+    ) -> bytes:
+        n = len(tensors)
+        if n > MAX_TENSORS:
+            raise ValueError(f"too many tensors ({n} > {MAX_TENSORS})")
+        arrs = [np.ascontiguousarray(t) for t in tensors]
+        dtypes = (C.c_uint8 * n)()
+        ndims = (C.c_uint8 * n)()
+        nbytes = (C.c_uint64 * n)()
+        shape_flat: list[int] = []
+        payloads = (C.POINTER(C.c_uint8) * n)()
+        for i, a in enumerate(arrs):
+            name = _canonical_dtype_name(a.dtype)
+            if name not in _DTYPE_TO_CODE:
+                raise ValueError(f"unsupported dtype {a.dtype}")
+            dtypes[i] = _DTYPE_TO_CODE[name]
+            ndims[i] = a.ndim
+            nbytes[i] = a.nbytes
+            shape_flat.extend(a.shape)
+            payloads[i] = a.ctypes.data_as(C.POINTER(C.c_uint8))
+        shapes = (C.c_int64 * max(len(shape_flat), 1))(*shape_flat)
+        size = self._lib.sn_frame_size(len(meta), n, ndims, nbytes)
+        if size == 0:
+            raise ValueError("invalid frame spec")
+        buf = C.create_string_buffer(size)
+        written = self._lib.sn_frame_encode(
+            C.cast(buf, C.POINTER(C.c_uint8)), size, msg_type, flags, meta,
+            len(meta), n, dtypes, ndims, shapes, payloads, nbytes,
+        )
+        if written == 0:
+            raise ValueError("frame encode failed")
+        return buf.raw[:written]
+
+    def decode(self, data: bytes) -> Frame:
+        view = _FrameView()
+        buf = np.frombuffer(data, dtype=np.uint8)  # zero-copy
+        rc = self._lib.sn_frame_parse(
+            buf.ctypes.data_as(C.POINTER(C.c_uint8)), len(data), C.byref(view)
+        )
+        if rc != 0:
+            raise ValueError(f"frame parse failed (code {rc})")
+        meta = bytes(
+            buf[view.meta_offset : view.meta_offset + view.meta_len]
+        )
+        tensors = []
+        for i in range(view.n_tensors):
+            t = view.tensors[i]
+            dt = _np_dtype(_CODE_TO_DTYPE[t.dtype])
+            shape = tuple(t.shape[d] for d in range(t.ndim))
+            off = t.payload_offset
+            arr = (
+                np.frombuffer(data, dtype=dt, count=t.nbytes // dt.itemsize,
+                              offset=off)
+                .reshape(shape)
+            )
+            tensors.append(arr)
+        return Frame(view.msg_type, meta, tensors)
+
+
+def _canonical_dtype_name(dtype: np.dtype) -> str:
+    name = np.dtype(dtype).name
+    return name
+
+
+class NativeBatchQueue:
+    """Thread-safe deadline/bucket batching queue backed by the C core."""
+
+    def __init__(
+        self,
+        max_batch_rows: int,
+        max_delay_s: float,
+        buckets: Sequence[int] = (),
+    ):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        cfg = _BatcherConfig()
+        cfg.max_batch_rows = max_batch_rows
+        cfg.max_delay_ns = int(max_delay_s * 1e9)
+        bs = sorted(buckets)
+        if len(bs) > 16:
+            raise ValueError("at most 16 buckets")
+        cfg.n_buckets = len(bs)
+        for i, b in enumerate(bs):
+            cfg.buckets[i] = b
+        self._h = self._lib.sn_batcher_create(C.byref(cfg))
+        if not self._h:
+            raise ValueError("invalid batcher config")
+        self._cap = 4096
+
+    def submit(self, req_id: int, nrows: int, lane: int = 0) -> None:
+        rc = self._lib.sn_batcher_submit(
+            self._h, req_id, nrows, lane, self._lib.sn_now_ns()
+        )
+        if rc != 0:
+            raise ValueError("submit rejected (nrows > max_batch_rows?)")
+
+    def next_batch(self) -> Optional[tuple[list[tuple[int, int]], int, int]]:
+        """Non-blocking: ([(req_id, nrows), ...], lane, bucket) or None."""
+        return self._pop(self._lib.sn_batcher_next, self._lib.sn_now_ns())
+
+    def wait_batch(
+        self, timeout_s: float
+    ) -> Optional[tuple[list[tuple[int, int]], int, int]]:
+        """Blocking (releases the GIL in C): waits up to timeout_s."""
+        return self._pop(self._lib.sn_batcher_wait_next, int(timeout_s * 1e9))
+
+    def _pop(self, fn, arg):
+        ids = (C.c_uint64 * self._cap)()
+        rows = (C.c_uint32 * self._cap)()
+        lane = C.c_uint32()
+        bucket = C.c_uint32()
+        n = fn(self._h, arg, ids, rows, self._cap, C.byref(lane), C.byref(bucket))
+        if n <= 0:
+            return None
+        return (
+            [(ids[i], rows[i]) for i in range(n)],
+            lane.value,
+            bucket.value,
+        )
+
+    @property
+    def pending(self) -> int:
+        return self._lib.sn_batcher_pending(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.sn_batcher_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class FramedServer:
+    """Epoll TCP server for the framed protocol.
+
+    ``handler(frame_bytes) -> response_bytes`` runs on the IO thread (ctypes
+    releases/reacquires the GIL around the C boundary).  With ``handler=None``
+    the built-in C echo handler serves — the pure-native transport path used
+    by the benchmarks.
+    """
+
+    def __init__(
+        self,
+        handler: Optional[Callable[[bytes], bytes]] = None,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+    ):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._py_handler = handler
+        if handler is None:
+            cfn = C.cast(self._lib.sn_echo_handler, _HANDLER)
+            self._cb = cfn  # keep alive
+        else:
+
+            def trampoline(req_p, req_len, resp_pp, resp_len_p, _ud):
+                try:
+                    req = C.string_at(req_p, req_len)
+                    out = handler(req)
+                except Exception:
+                    return 1  # close connection on handler error
+                if out:
+                    buf = self._lib.sn_buf_alloc(len(out))
+                    C.memmove(buf, out, len(out))
+                    resp_pp[0] = buf
+                    resp_len_p[0] = len(out)
+                return 0
+
+            self._cb = _HANDLER(trampoline)
+        self._h = self._lib.sn_server_create(
+            bind.encode(), port, self._cb, None
+        )
+        if not self._h:
+            raise OSError(f"failed to bind {bind}:{port}")
+
+    def start(self) -> "FramedServer":
+        if self._lib.sn_server_start(self._h) != 0:
+            raise OSError("failed to start server thread")
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._lib.sn_server_port(self._h)
+
+    @property
+    def requests(self) -> int:
+        return self._lib.sn_server_requests(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.sn_server_destroy(self._h)
+            self._h = None
+
+    def __enter__(self) -> "FramedServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

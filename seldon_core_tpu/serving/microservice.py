@@ -59,7 +59,8 @@ def load_annotations(path: str = ANNOTATIONS_FILE) -> dict:
 def main(argv: Optional[list] = None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("interface_name", help="module or module:Class of the user component")
-    ap.add_argument("api_type", nargs="?", default="REST", choices=["REST", "GRPC"])
+    ap.add_argument("api_type", nargs="?", default="REST",
+                    choices=["REST", "GRPC", "FRAMED"])
     ap.add_argument("--service-type", default=os.environ.get("SERVICE_TYPE", "MODEL"))
     ap.add_argument("--parameters",
                     default=os.environ.get("PREDICTIVE_UNIT_PARAMETERS", "[]"))
@@ -92,6 +93,18 @@ def main(argv: Optional[list] = None) -> None:
 
         asyncio.run(serve_grpc_component(handle, args.host, args.port,
                                          annotations=annotations))
+    elif args.api_type == "FRAMED":
+        # Native low-overhead transport (reference fbs path:
+        # wrappers/python/model_microservice.py:174-214).
+        import threading
+
+        from seldon_core_tpu.serving.framed import FramedComponentServer
+
+        srv = FramedComponentServer(handle, port=args.port, bind=args.host)
+        srv.start()
+        print(f"component {handle.name!r} serving FRAMED on "
+              f"{args.host}:{srv.port}", flush=True)
+        threading.Event().wait()
     else:
         asyncio.run(serve())
 

@@ -1,0 +1,231 @@
+"""Native runtime core: framing codec, batch queue, framed TCP server.
+
+The C++ layer replaces the reference's experimental FlatBuffers transport
+(fbs/prediction.fbs, wrappers/python/seldon_flatbuffers.py) and provides the
+batcher admission core.  Tests build the library on demand via `make` (g++).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.HAVE_NATIVE, reason="native library unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def codec():
+    return native.FrameCodec()
+
+
+class TestFrameCodec:
+    def test_roundtrip_multi_tensor(self, codec):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.array([1, 2, 3], dtype=np.int64)
+        meta = json.dumps({"names": ["x"]}).encode()
+        buf = codec.encode(native.MSG_PREDICT, meta=meta, tensors=[a, b])
+        frame = codec.decode(buf)
+        assert frame.msg_type == native.MSG_PREDICT
+        assert json.loads(frame.meta) == {"names": ["x"]}
+        np.testing.assert_array_equal(frame.tensors[0], a)
+        np.testing.assert_array_equal(frame.tensors[1], b)
+        assert frame.tensors[0].dtype == np.float32
+
+    def test_bfloat16_over_wire(self, codec):
+        import ml_dtypes
+
+        a = np.asarray([[1.5, -2.25]], dtype=ml_dtypes.bfloat16)
+        frame = codec.decode(codec.encode(native.MSG_RESPONSE, tensors=[a]))
+        assert frame.tensors[0].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            frame.tensors[0].astype(np.float32), a.astype(np.float32)
+        )
+
+    def test_payloads_are_64B_aligned_zero_copy_views(self, codec):
+        a = np.ones((5, 7), dtype=np.float64)
+        buf = codec.encode(native.MSG_PREDICT, meta=b"x" * 13, tensors=[a])
+        frame = codec.decode(buf)
+        t = frame.tensors[0]
+        # the view must point into the receive buffer, not a copy
+        assert t.base is not None
+        addr = t.__array_interface__["data"][0]
+        base_addr = np.frombuffer(buf, dtype=np.uint8).__array_interface__[
+            "data"
+        ][0]
+        assert (addr - base_addr) % 64 == 0
+
+    def test_corrupt_frames_rejected(self, codec):
+        a = np.zeros(4, dtype=np.float32)
+        buf = bytearray(codec.encode(native.MSG_PREDICT, tensors=[a]))
+        with pytest.raises(ValueError):
+            codec.decode(bytes(buf[: len(buf) // 2]))  # truncated
+        buf[0] ^= 0xFF  # bad magic
+        with pytest.raises(ValueError):
+            codec.decode(bytes(buf))
+
+    def test_empty_frame(self, codec):
+        frame = codec.decode(codec.encode(native.MSG_PING))
+        assert frame.msg_type == native.MSG_PING
+        assert frame.tensors == [] and frame.meta == b""
+
+
+class TestNativeBatchQueue:
+    def test_flush_on_full_bucket(self):
+        q = native.NativeBatchQueue(8, max_delay_s=10.0, buckets=[4, 8])
+        for i in range(4):
+            q.submit(i, nrows=2)
+        got = q.next_batch()
+        assert got is not None
+        items, _lane, bucket = got
+        assert [r for _, r in items] == [2, 2, 2, 2]
+        assert bucket == 8
+        assert q.pending == 0
+        q.close()
+
+    def test_flush_on_deadline(self):
+        q = native.NativeBatchQueue(64, max_delay_s=0.02, buckets=[16, 64])
+        q.submit(7, nrows=3)
+        assert q.next_batch() is None  # not full, not expired
+        got = q.wait_batch(timeout_s=1.0)
+        assert got is not None
+        items, _lane, bucket = got
+        assert items == [(7, 3)]
+        assert bucket == 16  # smallest bucket >= 3 rows
+        q.close()
+
+    def test_lanes_do_not_mix(self):
+        q = native.NativeBatchQueue(4, max_delay_s=10.0)
+        q.submit(1, nrows=2, lane=11)
+        q.submit(2, nrows=2, lane=22)
+        assert q.next_batch() is None  # neither lane full
+        q.submit(3, nrows=2, lane=11)
+        items, lane, _ = q.next_batch()
+        assert lane == 11 and [i for i, _ in items] == [1, 3]
+        q.close()
+
+    def test_oversize_request_rejected(self):
+        q = native.NativeBatchQueue(4, max_delay_s=0.1)
+        with pytest.raises(ValueError):
+            q.submit(1, nrows=5)
+        q.close()
+
+    def test_wait_unblocks_from_other_thread(self):
+        q = native.NativeBatchQueue(2, max_delay_s=5.0)
+        result = {}
+
+        def waiter():
+            result["batch"] = q.wait_batch(timeout_s=2.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        q.submit(1, nrows=2)  # fills the bucket -> signals the waiter
+        t.join(timeout=2.0)
+        assert result["batch"] is not None
+        q.close()
+
+
+class TestFramedServer:
+    def test_echo_handler_roundtrip(self, codec):
+        from seldon_core_tpu.serving.framed import FramedClient
+
+        a = np.arange(8, dtype=np.float32)
+        with native.FramedServer() as srv:  # built-in C echo handler
+            with FramedClient(port=srv.port) as cli:
+                req = codec.encode(native.MSG_PREDICT, tensors=[a])
+                resp = cli.ping_raw(req)
+                frame = codec.decode(resp)
+                assert frame.msg_type == native.MSG_RESPONSE
+                np.testing.assert_array_equal(frame.tensors[0], a)
+            assert srv.requests >= 1
+
+    def test_python_handler_component(self):
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.framed import (
+            FramedClient,
+            FramedComponentServer,
+        )
+
+        class Doubler:
+            def predict(self, X, names):
+                return X * 2
+
+        handle = ComponentHandle(Doubler(), name="doubler")
+        with FramedComponentServer(handle) as srv:
+            with FramedClient(port=srv.port) as cli:
+                msg = SeldonMessage.from_ndarray(
+                    np.array([[1.0, 2.0]], dtype=np.float32), names=["a", "b"]
+                )
+                out = cli.predict(msg)
+                np.testing.assert_array_equal(
+                    out.host_data(), [[2.0, 4.0]]
+                )
+
+    def test_error_path_closes_cleanly(self):
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.framed import (
+            FramedClient,
+            FramedComponentServer,
+        )
+
+        class Broken:
+            def predict(self, X, names):
+                raise RuntimeError("boom")
+
+        handle = ComponentHandle(Broken(), name="broken")
+        with FramedComponentServer(handle) as srv:
+            with FramedClient(port=srv.port) as cli:
+                with pytest.raises(RuntimeError, match="boom"):
+                    cli.predict(
+                        SeldonMessage.from_ndarray(np.zeros((1, 2), np.float32))
+                    )
+
+    def test_feedback_roundtrip(self):
+        from seldon_core_tpu.messages import Feedback, SeldonMessage
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.framed import (
+            FramedClient,
+            FramedComponentServer,
+        )
+
+        seen = {}
+
+        class Learner:
+            def predict(self, X, names):
+                return X
+
+            def send_feedback(self, request, names, reward, truth, routing=None):
+                seen["reward"] = reward
+
+        handle = ComponentHandle(Learner(), name="learner")
+        with FramedComponentServer(handle) as srv:
+            with FramedClient(port=srv.port) as cli:
+                fb = Feedback(
+                    request=SeldonMessage.from_ndarray(
+                        np.ones((1, 2), np.float32)
+                    ),
+                    reward=0.75,
+                )
+                cli.send_feedback(fb)
+        assert seen["reward"] == 0.75
+
+    def test_many_requests_single_connection(self, codec):
+        from seldon_core_tpu.serving.framed import FramedClient
+
+        with native.FramedServer() as srv:
+            with FramedClient(port=srv.port) as cli:
+                req = codec.encode(
+                    native.MSG_PREDICT,
+                    tensors=[np.zeros((4, 16), np.float32)],
+                )
+                for _ in range(200):
+                    cli.ping_raw(req)
+            assert srv.requests >= 200
