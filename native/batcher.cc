@@ -67,6 +67,9 @@ sn_batcher *sn_batcher_create(const sn_batcher_config *cfg) {
       b->buckets.push_back(cfg->buckets[i]);
     for (size_t i = 1; i < b->buckets.size(); i++)
       if (b->buckets[i] < b->buckets[i - 1]) { delete b; return nullptr; }
+    /* no bucket may exceed max_batch_rows: the device loop compiles padded
+     * executables up to the max, so a larger bucket is a config error */
+    if (b->buckets.back() > cfg->max_batch_rows) { delete b; return nullptr; }
     /* invariant: some bucket covers any poppable batch (<= max_batch_rows) */
     if (b->buckets.back() < cfg->max_batch_rows)
       b->buckets.push_back(cfg->max_batch_rows);
@@ -104,6 +107,10 @@ static int pop_locked(sn_batcher *b, uint64_t now_ns, uint64_t *out_ids,
                       uint32_t *out_rows, uint32_t cap, uint32_t *out_lane,
                       uint32_t *out_bucket) {
   const uint32_t max_rows = b->cfg.max_batch_rows;
+  /* pick the flushable lane with the oldest front request — hash order
+   * would let a continually-full lane starve other lanes past deadline */
+  Lane *best = nullptr;
+  uint32_t best_id = 0;
   for (auto &kv : b->lanes) {
     Lane &lane = kv.second;
     if (lane.q.empty()) continue;
@@ -111,30 +118,34 @@ static int pop_locked(sn_batcher *b, uint64_t now_ns, uint64_t *out_ids,
     bool timed_out =
         now_ns >= lane.q.front().arrival_ns + b->cfg.max_delay_ns;
     if (!full && !timed_out) continue;
-
-    /* pop whole requests while they fit under max_rows */
-    int n = 0;
-    uint32_t rows = 0;
-    while (!lane.q.empty() && (uint32_t)n < cap) {
-      Pending &p = lane.q.front();
-      if (rows + p.nrows > max_rows) break;
-      out_ids[n] = p.req_id;
-      out_rows[n] = p.nrows;
-      rows += p.nrows;
-      lane.rows -= p.nrows;
-      b->pending--;
-      lane.q.pop_front();
-      n++;
+    if (!best || lane.q.front().arrival_ns < best->q.front().arrival_ns) {
+      best = &lane;
+      best_id = kv.first;
     }
-    if (n == 0) continue; /* single request larger than cap */
-    *out_lane = kv.first;
-    uint32_t bucket = b->buckets.back();
-    for (uint32_t bk : b->buckets)
-      if (bk >= rows) { bucket = bk; break; }
-    *out_bucket = bucket;
-    return n;
   }
-  return 0;
+  if (!best) return 0;
+
+  /* pop whole requests while they fit under max_rows */
+  int n = 0;
+  uint32_t rows = 0;
+  while (!best->q.empty() && (uint32_t)n < cap) {
+    Pending &p = best->q.front();
+    if (rows + p.nrows > max_rows) break;
+    out_ids[n] = p.req_id;
+    out_rows[n] = p.nrows;
+    rows += p.nrows;
+    best->rows -= p.nrows;
+    b->pending--;
+    best->q.pop_front();
+    n++;
+  }
+  if (n == 0) return 0; /* cap smaller than the first request */
+  *out_lane = best_id;
+  uint32_t bucket = b->buckets.back();
+  for (uint32_t bk : b->buckets)
+    if (bk >= rows) { bucket = bk; break; }
+  *out_bucket = bucket;
+  return n;
 }
 
 int sn_batcher_next(sn_batcher *b, uint64_t now_ns, uint64_t *out_ids,

@@ -21,6 +21,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <unordered_map>
 #include <vector>
 
@@ -51,9 +52,9 @@ struct sn_server {
   sn_handler_fn handler = nullptr;
   void *ud = nullptr;
   pthread_t thread{};
-  bool running = false;
-  volatile int stop_flag = 0;
-  uint64_t n_requests = 0;
+  bool running = false; /* touched only by the controlling thread */
+  std::atomic<int> stop_flag{0};
+  std::atomic<uint64_t> n_requests{0};
   std::unordered_map<int, Conn *> conns;
 };
 
@@ -270,7 +271,9 @@ int sn_server_start(sn_server *s) {
 
 uint16_t sn_server_port(sn_server *s) { return s ? s->port : 0; }
 
-uint64_t sn_server_requests(sn_server *s) { return s ? s->n_requests : 0; }
+uint64_t sn_server_requests(sn_server *s) {
+  return s ? s->n_requests.load() : 0;
+}
 
 void sn_server_stop(sn_server *s) {
   if (!s || !s->running) return;

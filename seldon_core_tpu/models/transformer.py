@@ -62,6 +62,10 @@ class TransformerConfig:
     # region (Shardy limitation); use seq_shard+dense with pp, ring when pp=1.
     seq_shard: bool = True
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
+    # Pallas flash-attention kernel (ops/attention.py) on the dense path:
+    # O(L) memory, scores never hit HBM.  Off on sharded meshes — GSPMD
+    # can't partition through pallas_call; ring attention covers that case.
+    use_flash: bool = False
 
     @property
     def d_head(self) -> int:
@@ -223,7 +227,12 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None):
         q = c(q, "dp", None, "tp", None)
         k = c(k, "dp", None, "tp", None)
         v = c(v, "dp", None, "tp", None)
-        attn = dense_attention(q, k, v, causal=True)
+        if cfg.use_flash and mesh is None:
+            from seldon_core_tpu.ops.attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True)
+        else:
+            attn = dense_attention(q, k, v, causal=True)
     out = jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(x.dtype))
     # SP: reduce-scatter the row-parallel output back to sequence shards
     out = c(out, "dp", _seq_axis(cfg) if cfg.attention != "ring" else None, None)

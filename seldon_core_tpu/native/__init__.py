@@ -117,11 +117,21 @@ _lib_lock = threading.Lock()
 
 
 def _build() -> None:
-    subprocess.run(
-        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-        check=True,
-        capture_output=True,
-    )
+    # flock so concurrent imports (pytest-xdist, multi-worker servers) don't
+    # race make on the same .o/.so files
+    import fcntl
+
+    lock_path = os.path.join(os.path.abspath(_NATIVE_DIR), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+            )
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def load() -> Optional[C.CDLL]:
@@ -426,6 +436,14 @@ class FramedServer:
         if self._h:
             self._lib.sn_server_destroy(self._h)
             self._h = None
+
+    def __del__(self):  # pragma: no cover
+        # The IO thread holds a pointer to self._cb; letting GC free the
+        # callback while the thread lives would be a use-after-free.
+        try:
+            self.stop()
+        except Exception:
+            pass
 
     def __enter__(self) -> "FramedServer":
         return self.start()

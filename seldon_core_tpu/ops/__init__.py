@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for the serving hot path.
+
+- :mod:`seldon_core_tpu.ops.attention` — flash attention (online softmax,
+  O(L) memory) for the dense attention path.
+- :mod:`seldon_core_tpu.ops.quant` — int8 weight-quantized matmul (dynamic
+  per-row activation quantization, int8 MXU accumulation).
+
+All kernels run in interpreter mode off-TPU so the CPU test suite exercises
+the same code paths that compile on hardware.
+"""
+
+from seldon_core_tpu.ops.attention import flash_attention, use_interpret
+from seldon_core_tpu.ops.quant import QuantizedLinear, int8_matmul, quantize_int8
+
+__all__ = [
+    "flash_attention",
+    "use_interpret",
+    "QuantizedLinear",
+    "int8_matmul",
+    "quantize_int8",
+]

@@ -1,0 +1,168 @@
+"""Pallas flash attention for the serving hot path.
+
+The reference has no accelerator kernels at all (SURVEY.md §2: no C++/CUDA in
+the repo — its "models" are user containers).  Here the model runtime itself
+owns the FLOPs, so the attention inner loop is a first-class TPU kernel:
+
+- online-softmax flash attention over (block_q, block_k) tiles — O(L) memory,
+  never materializes the (L, L) score matrix in HBM;
+- q/k/v tiles staged in VMEM, scores computed on the MXU in float32
+  (``preferred_element_type``), accumulator carried across the k-grid in VMEM
+  scratch;
+- causal masking skips fully-masked k-blocks via the grid (no wasted MXU
+  work past the diagonal);
+- runs in interpreter mode off-TPU so CPU tests exercise the same code path.
+
+Layout matches the flagship transformer: ``(batch, seq, heads, d_head)``
+(seldon_core_tpu/models/transformer.py, parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "use_interpret"]
+
+NEG_INF = -1e30
+_LANES = 128  # m/l scratch lane width (TPU min tile)
+
+
+def use_interpret() -> bool:
+    """Pallas kernels compile only on TPU; elsewhere run interpreted."""
+    return jax.default_backend() != "tpu"
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: a k-block is live iff its first key index <= last query index.
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)  # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # (block_q, 1)
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        # fully-masked rows (can't happen causally, but guard) divide by 1
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_bhld(q, k, v, causal: bool, scale: float, block_q: int,
+                block_k: int, interpret: bool):
+    """Flash attention over (BH, L, d) with L divisible by the blocks."""
+    BH, L, d = q.shape
+    n_q = L // block_q
+    n_k = L // block_k
+    grid = (BH, n_q, n_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, L, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * BH * L * L * d,
+            bytes_accessed=(3 * BH * L * d + BH * L * d) * q.dtype.itemsize,
+            transcendentals=BH * L * L,
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention on ``(batch, seq, heads, d_head)`` tensors.
+
+    Falls back to the dense reference path when the sequence doesn't tile
+    (shorter than a block and not divisible) — the caller never has to
+    special-case shapes.
+    """
+    B, L, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    if interpret is None:
+        interpret = use_interpret()
+    block_q = min(block_q, L)
+    block_k = min(block_k, L)
+    if L % block_q or L % block_k:
+        from seldon_core_tpu.parallel.ring_attention import dense_attention
+
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    # (B, L, H, D) -> (B*H, L, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    out = _flash_bhld(qt, kt, vt, causal, float(scale), block_q, block_k,
+                      bool(interpret))
+    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,128 @@
+"""Int8 weight-quantized matmul kernel for serving.
+
+Serving is memory-bandwidth bound: weights stream HBM→VMEM every step, so
+int8 weights halve (vs bf16) the bytes on the bottleneck path.  Design:
+
+- **offline**: per-output-channel symmetric quantization of weights
+  (:func:`quantize_int8`) — absmax/127 scale per column;
+- **online**: per-row dynamic quantization of activations inside the kernel,
+  int8×int8 matmul on the MXU accumulating in int32, then a single
+  f32 rescale by (row_scale × col_scale).
+
+The reference framework has no quantization story at all; its wire tensor is
+float64-only (proto/prediction.proto:31-34).  Interpreter mode covers CPU
+tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seldon_core_tpu.ops.attention import use_interpret
+
+__all__ = ["QuantizedLinear", "quantize_int8", "int8_matmul"]
+
+
+class QuantizedLinear(NamedTuple):
+    """Per-output-channel symmetric int8 weight."""
+
+    values: jax.Array  # (K, N) int8
+    scales: jax.Array  # (N,) float32
+
+
+def quantize_int8(w) -> QuantizedLinear:
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)  # (N,)
+    scales = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w / scales), -127, 127).astype(jnp.int8)
+    return QuantizedLinear(values=q, scales=scales.astype(jnp.float32))
+
+
+def _int8_kernel(x_ref, w_ref, ws_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)  # (bm, K)
+    # dynamic per-row activation quantization
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)  # (bm, 1)
+    xs = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    xq = jnp.clip(jnp.round(x / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (bm, bn) int32
+    o_ref[:] = (acc.astype(jnp.float32) * xs * ws_ref[0]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "block_n", "out_dtype", "interpret")
+)
+def _int8_matmul(x, wq, ws, block_m: int, block_n: int, out_dtype,
+                 interpret: bool):
+    M, K = x.shape
+    _, N = wq.shape
+    grid = (M // block_m, N // block_n)
+    return pl.pallas_call(
+        _int8_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, K), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, block_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * M * N * K,
+            bytes_accessed=M * K * x.dtype.itemsize + K * N + M * N * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x, wq, ws)
+
+
+def int8_matmul(
+    x,
+    w: QuantizedLinear,
+    block_m: int = 128,
+    block_n: int = 128,
+    out_dtype=None,
+    interpret: Optional[bool] = None,
+):
+    """``x @ dequant(w)`` with int8 MXU compute.
+
+    ``x``: (..., K) activations.  Shapes that don't tile fall back to a
+    dequantized jnp matmul (still int8 weights in HBM — the bandwidth win —
+    just no int8 MXU path).
+    """
+    if interpret is None:
+        interpret = use_interpret()
+    if out_dtype is None:
+        out_dtype = x.dtype
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    N = w.values.shape[1]
+    bm = min(block_m, M)
+    bn = min(block_n, N)
+    if M % bm or N % bn:
+        out = jnp.dot(
+            x2.astype(jnp.float32),
+            w.values.astype(jnp.float32) * w.scales[None, :],
+            preferred_element_type=jnp.float32,
+        ).astype(out_dtype)
+    else:
+        out = _int8_matmul(x2, w.values, w.scales.reshape(1, N), bm, bn,
+                           jnp.dtype(out_dtype), bool(interpret))
+    return out.reshape(*lead, N)

@@ -135,6 +135,17 @@ def decode_feedback(frame: Frame) -> Feedback:
     return fb
 
 
+def _writable(msg: SeldonMessage) -> None:
+    """Zero-copy decode yields read-only views over the receive buffer; user
+    components may mutate their input in place (the REST/GRPC transports hand
+    them writable arrays), so copy-on-dispatch before user code sees it.
+    Device placement (``jax.device_put``) takes the read-only view directly.
+    """
+    d = msg.data
+    if isinstance(d, np.ndarray) and not d.flags.writeable:
+        msg.data = np.array(d)
+
+
 class FramedComponentServer:
     """Serve a ComponentHandle (or GraphEngine) over the framed protocol."""
 
@@ -159,12 +170,16 @@ class FramedComponentServer:
 
     def _dispatch_predict(self, msg: SeldonMessage) -> SeldonMessage:
         t = self._target
+        _writable(msg)
         if hasattr(t, "predict_sync"):  # GraphEngine
             return t.predict_sync(msg)
         return t.predict(msg)
 
     def _dispatch_feedback(self, fb: Feedback) -> SeldonMessage:
         t = self._target
+        for part in (fb.request, fb.response, fb.truth):
+            if part is not None:
+                _writable(part)
         if hasattr(t, "send_feedback_sync"):  # GraphEngine
             return t.send_feedback_sync(fb)
         out = t.send_feedback(fb)

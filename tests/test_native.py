@@ -109,6 +109,19 @@ class TestNativeBatchQueue:
         assert lane == 11 and [i for i, _ in items] == [1, 3]
         q.close()
 
+    def test_bucket_above_max_rejected(self):
+        with pytest.raises(ValueError):
+            native.NativeBatchQueue(8, max_delay_s=0.1, buckets=[4, 16])
+
+    def test_starved_lane_flushes_first(self):
+        # hot lane full, cold lane deadline-expired: cold (older) pops first
+        q = native.NativeBatchQueue(2, max_delay_s=0.0)
+        q.submit(100, nrows=1, lane=5)  # cold, oldest
+        q.submit(1, nrows=2, lane=1)    # hot, full
+        _items, lane, _ = q.next_batch()
+        assert lane == 5
+        q.close()
+
     def test_oversize_request_rejected(self):
         q = native.NativeBatchQueue(4, max_delay_s=0.1)
         with pytest.raises(ValueError):

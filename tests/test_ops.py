@@ -1,0 +1,125 @@
+"""Pallas kernels: flash attention + int8 matmul (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.ops import (
+    QuantizedLinear,
+    flash_attention,
+    int8_matmul,
+    quantize_int8,
+)
+from seldon_core_tpu.parallel.ring_attention import dense_attention
+
+
+def _qkv(key, B=2, L=256, H=4, D=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, L, H, D), dtype)
+    k = jax.random.normal(kk, (B, L, H, D), dtype)
+    v = jax.random.normal(kv, (B, L, H, D), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    def test_matches_dense_causal(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        out = flash_attention(q, k, v, causal=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_matches_dense_noncausal(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), L=128)
+        out = flash_attention(q, k, v, causal=False)
+        ref = dense_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_multiple_k_blocks_online_softmax(self):
+        # L=512 with block 128 → 4 k-blocks: exercises the running
+        # max/sum rescaling across iterations.
+        q, k, v = _qkv(jax.random.PRNGKey(2), B=1, L=512, H=2)
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_ragged_length_falls_back(self):
+        q, k, v = _qkv(jax.random.PRNGKey(3), L=100)  # not divisible by 128
+        out = flash_attention(q, k, v, causal=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_bfloat16_io(self):
+        q, k, v = _qkv(jax.random.PRNGKey(4), L=128, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32), atol=3e-2
+        )
+
+    def test_transformer_flash_config_matches_dense(self):
+        from seldon_core_tpu.models.transformer import (
+            TransformerConfig,
+            forward,
+            init_params,
+        )
+
+        base = dict(vocab_size=64, d_model=64, n_layers=1, n_heads=2,
+                    d_ff=128, max_seq=128, dtype=jnp.float32, seq_shard=False)
+        cfg_d = TransformerConfig(**base)
+        cfg_f = TransformerConfig(**base, use_flash=True)
+        params = init_params(jax.random.PRNGKey(0), cfg_d)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (1, 128), 0, 64)
+        logits_d, _ = forward(params, ids, cfg_d)
+        logits_f, _ = forward(params, ids, cfg_f)
+        np.testing.assert_allclose(logits_d, logits_f, atol=2e-4, rtol=2e-4)
+
+
+class TestInt8Matmul:
+    def test_quantize_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        qw = quantize_int8(w)
+        deq = qw.values.astype(jnp.float32) * qw.scales[None, :]
+        # symmetric absmax/127: per-column error <= scale/2
+        err = jnp.abs(deq - w)
+        assert float(jnp.max(err / qw.scales[None, :])) <= 0.5 + 1e-6
+
+    def test_matmul_close_to_f32(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (128, 256))
+        w = jax.random.normal(jax.random.PRNGKey(2), (256, 128))
+        out = int8_matmul(x, quantize_int8(w))
+        ref = x @ w
+        # int8 dynamic quant: ~1% relative error on random gaussians
+        rel = float(
+            jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref)
+        )
+        assert rel < 0.02, rel
+
+    def test_ragged_shapes_fall_back(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (5, 96))
+        w = jax.random.normal(jax.random.PRNGKey(4), (96, 33))
+        out = int8_matmul(x, quantize_int8(w))
+        assert out.shape == (5, 33)
+        rel = float(jnp.linalg.norm(out - x @ w) / jnp.linalg.norm(x @ w))
+        assert rel < 0.02
+
+    def test_batched_leading_dims(self):
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 64, 128))
+        w = jax.random.normal(jax.random.PRNGKey(6), (128, 128))
+        out = int8_matmul(x, quantize_int8(w))
+        assert out.shape == (2, 64, 128)
+
+    def test_zero_column_weight(self):
+        w = jnp.zeros((32, 128))
+        qw = quantize_int8(w)
+        x = jnp.ones((128, 32))
+        out = int8_matmul(x, qw)
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+    def test_namedtuple_is_pytree(self):
+        w = jax.random.normal(jax.random.PRNGKey(7), (64, 64))
+        qw = quantize_int8(w)
+        leaves = jax.tree.leaves(qw)
+        assert len(leaves) == 2
+        assert isinstance(qw, QuantizedLinear)
