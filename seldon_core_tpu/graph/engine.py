@@ -77,12 +77,16 @@ class GraphEngine:
         resolver: Optional[Callable[[PredictiveUnit], NodeImpl]] = None,
         name: str = "predictor",
         metrics_sink: Optional[Any] = None,
+        tracer: Optional[Any] = None,
     ):
+        from seldon_core_tpu.utils.tracing import NULL_TRACER
+
         self.name = name
         self.spec = parse_graph(graph)
         validate_graph(self.spec)
         self._resolver = resolver
         self.metrics = metrics_sink  # duck: .observe_node(name, secs), .merge_custom(metrics)
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.root = self._build(self.spec)
         self._nodes: dict[str, _Node] = {}
         self._index(self.root)
@@ -123,7 +127,8 @@ class GraphEngine:
         if not meta.puid:
             meta.puid = new_puid()
         try:
-            out = await self._walk(self.root, request, meta)
+            with self.tracer.trace(meta.puid, graph=self.name):
+                out = await self._walk(self.root, request, meta)
         except SeldonComponentError as e:
             return SeldonMessage(
                 status=Status.failure(e.status_code, str(e), e.reason), meta=meta
@@ -162,6 +167,13 @@ class GraphEngine:
         meta.request_path[unit.name] = unit.implementation or type(
             getattr(impl, "user", impl)
         ).__name__
+        with self.tracer.span(unit.name, kind=node.type):
+            return await self._walk_traced(node, msg, meta)
+
+    async def _walk_traced(
+        self, node: _Node, msg: SeldonMessage, meta: Meta
+    ) -> SeldonMessage:
+        unit, impl = node.unit, node.impl
 
         # 1. transformInput: MODEL.predict / TRANSFORMER.transform_input
         #    (type→method map, PredictorConfigBean.java:45-99)

@@ -17,6 +17,7 @@ Two jobs:
 from __future__ import annotations
 
 import importlib
+import os
 import random
 from typing import Any, Optional
 
@@ -107,7 +108,20 @@ class LocalPredictor:
             resolver=lambda u: resolve_component(u, ann, self.metrics.registry),
             name=pred.name,
             metrics_sink=self.metrics,
+            tracer=_tracer_from_config(ann),
         )
+
+
+def _tracer_from_config(ann: dict):
+    """Tracing knob: annotation ``seldon.io/tracing`` ("true"/"1") or env
+    ``SELDON_TRACING=1``; ``seldon.io/tracing-max`` caps the ring."""
+    flag = str(ann.get("seldon.io/tracing",
+                       os.environ.get("SELDON_TRACING", ""))).lower()
+    if flag not in ("1", "true", "yes"):
+        return None
+    from seldon_core_tpu.utils.tracing import Tracer
+
+    return Tracer(max_traces=int(ann.get("seldon.io/tracing-max", 256)))
 
 
 class LocalDeployment:

@@ -1,0 +1,299 @@
+"""State persistence for learning components (routers, outlier detectors).
+
+Reference semantics (``wrappers/python/persistence.py:1-58``): pickle the
+user object to Redis under ``persistence_<deployment>_<predictor>_<unit>``
+on a timer thread (``push_frequency`` seconds, default 60), restore on boot.
+
+TPU-native redesign:
+
+- **state, not object**: components that expose ``get_state()/set_state()``
+  (e.g. graph/builtins.py EpsilonGreedy) persist just their mutable state —
+  jnp arrays included — instead of pickling the whole object.  Pickle of the
+  full object remains the fallback for components without the protocol.
+- **pytree-aware**: device arrays are pulled to host and stored as npz
+  entries, so MAB value estimates living in HBM checkpoint cleanly; an
+  orbax-backed store handles large sharded pytrees.
+- **pluggable stores**: file (atomic tmp+rename — the k8s-native choice is a
+  PVC mount, no Redis pod needed), in-memory (tests), orbax (sharded).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Optional, Protocol
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "StateStore",
+    "FileStateStore",
+    "MemoryStateStore",
+    "OrbaxStateStore",
+    "persistence_key",
+    "PersistenceManager",
+]
+
+DEFAULT_PUSH_FREQUENCY = 60.0  # seconds (reference persistence.py:14)
+
+
+def persistence_key(deployment: str, predictor: str, unit: str) -> str:
+    """Reference key format (``persistence.py:29-31``)."""
+    return f"persistence_{deployment}_{predictor}_{unit}"
+
+
+class StateStore(Protocol):
+    def save(self, key: str, blob: bytes) -> None: ...
+
+    def load(self, key: str) -> Optional[bytes]: ...
+
+
+class MemoryStateStore:
+    """In-process store (tests / single-process local runner)."""
+
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+
+    def save(self, key: str, blob: bytes) -> None:
+        self._data[key] = blob
+
+    def load(self, key: str) -> Optional[bytes]:
+        return self._data.get(key)
+
+
+class FileStateStore:
+    """One file per key under a root dir (a PVC in k8s).  Atomic writes."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "_")
+        return os.path.join(self.root, f"{safe}.state")
+
+    def save(self, key: str, blob: bytes) -> None:
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load(self, key: str) -> Optional[bytes]:
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class OrbaxStateStore:
+    """Orbax-backed store for large / sharded pytree state.
+
+    The blob protocol stays bytes-in/bytes-out at this layer; orbax handles
+    the pytree under the hood via a staging deserialization.  Use for
+    learning components whose state is a big sharded pytree (e.g. an
+    on-device bandit over many arms); for small states FileStateStore is
+    leaner.
+    """
+
+    def __init__(self, root: str):
+        import orbax.checkpoint as ocp
+
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._ckpt = ocp.PyTreeCheckpointer()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "_"))
+
+    def save(self, key: str, blob: bytes) -> None:
+        import shutil
+
+        if blob[:5] == _STATE_MAGIC:
+            state = _unpack_state(blob)
+        else:
+            # pickle-fallback blobs (components without get_state/set_state)
+            # ride through as a raw byte leaf
+            state = {"__raw_blob__": np.frombuffer(blob, np.uint8).copy()}
+        path = self._path(key)
+        tmp, old = f"{path}.tmp", f"{path}.old"
+        for d in (tmp, old):
+            if os.path.exists(d):
+                shutil.rmtree(d)
+        self._ckpt.save(tmp, state)
+        # crash-safe swap: the committed copy survives every window —
+        # path or path.old exists at all times (load() checks both)
+        if os.path.exists(path):
+            os.replace(path, old)
+        os.replace(tmp, path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+
+    def load(self, key: str) -> Optional[bytes]:
+        path = self._path(key)
+        if not os.path.exists(path):
+            old = f"{path}.old"  # crashed mid-swap: fall back
+            if not os.path.exists(old):
+                return None
+            path = old
+        state = self._ckpt.restore(path)
+        if isinstance(state, dict) and set(state) == {"__raw_blob__"}:
+            return np.asarray(state["__raw_blob__"], np.uint8).tobytes()
+        return _pack_state(state)
+
+
+# ---- state blob codec --------------------------------------------------
+#
+# v1 blob: b"SNST1" + npz(numpy leaves) + pickle(treedef w/ leaf markers)
+# fallback blob: b"SNPK1" + pickle(whole user object)
+
+_STATE_MAGIC = b"SNST1"
+_PICKLE_MAGIC = b"SNPK1"
+
+
+def _to_host(x: Any) -> Any:
+    if type(x).__module__.startswith("jax") or hasattr(x, "addressable_shards"):
+        return np.asarray(x)
+    return x
+
+
+def _pack_state(state: Any) -> bytes:
+    """Flatten a pytree state; numpy/jax leaves go in an npz, the structure
+    (with leaf placeholders) is pickled alongside."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(state)
+    arrays: dict[str, np.ndarray] = {}
+    markers: list[Any] = []
+    for i, leaf in enumerate(leaves):
+        host = _to_host(leaf)
+        if isinstance(host, np.ndarray):
+            arrays[f"a{i}"] = host
+            markers.append(("__array__", i))
+        else:
+            markers.append(("__obj__", host))
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    npz = buf.getvalue()
+    tail = pickle.dumps((markers, treedef))
+    return _STATE_MAGIC + len(npz).to_bytes(8, "little") + npz + tail
+
+
+def _unpack_state(blob: bytes) -> Any:
+    import jax
+
+    assert blob[:5] == _STATE_MAGIC
+    n = int.from_bytes(blob[5:13], "little")
+    npz = np.load(io.BytesIO(blob[13 : 13 + n]), allow_pickle=False)
+    markers, treedef = pickle.loads(blob[13 + n :])
+    leaves = [
+        npz[f"a{val}"] if kind == "__array__" else val
+        for kind, val in markers
+    ]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class PersistenceManager:
+    """Restore-on-boot + periodic push for one component.
+
+    ``user`` with ``get_state/set_state`` → state blob; otherwise the whole
+    object is pickled (reference behavior, ``persistence.py:21-27``).
+    """
+
+    def __init__(
+        self,
+        user: Any,
+        store: StateStore,
+        key: str,
+        push_frequency: float = DEFAULT_PUSH_FREQUENCY,
+    ):
+        self.user = user
+        self.store = store
+        self.key = key
+        self.push_frequency = push_frequency
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def _has_state_protocol(self) -> bool:
+        return callable(getattr(self.user, "get_state", None)) and callable(
+            getattr(self.user, "set_state", None)
+        )
+
+    # -- restore --------------------------------------------------------
+    def restore(self) -> bool:
+        """Returns True iff prior state was found and applied.  When the
+        fallback pickle path restores, the *new object's* state is replaced
+        via ``__dict__`` update (the instance identity the caller holds must
+        not change)."""
+        blob = self.store.load(self.key)
+        if blob is None:
+            return False
+        if blob[:5] == _STATE_MAGIC:
+            if not self._has_state_protocol:
+                logger.warning("state blob for %s but component has no "
+                               "set_state; ignoring", self.key)
+                return False
+            self.user.set_state(_unpack_state(blob))
+            return True
+        if blob[:5] == _PICKLE_MAGIC:
+            restored = pickle.loads(blob[5:])
+            self.user.__dict__.update(restored.__dict__)
+            return True
+        logger.warning("unrecognized state blob for %s", self.key)
+        return False
+
+    # -- push -----------------------------------------------------------
+    def push(self) -> None:
+        if self._has_state_protocol:
+            blob = _pack_state(self.user.get_state())
+        else:
+            blob = _PICKLE_MAGIC + pickle.dumps(self.user)
+        self.store.save(self.key, blob)
+
+    def start(self) -> "PersistenceManager":
+        """Reference: daemon timer thread pushing every push_frequency
+        (``persistence.py:33-44``)."""
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.wait(self.push_frequency):
+                try:
+                    self.push()
+                except Exception:  # noqa: BLE001 — never kill serving
+                    logger.exception("state push failed for %s", self.key)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"persist-{self.key}")
+        self._thread.start()
+        return self
+
+    def stop(self, final_push: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_push:
+            try:
+                self.push()
+            except Exception:
+                logger.exception("final state push failed for %s", self.key)
+
+
+def store_from_env() -> StateStore:
+    """Pick a store from env: ``SELDON_STATE_DIR`` (file store root,
+    default /tmp/seldon-state), ``SELDON_STATE_BACKEND`` = file|orbax."""
+    root = os.environ.get("SELDON_STATE_DIR", "/tmp/seldon-state")
+    backend = os.environ.get("SELDON_STATE_BACKEND", "file")
+    if backend == "orbax":
+        return OrbaxStateStore(root)
+    return FileStateStore(root)

@@ -67,6 +67,11 @@ def main(argv: Optional[list] = None) -> None:
     ap.add_argument("--port", type=int,
                     default=int(os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "9000")))
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--persistence", type=int, default=int(os.environ.get("PERSISTENCE", "0")),
+                    help="1 = restore state on boot + periodic push "
+                         "(reference wrappers/python/persistence.py)")
+    ap.add_argument("--push-frequency", type=float,
+                    default=float(os.environ.get("PUSH_FREQUENCY", "60")))
     args = ap.parse_args(argv)
     from seldon_core_tpu.operator.local import _honor_jax_platforms_env
 
@@ -77,6 +82,38 @@ def main(argv: Optional[list] = None) -> None:
     handle = load_component(mod, cls or None, params, service_type=args.service_type)
     handle.name = os.environ.get("PREDICTIVE_UNIT_ID", handle.name)
     metrics = EngineMetrics(MetricsRegistry(), deployment=handle.name)
+
+    if args.persistence:
+        from seldon_core_tpu.runtime.persistence import (
+            PersistenceManager,
+            persistence_key,
+            store_from_env,
+        )
+
+        key = persistence_key(
+            os.environ.get("SELDON_DEPLOYMENT_ID", "dep"),
+            os.environ.get("PREDICTOR_ID", "pred"),
+            handle.name,
+        )
+        pm = PersistenceManager(handle.user, store_from_env(), key,
+                                push_frequency=args.push_frequency)
+        if pm.restore():
+            logger.info("restored state for %s", key)
+        pm.start()
+
+        # final push on shutdown (SIGTERM from k8s, atexit otherwise) —
+        # without this, up to push_frequency seconds of learned state
+        # would be lost on every rollout
+        import atexit
+        import signal
+
+        atexit.register(pm.stop)
+
+        def _on_term(signum, frame):
+            pm.stop()
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _on_term)
 
     async def serve():
         from seldon_core_tpu.serving.rest import build_app, start_server

@@ -158,6 +158,37 @@ class EngineServer:
             content_type="text/plain",
         )
 
+    async def trace(self, request: web.Request) -> web.Response:
+        """Recent request trace trees (or one by ?puid=).  404s when the
+        engine has no tracer enabled."""
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None or not tracer.enabled:
+            return web.Response(
+                status=404, text=_err_json(404, "tracing disabled"),
+                content_type="application/json",
+            )
+        puid = request.query.get("puid")
+        if puid:
+            sp = tracer.get(puid)
+            if sp is None:
+                return web.Response(
+                    status=404, text=_err_json(404, f"no trace for {puid}"),
+                    content_type="application/json",
+                )
+            body = json.dumps({"puid": puid, **sp.to_dict()})
+        else:
+            try:
+                n = int(request.query.get("n", 20))
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text=_err_json(400, "n must be an integer"),
+                    content_type="application/json",
+                )
+            body = json.dumps(
+                {"traces": tracer.recent(n) if n > 0 else []}
+            )
+        return web.Response(text=body, content_type="application/json")
+
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
         app.router.add_post("/api/v1.0/predictions", self.predictions)  # alias
@@ -167,6 +198,7 @@ class EngineServer:
         app.router.add_get("/pause", self.pause)
         app.router.add_get("/unpause", self.unpause)
         app.router.add_get("/metrics", self.prometheus)
+        app.router.add_get("/trace", self.trace)
 
 
 class ComponentServer:

@@ -1,0 +1,149 @@
+"""Per-request trace spans + XLA profiler integration.
+
+The reference has no tracing (SURVEY.md §5.1): only per-hop debug logs
+(``engine/.../InternalPredictionService.java:374``) and the
+``meta.requestPath``/``meta.routing`` breadcrumbs carried in the payload.
+This subsystem makes the implicit explicit:
+
+- :class:`Tracer` records a span tree per request (graph-node enter/exit
+  with wall-time and attributes), keyed by puid, kept in a bounded ring;
+- spans nest via contextvars, so the async graph walk's concurrent child
+  fan-out attributes children to the right parent without explicit plumbing;
+- :func:`xla_profile` wraps ``jax.profiler.trace`` for device-level traces
+  (TensorBoard-viewable) around any serving window;
+- export: JSON dict per trace (``/trace`` REST endpoint serves these).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+__all__ = ["Span", "Tracer", "xla_profile", "NULL_TRACER"]
+
+
+@dataclass
+class Span:
+    name: str
+    kind: str = ""
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    children: list["Span"] = field(default_factory=list)
+    status: str = "OK"
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "start_ns": self.start_ns,
+            "duration_ms": self.duration_ms,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+_current_span: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+    "seldon_current_span", default=None
+)
+
+
+class Tracer:
+    """Collects span trees per request into a bounded LRU ring."""
+
+    def __init__(self, max_traces: int = 256, enabled: bool = True):
+        self.enabled = enabled
+        self.max_traces = max_traces
+        self._traces: OrderedDict[str, Span] = OrderedDict()
+        self._lock = threading.Lock()
+
+    # -- span API -------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str = "", **attributes) -> Iterator[Span]:
+        """Open a child span of the context's current span.  Works across
+        await boundaries: each asyncio task inherits the parent's context
+        snapshot, so concurrent siblings attach to the same parent."""
+        if not self.enabled:
+            yield _DUMMY
+            return
+        sp = Span(name=name, kind=kind, attributes=dict(attributes),
+                  start_ns=time.time_ns())
+        parent = _current_span.get()
+        if parent is not None:
+            # list.append is atomic under the GIL; concurrent siblings are safe
+            parent.children.append(sp)
+        token = _current_span.set(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.status = f"ERROR: {type(e).__name__}"
+            raise
+        finally:
+            sp.end_ns = time.time_ns()
+            _current_span.reset(token)
+
+    @contextlib.contextmanager
+    def trace(self, puid: str, name: str = "predict", **attributes
+              ) -> Iterator[Span]:
+        """Open (and on exit, record) a root span for one request."""
+        if not self.enabled:
+            yield _DUMMY
+            return
+        with self.span(name, kind="request", puid=puid, **attributes) as root:
+            try:
+                yield root
+            finally:
+                # record even on failure — error traces are the useful ones
+                self._record(puid, root)
+
+    def _record(self, puid: str, root: Span) -> None:
+        with self._lock:
+            self._traces[puid] = root
+            self._traces.move_to_end(puid)
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+
+    # -- query ----------------------------------------------------------
+    def get(self, puid: str) -> Optional[Span]:
+        with self._lock:
+            return self._traces.get(puid)
+
+    def recent(self, n: int = 20) -> list[dict]:
+        with self._lock:
+            spans = list(self._traces.items())[-n:]
+        return [{"puid": p, **s.to_dict()} for p, s in reversed(spans)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+_DUMMY = Span(name="disabled")
+
+NULL_TRACER = Tracer(enabled=False)
+
+
+@contextlib.contextmanager
+def xla_profile(logdir: str):
+    """Device-level XLA trace (TensorBoard format) around a serving window.
+
+    The TPU-native upgrade of the reference's JMX port (SURVEY.md §5.1):
+    wrap any window of requests to capture HLO timelines and HBM stats.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,165 @@
+"""Persistence for learning components (reference wrappers/python/persistence.py)."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.builtins import EpsilonGreedy
+from seldon_core_tpu.runtime.persistence import (
+    FileStateStore,
+    MemoryStateStore,
+    OrbaxStateStore,
+    PersistenceManager,
+    persistence_key,
+)
+
+
+def test_key_format_reference_parity():
+    assert (
+        persistence_key("mydep", "p0", "router")
+        == "persistence_mydep_p0_router"
+    )
+
+
+class TestStateProtocol:
+    def test_epsilon_greedy_state_roundtrip(self):
+        store = MemoryStateStore()
+        eg = EpsilonGreedy(n_branches=3, epsilon=0.1, seed=0)
+        # train it a bit so state is non-trivial
+        for _ in range(5):
+            eg.send_feedback(None, None, reward=1.0, truth=None, routing=1)
+        PersistenceManager(eg, store, "k").push()
+
+        fresh = EpsilonGreedy(n_branches=3, epsilon=0.1, seed=42)
+        assert PersistenceManager(fresh, store, "k").restore()
+        a, b = fresh.get_state(), eg.get_state()
+        assert set(a) == set(b)
+        for key in a:
+            np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+
+    def test_jax_array_state(self):
+        import jax.numpy as jnp
+
+        class DeviceBandit:
+            def __init__(self):
+                self.values = jnp.zeros((4,))
+
+            def get_state(self):
+                return {"values": self.values}
+
+            def set_state(self, state):
+                self.values = jnp.asarray(state["values"])
+
+        store = MemoryStateStore()
+        b = DeviceBandit()
+        b.values = jnp.array([1.0, 2.0, 3.0, 4.0])
+        PersistenceManager(b, store, "k").push()
+        fresh = DeviceBandit()
+        assert PersistenceManager(fresh, store, "k").restore()
+        np.testing.assert_array_equal(np.asarray(fresh.values), [1, 2, 3, 4])
+
+
+class Plain:
+    """Module-level so pickle can resolve it (local classes can't pickle —
+    same constraint the reference's Redis-pickle path has)."""
+
+    def __init__(self):
+        self.counter = 0
+
+
+class TestPickleFallback:
+    def test_object_without_protocol(self):
+        store = MemoryStateStore()
+        obj = Plain()
+        obj.counter = 7
+        PersistenceManager(obj, store, "k").push()
+        fresh = Plain()
+        pm = PersistenceManager(fresh, store, "k")
+        assert pm.restore()
+        assert fresh.counter == 7
+
+    def test_restore_missing_returns_false(self):
+        pm = PersistenceManager(object(), MemoryStateStore(), "nope")
+        assert not pm.restore()
+
+
+class TestFileStore:
+    def test_atomic_roundtrip(self, tmp_path):
+        store = FileStateStore(str(tmp_path))
+        store.save("persistence_d_p_u", b"hello")
+        assert store.load("persistence_d_p_u") == b"hello"
+        store.save("persistence_d_p_u", b"world")  # overwrite
+        assert store.load("persistence_d_p_u") == b"world"
+        assert store.load("missing") is None
+
+    def test_push_timer_thread(self, tmp_path):
+        import time
+
+        class Counting:
+            def __init__(self):
+                self.n = 0
+
+            def get_state(self):
+                return {"n": self.n}
+
+            def set_state(self, s):
+                self.n = s["n"]
+
+        store = FileStateStore(str(tmp_path))
+        obj = Counting()
+        obj.n = 3
+        pm = PersistenceManager(obj, store, "timer", push_frequency=0.05)
+        pm.start()
+        time.sleep(0.2)
+        pm.stop(final_push=False)
+        fresh = Counting()
+        assert PersistenceManager(fresh, store, "timer").restore()
+        assert fresh.n == 3
+
+
+class TestOrbaxStore:
+    def test_pytree_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        class DeviceBandit:
+            def __init__(self):
+                self.values = jnp.zeros((3,))
+                self.counts = jnp.zeros((3,), jnp.int32)
+
+            def get_state(self):
+                return {"values": self.values, "counts": self.counts}
+
+            def set_state(self, state):
+                self.values = jnp.asarray(state["values"])
+                self.counts = jnp.asarray(state["counts"])
+
+        store = OrbaxStateStore(str(tmp_path / "orbax"))
+        b = DeviceBandit()
+        b.values = jnp.array([0.5, 1.5, 2.5])
+        b.counts = jnp.array([1, 2, 3], jnp.int32)
+        PersistenceManager(b, store, "bandit").push()
+        fresh = DeviceBandit()
+        assert PersistenceManager(fresh, store, "bandit").restore()
+        np.testing.assert_allclose(np.asarray(fresh.values), [0.5, 1.5, 2.5])
+        np.testing.assert_array_equal(np.asarray(fresh.counts), [1, 2, 3])
+
+    def test_pickle_fallback_component(self, tmp_path):
+        # components without the state protocol must work on orbax too
+        store = OrbaxStateStore(str(tmp_path / "orbax2"))
+        obj = Plain()
+        obj.counter = 9
+        PersistenceManager(obj, store, "plain").push()
+        fresh = Plain()
+        assert PersistenceManager(fresh, store, "plain").restore()
+        assert fresh.counter == 9
+
+    def test_overwrite_keeps_latest(self, tmp_path):
+        store = OrbaxStateStore(str(tmp_path / "orbax3"))
+        obj = Plain()
+        pm = PersistenceManager(obj, store, "p")
+        obj.counter = 1
+        pm.push()
+        obj.counter = 2
+        pm.push()
+        fresh = Plain()
+        assert PersistenceManager(fresh, store, "p").restore()
+        assert fresh.counter == 2

@@ -1,0 +1,182 @@
+"""Tracing subsystem: span trees per request, engine integration, /trace."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.utils.tracing import NULL_TRACER, Tracer
+
+
+class TestTracer:
+    def test_nested_spans(self):
+        tr = Tracer()
+        with tr.trace("p1") as root:
+            with tr.span("child-a", kind="MODEL"):
+                pass
+            with tr.span("child-b"):
+                with tr.span("grandchild"):
+                    pass
+        got = tr.get("p1")
+        assert [c.name for c in got.children] == ["child-a", "child-b"]
+        assert got.children[1].children[0].name == "grandchild"
+        assert got.duration_ms >= 0
+
+    def test_error_marks_status(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.trace("p2"):
+                with tr.span("boom"):
+                    raise ValueError("x")
+        got = tr.get("p2")
+        assert got.children[0].status.startswith("ERROR")
+        assert got.status.startswith("ERROR")
+
+    def test_concurrent_tasks_attach_to_right_parent(self):
+        tr = Tracer()
+
+        async def child(name):
+            with tr.span(name):
+                await asyncio.sleep(0.01)
+
+        async def main():
+            with tr.trace("p3"):
+                await asyncio.gather(child("a"), child("b"), child("c"))
+
+        asyncio.run(main())
+        got = tr.get("p3")
+        assert sorted(c.name for c in got.children) == ["a", "b", "c"]
+
+    def test_ring_eviction(self):
+        tr = Tracer(max_traces=2)
+        for i in range(4):
+            with tr.trace(f"p{i}"):
+                pass
+        assert tr.get("p0") is None and tr.get("p1") is None
+        assert tr.get("p3") is not None
+
+    def test_null_tracer_is_free(self):
+        with NULL_TRACER.trace("x") as sp:
+            with NULL_TRACER.span("y"):
+                pass
+        assert NULL_TRACER.get("x") is None
+        assert sp.name == "disabled"
+
+
+class TestEngineTracing:
+    GRAPH = {
+        "name": "combiner",
+        "implementation": "AVERAGE_COMBINER",
+        "type": "COMBINER",
+        "children": [
+            {"name": "m1", "implementation": "SIMPLE_MODEL"},
+            {"name": "m2", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+
+    def test_graph_walk_produces_span_tree(self):
+        tr = Tracer()
+        eng = GraphEngine(self.GRAPH, tracer=tr)
+        req = SeldonMessage.from_ndarray(np.array([[1.0, 2.0]]))
+        out = eng.predict_sync(req)
+        puid = out.meta.puid
+        root = tr.get(puid)
+        assert root is not None
+        combiner = root.children[0]
+        assert combiner.name == "combiner" and combiner.kind == "COMBINER"
+        assert sorted(c.name for c in combiner.children) == ["m1", "m2"]
+        assert all(c.kind == "MODEL" for c in combiner.children)
+
+    def test_local_predictor_tracing_annotation(self):
+        from seldon_core_tpu.operator.local import LocalDeployment
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+
+        dep = SeldonDeployment.from_dict({
+            "apiVersion": "machinelearning.seldon.io/v1alpha2",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "traced"},
+            "spec": {
+                "name": "traced",
+                "annotations": {"seldon.io/tracing": "true"},
+                "predictors": [{
+                    "name": "p0",
+                    "replicas": 1,
+                    "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+                }],
+            },
+        })
+        local = LocalDeployment(dep)
+        pred = local.pick()
+        out = pred.engine.predict_sync(
+            SeldonMessage.from_ndarray(np.ones((1, 2)))
+        )
+        assert pred.engine.tracer.get(out.meta.puid) is not None
+
+    def test_engine_without_tracer_records_nothing(self):
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        out = eng.predict_sync(SeldonMessage.from_ndarray(np.ones((1, 2))))
+        assert out.status.status == "SUCCESS"
+        assert eng.tracer is NULL_TRACER
+
+
+class TestTraceEndpoint:
+    async def _serve(self):
+        from aiohttp.test_utils import TestClient, TestServer
+        from aiohttp import web
+
+        from seldon_core_tpu.serving.rest import EngineServer
+
+        tr = Tracer()
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"},
+                          tracer=tr)
+        srv = EngineServer(eng)
+        app = web.Application()
+        srv.register(app)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    def test_trace_endpoint(self):
+        async def run():
+            client = await self._serve()
+            try:
+                r = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                )
+                body = await r.json()
+                puid = body["meta"]["puid"]
+                r = await client.get("/trace")
+                traces = (await r.json())["traces"]
+                assert traces and traces[0]["puid"] == puid
+                r = await client.get("/trace", params={"puid": puid})
+                one = await r.json()
+                assert one["children"][0]["name"] == "m"
+                r = await client.get("/trace", params={"puid": "zzz"})
+                assert r.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_trace_endpoint_disabled(self):
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+            from aiohttp import web
+
+            from seldon_core_tpu.serving.rest import EngineServer
+
+            eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+            app = web.Application()
+            EngineServer(eng).register(app)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/trace")
+                assert r.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(run())
