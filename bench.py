@@ -136,9 +136,15 @@ def bench_resnet50(seconds_budget: float = 60.0, batch: int = 64) -> dict:
     }
 
 
-def bench_batched_serving(seconds: float = 3.0, concurrency: int = 128) -> float:
+def bench_batched_serving(seconds: float = 3.0, concurrency: int = 1024) -> float:
     """MNIST MLP behind engine + dynamic batcher (single-row requests fused
-    into device batches)."""
+    into device batches).
+
+    Sized so several batches stay in flight at once: the serving tunnel to a
+    remote TPU has a fixed ~65 ms round trip but pipelines concurrent
+    transfers ~8x, so throughput = batch_rows x inflight / RTT.  A closed
+    loop with concurrency == max_batch would lockstep on ONE in-flight batch
+    and measure only the RTT."""
     import numpy as np
 
     from seldon_core_tpu.graph.engine import GraphEngine
@@ -149,7 +155,12 @@ def bench_batched_serving(seconds: float = 3.0, concurrency: int = 128) -> float
 
     bm = BatchedModel(
         ComponentHandle(MNISTMLP(hidden=256), name="mnist"),
-        BatcherConfig(max_batch_size=128, max_delay_ms=1.0),
+        BatcherConfig(
+            max_batch_size=256,
+            max_delay_ms=1.0,
+            max_inflight=8,
+            max_queue_rows=0,  # closed-loop bench: no shedding
+        ),
     )
     eng = GraphEngine({"name": "mnist", "type": "MODEL"}, resolver=lambda u: bm)
     row = np.random.default_rng(0).normal(size=(1, 784)).astype(np.float32)

@@ -28,6 +28,10 @@
 namespace {
 
 constexpr uint32_t kMaxFrame = 1u << 30; /* 1 GiB hard cap */
+/* Stop reading from a connection once this many response bytes are queued
+ * for it: a slow-reading client must consume responses before sending more
+ * requests, instead of ballooning wbuf without bound. */
+constexpr size_t kMaxBuffered = 64u << 20;
 
 struct Conn {
   int fd = -1;
@@ -93,8 +97,10 @@ void close_conn(sn_server *s, Conn *c) {
 }
 
 void arm(sn_server *s, Conn *c) {
+  size_t pending = c->wbuf.size() - c->woff;
   struct epoll_event ev;
-  ev.events = EPOLLIN | (c->wbuf.size() > c->woff ? (uint32_t)EPOLLOUT : 0u);
+  ev.events = pending > 0 ? (uint32_t)EPOLLOUT : 0u;
+  if (pending < kMaxBuffered) ev.events |= EPOLLIN; /* read backpressure */
   ev.data.ptr = c;
   epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
 }
@@ -106,6 +112,13 @@ bool do_write(sn_server *s, Conn *c) {
     if (n > 0) {
       c->woff += (size_t)n;
     } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      /* reclaim the consumed prefix: without this a client that reads just
+       * slowly enough to keep pending under kMaxBuffered would make wbuf
+       * grow by every byte ever sent since the last full drain */
+      if (c->woff >= (1u << 20)) {
+        c->wbuf.erase(c->wbuf.begin(), c->wbuf.begin() + (ptrdiff_t)c->woff);
+        c->woff = 0;
+      }
       arm(s, c);
       return true;
     } else {
@@ -161,6 +174,10 @@ bool drain_frames(sn_server *s, Conn *c) {
 
 bool do_read(sn_server *s, Conn *c) {
   for (;;) {
+    if (c->wbuf.size() - c->woff >= kMaxBuffered) {
+      arm(s, c); /* pause reads until the client drains its responses */
+      return true;
+    }
     if (c->rbuf.size() - c->rlen < 65536) c->rbuf.resize(c->rlen + 262144);
     ssize_t n = read(c->fd, c->rbuf.data() + c->rlen, c->rbuf.size() - c->rlen);
     if (n > 0) {
@@ -250,6 +267,15 @@ sn_server *sn_server_create(const char *bind_addr, uint16_t port,
   s->ud = ud;
   s->epoll_fd = epoll_create1(0);
   s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {
+    /* without a working epoll/eventfd the IO thread would busy-spin on
+     * epoll_wait(-1) at 100% CPU — fail creation instead */
+    if (s->epoll_fd >= 0) close(s->epoll_fd);
+    if (s->wake_fd >= 0) close(s->wake_fd);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
   struct epoll_event ev;
   ev.events = EPOLLIN;
   ev.data.u64 = kListenTag;

@@ -134,20 +134,46 @@ def _build() -> None:
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
+def _sources_mtime() -> float:
+    newest = 0.0
+    for name in os.listdir(_NATIVE_DIR):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            newest = max(
+                newest, os.path.getmtime(os.path.join(_NATIVE_DIR, name))
+            )
+    return newest
+
+
+def _lib_is_current() -> bool:
+    return (
+        os.path.exists(_LIB_PATH)
+        and os.path.getmtime(_LIB_PATH) >= _sources_mtime()
+    )
+
+
 def load() -> Optional[C.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
+    import logging
+
+    logger = logging.getLogger(__name__)
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        # Always (re)run make when sources are present: a no-op when the .so
-        # is current, and prevents silently loading a stale library after
-        # native/*.cc edits.
         if os.path.isdir(_NATIVE_DIR):
-            try:
-                _build()
-            except Exception:
-                if not os.path.exists(_LIB_PATH):
+            # invoke make only when the .so is older than a source file —
+            # serving boot skips the compiler entirely on the common path
+            if not _lib_is_current():
+                try:
+                    _build()
+                except Exception as e:
+                    detail = getattr(e, "stderr", b"") or b""
+                    logger.warning(
+                        "native build failed (%s): %s",
+                        e,
+                        detail[-500:].decode(errors="replace"),
+                    )
+                    # never serve a stale binary after native/*.cc edits
                     return None
         elif not os.path.exists(_LIB_PATH):
             return None

@@ -85,10 +85,19 @@ def _batching_enabled(ann: dict) -> bool:
 
 
 def _batcher_config(ann: dict) -> BatcherConfig:
-    return BatcherConfig(
+    """Batcher knobs from ``seldon.io/*`` annotations (the reference's
+    runtime flag system, ``docs/annotations.md``); backpressure knobs map to
+    the DynamicBatcher queue cap / deadline shed / in-flight cap."""
+    cfg = BatcherConfig(
         max_batch_size=int(ann.get("seldon.io/batch-max-size", "64")),
         max_delay_ms=float(ann.get("seldon.io/batch-max-delay-ms", "2.0")),
+        shed_after_ms=float(ann.get("seldon.io/batch-shed-after-ms", "0")),
+        max_inflight=int(ann.get("seldon.io/batch-max-inflight", "4")),
+        materialize=ann.get("seldon.io/batch-materialize", "host"),
     )
+    if "seldon.io/batch-max-queue-rows" in ann:
+        cfg.max_queue_rows = int(ann["seldon.io/batch-max-queue-rows"])
+    return cfg
 
 
 class LocalPredictor:

@@ -155,7 +155,16 @@ def flash_attention(
         interpret = use_interpret()
     block_q = min(block_q, L)
     block_k = min(block_k, L)
-    if L % block_q or L % block_k:
+    # Mosaic tiling wants sublane-aligned blocks: a non-multiple-of-8 block
+    # (e.g. L=20 → block 20) passes in interpreter mode but can fail when
+    # actually compiled on TPU — CPU tests cannot catch that, so route any
+    # non-aligned shape to the dense fallback instead.
+    if (
+        L % block_q
+        or L % block_k
+        or block_q % 8
+        or block_k % 8
+    ):
         from seldon_core_tpu.parallel.ring_attention import dense_attention
 
         return dense_attention(q, k, v, causal=causal, scale=scale)

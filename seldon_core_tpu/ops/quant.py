@@ -116,12 +116,23 @@ def int8_matmul(
     N = w.values.shape[1]
     bm = min(block_m, M)
     bn = min(block_n, N)
-    if M % bm or N % bn:
-        out = jnp.dot(
-            x2.astype(jnp.float32),
-            w.values.astype(jnp.float32) * w.scales[None, :],
-            preferred_element_type=jnp.float32,
-        ).astype(out_dtype)
+    # Mosaic wants sublane/lane-aligned blocks; misaligned shapes fall back.
+    if M % bm or N % bn or bm % 8 or bn % 128:
+        # Same numerics as the kernel — per-row dynamic activation
+        # quantization + int32 accumulate — so identical inputs produce
+        # identical results whichever shape path serving takes (batch 127
+        # and 128 must not differ in precision).
+        xf = x2.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+        xs = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+        xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, w.values, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        out = (acc.astype(jnp.float32) * xs * w.scales[None, :]).astype(
+            out_dtype
+        )
     else:
         out = _int8_matmul(x2, w.values, w.scales.reshape(1, N), bm, bn,
                            jnp.dtype(out_dtype), bool(interpret))

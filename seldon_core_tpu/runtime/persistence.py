@@ -67,11 +67,31 @@ class MemoryStateStore:
 
 
 class FileStateStore:
-    """One file per key under a root dir (a PVC in k8s).  Atomic writes."""
+    """One file per key under a root dir (a PVC in k8s).  Atomic writes.
 
-    def __init__(self, root: str):
+    With ``require_owner=True`` the root must be owned by the current user:
+    restore() may unpickle, so loading from a directory another local user
+    can pre-create (e.g. a predictable shared-tmp path) would let them plant
+    a malicious pickle executed at component boot.  The flag is set for the
+    *implicit* default root only — an explicitly configured
+    ``SELDON_STATE_DIR`` (e.g. a root-owned PVC mount with fsGroup access)
+    is the operator's deliberate choice and is not second-guessed.
+    """
+
+    def __init__(self, root: str, require_owner: bool = False):
         self.root = root
+        existed = os.path.isdir(root)
         os.makedirs(root, exist_ok=True)
+        if require_owner and hasattr(os, "getuid"):
+            st = os.stat(root)
+            if st.st_uid != os.getuid():
+                raise PermissionError(
+                    f"state dir {root!r} is owned by uid {st.st_uid}, not "
+                    f"the current user ({os.getuid()}): refusing to load "
+                    "state from a directory another user controls"
+                )
+            if not existed and st.st_mode & 0o022:
+                os.chmod(root, st.st_mode & ~0o022)
 
     def _path(self, key: str) -> str:
         safe = key.replace("/", "_")
@@ -290,10 +310,29 @@ class PersistenceManager:
 
 
 def store_from_env() -> StateStore:
-    """Pick a store from env: ``SELDON_STATE_DIR`` (file store root,
-    default /tmp/seldon-state), ``SELDON_STATE_BACKEND`` = file|orbax."""
-    root = os.environ.get("SELDON_STATE_DIR", "/tmp/seldon-state")
+    """Pick a store from env: ``SELDON_STATE_DIR`` (file store root),
+    ``SELDON_STATE_BACKEND`` = file|orbax.
+
+    Without ``SELDON_STATE_DIR`` the default is a per-user state dir
+    (``$XDG_STATE_HOME/seldon-state`` or ``~/.local/state/seldon-state``) —
+    NOT a world-writable /tmp path, which another local user could
+    pre-create and seed with a malicious pickle (see FileStateStore)."""
+    root = os.environ.get("SELDON_STATE_DIR")
+    implicit = not root
+    if implicit:
+        base = os.environ.get("XDG_STATE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".local", "state"
+        )
+        root = os.path.join(base, "seldon-state")
+        legacy = "/tmp/seldon-state"
+        if os.path.isdir(legacy) and not os.path.isdir(root):
+            logger.warning(
+                "state found at legacy default %s but the default root is "
+                "now %s (the old path was world-predictable); set "
+                "SELDON_STATE_DIR=%s explicitly to keep using it",
+                legacy, root, legacy,
+            )
     backend = os.environ.get("SELDON_STATE_BACKEND", "file")
     if backend == "orbax":
         return OrbaxStateStore(root)
-    return FileStateStore(root)
+    return FileStateStore(root, require_owner=implicit)

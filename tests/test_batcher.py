@@ -9,7 +9,9 @@ from seldon_core_tpu.messages import SeldonMessage
 from seldon_core_tpu.runtime.batcher import (
     BatchedModel,
     BatcherConfig,
+    DeadlineExceededError,
     DynamicBatcher,
+    QueueFullError,
     default_buckets,
 )
 from seldon_core_tpu.runtime.component import ComponentHandle
@@ -217,6 +219,151 @@ def test_buckets_smaller_than_max_batch_rejected():
 
     with pytest.raises(ValueError):
         DynamicBatcher(fn, BatcherConfig(max_batch_size=64, buckets=[2, 4]))
+
+
+def test_queue_full_sheds_with_429():
+    """Overload: queue cap bounds memory; excess requests get QUEUE_FULL."""
+    import time as _time
+
+    class SlowDeviceArray:
+        """Async-dispatch semantics: fn returns instantly, result is slow."""
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __array__(self, dtype=None):
+            _time.sleep(0.01)  # slow device→host fetch → queue builds up
+            return self.arr
+
+    def slow_fn(batch):
+        return SlowDeviceArray(np.asarray(batch))
+
+    b = DynamicBatcher(
+        slow_fn,
+        BatcherConfig(
+            max_batch_size=2,
+            max_delay_ms=1.0,
+            max_queue_rows=4,
+            max_inflight=1,
+        ),
+    )
+
+    async def main():
+        return await asyncio.gather(
+            *(b(np.ones((1, 1))) for _ in range(40)), return_exceptions=True
+        )
+
+    res = asyncio.run(main())
+    shed = [r for r in res if isinstance(r, QueueFullError)]
+    ok = [r for r in res if not isinstance(r, Exception)]
+    assert shed, "expected some requests shed under 10x overload"
+    assert ok, "expected some requests to succeed"
+    assert shed[0].status_code == 429 and shed[0].reason == "QUEUE_FULL"
+
+
+def test_deadline_shed_at_flush():
+    def fn(batch):
+        return batch
+
+    b = DynamicBatcher(
+        fn,
+        BatcherConfig(
+            max_batch_size=8,
+            max_delay_ms=1.0,
+            shed_after_ms=5.0,
+            max_queue_rows=0,
+        ),
+    )
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        # hand-enqueue an already-expired request, then flush via real traffic
+        from seldon_core_tpu.runtime.batcher import _Pending
+
+        lane_key = ((1,), "float64")
+        task = asyncio.ensure_future(b(np.ones((1, 1))))
+        await asyncio.sleep(0)  # lane now exists
+        lane = b._lanes[lane_key]
+        lane.pending.insert(
+            0, _Pending(np.ones((1, 1)), 1, fut, t_enqueue=loop.time() - 1.0)
+        )
+        lane.pending_rows += 1
+        fresh = await task
+        return fut, fresh
+
+    fut, fresh = asyncio.run(main())
+    assert isinstance(fut.exception(), DeadlineExceededError)
+    assert fut.exception().status_code == 504
+    assert fresh.shape == (1, 1)  # fresh request unaffected
+
+
+def test_inflight_cap_defers_flushes():
+    """No more than max_inflight device batches outstanding at once."""
+    import threading
+    import time as _time
+
+    inflight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    class FakeDeviceArray:
+        """Non-numpy output so the host-materialize executor path runs."""
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __array__(self, dtype=None):
+            with lock:
+                inflight[0] += 1
+                peak[0] = max(peak[0], inflight[0])
+            _time.sleep(0.005)
+            with lock:
+                inflight[0] -= 1
+            return self.arr
+
+    def fn(batch):
+        return FakeDeviceArray(np.asarray(batch))
+
+    b = DynamicBatcher(
+        fn,
+        BatcherConfig(
+            max_batch_size=2, max_delay_ms=0.5, max_inflight=2, max_queue_rows=0
+        ),
+    )
+
+    async def main():
+        return await asyncio.gather(*(b(np.ones((1, 1))) for _ in range(32)))
+
+    outs = asyncio.run(main())
+    assert len(outs) == 32
+    assert peak[0] <= 2
+
+
+def test_host_materialize_returns_numpy_for_jax_fn():
+    import jax.numpy as jnp
+
+    def fn(batch):
+        return jnp.asarray(batch) * 3.0
+
+    b = DynamicBatcher(fn, BatcherConfig(max_batch_size=4, max_delay_ms=2.0))
+    out = asyncio.run(b(np.ones((1, 2), np.float32)))
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, [[3.0, 3.0]])
+
+
+def test_device_materialize_returns_device_slices():
+    import jax
+
+    def fn(batch):
+        return jax.numpy.asarray(batch) + 1.0
+
+    b = DynamicBatcher(
+        fn, BatcherConfig(max_batch_size=4, max_delay_ms=2.0, materialize="device")
+    )
+    out = asyncio.run(b(np.ones((1, 2), np.float32)))
+    assert not isinstance(out, np.ndarray)  # stayed on device
+    np.testing.assert_array_equal(np.asarray(out), [[2.0, 2.0]])
 
 
 def test_lane_eviction_bounds_memory():
