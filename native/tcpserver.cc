@@ -136,40 +136,51 @@ bool do_write(sn_server *s, Conn *c) {
   return true;
 }
 
-/* run handler over every complete frame in rbuf */
+/* run handler over complete frames in rbuf, respecting the response-buffer
+ * cap: frames are parked (left in rbuf) while pending writes exceed
+ * kMaxBuffered, and resumed as writes drain — one read burst of pipelined
+ * requests with large responses cannot overshoot the cap unboundedly */
 bool drain_frames(sn_server *s, Conn *c) {
-  size_t off = 0;
-  while (c->rlen - off >= 4) {
-    uint32_t flen;
-    memcpy(&flen, c->rbuf.data() + off, 4);
-    if (flen > kMaxFrame) { close_conn(s, c); return false; }
-    if (c->rlen - off - 4 < flen) break;
-    uint8_t *resp = nullptr;
-    uint64_t resp_len = 0;
-    s->n_requests++;
-    int rc = s->handler(c->rbuf.data() + off + 4, flen, &resp, &resp_len, s->ud);
-    if (resp_len > kMaxFrame) { /* u32 prefix cannot carry it */
+  for (;;) {
+    size_t off = 0;
+    bool parked = false;
+    while (c->rlen - off >= 4 && !c->closing) {
+      if (c->wbuf.size() - c->woff >= kMaxBuffered) { parked = true; break; }
+      uint32_t flen;
+      memcpy(&flen, c->rbuf.data() + off, 4);
+      if (flen > kMaxFrame) { close_conn(s, c); return false; }
+      if (c->rlen - off - 4 < flen) break;
+      uint8_t *resp = nullptr;
+      uint64_t resp_len = 0;
+      s->n_requests++;
+      int rc = s->handler(c->rbuf.data() + off + 4, flen, &resp, &resp_len, s->ud);
+      if (resp_len > kMaxFrame) { /* u32 prefix cannot carry it */
+        if (resp) sn_buf_free(resp);
+        close_conn(s, c);
+        return false;
+      }
+      if (resp && resp_len) {
+        uint32_t rl = (uint32_t)resp_len;
+        size_t pos = c->wbuf.size();
+        c->wbuf.resize(pos + 4 + resp_len);
+        memcpy(c->wbuf.data() + pos, &rl, 4);
+        memcpy(c->wbuf.data() + pos + 4, resp, resp_len);
+      }
       if (resp) sn_buf_free(resp);
-      close_conn(s, c);
-      return false;
+      off += 4 + flen;
+      if (rc != 0) { c->closing = true; break; }
     }
-    if (resp && resp_len) {
-      uint32_t rl = (uint32_t)resp_len;
-      size_t pos = c->wbuf.size();
-      c->wbuf.resize(pos + 4 + resp_len);
-      memcpy(c->wbuf.data() + pos, &rl, 4);
-      memcpy(c->wbuf.data() + pos + 4, resp, resp_len);
+    if (off) {
+      memmove(c->rbuf.data(), c->rbuf.data() + off, c->rlen - off);
+      c->rlen -= off;
     }
-    if (resp) sn_buf_free(resp);
-    off += 4 + flen;
-    if (rc != 0) { c->closing = true; break; }
+    if (!c->wbuf.empty() || c->closing) {
+      if (!do_write(s, c)) return false;
+    }
+    if (!parked) return true;
+    if (c->wbuf.size() - c->woff >= kMaxBuffered) return true; /* EPOLLOUT resumes */
+    /* writes drained synchronously — keep processing parked frames */
   }
-  if (off) {
-    memmove(c->rbuf.data(), c->rbuf.data() + off, c->rlen - off);
-    c->rlen -= off;
-  }
-  if (!c->wbuf.empty() || c->closing) return do_write(s, c);
-  return true;
 }
 
 bool do_read(sn_server *s, Conn *c) {
@@ -225,6 +236,8 @@ void *loop(void *arg) {
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) { close_conn(s, c); continue; }
       if (evs[i].events & EPOLLOUT) {
         if (!do_write(s, c)) continue;
+        /* writes drained below the cap: resume any frames parked in rbuf */
+        if (!drain_frames(s, c)) continue;
       }
       if (evs[i].events & EPOLLIN) {
         if (!do_read(s, c)) continue;

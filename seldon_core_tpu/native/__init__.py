@@ -173,8 +173,19 @@ def load() -> Optional[C.CDLL]:
                         e,
                         detail[-500:].decode(errors="replace"),
                     )
-                    # never serve a stale binary after native/*.cc edits
-                    return None
+                    # never serve a stale binary after native/*.cc edits —
+                    # unless the operator explicitly opts in (prebuilt .so
+                    # shipped to a host without a toolchain, where source
+                    # mtimes from the install can postdate the library)
+                    if not (
+                        os.path.exists(_LIB_PATH)
+                        and os.environ.get("SELDON_NATIVE_ALLOW_STALE")
+                    ):
+                        return None
+                    logger.warning(
+                        "loading possibly-stale %s (SELDON_NATIVE_ALLOW_STALE)",
+                        _LIB_PATH,
+                    )
         elif not os.path.exists(_LIB_PATH):
             return None
         try:

@@ -130,6 +130,11 @@ class DynamicBatcher:
                 f"largest bucket {self.buckets[-1]} < max_batch_size "
                 f"{self.config.max_batch_size}: batches could exceed the pad"
             )
+        if self.config.materialize not in ("host", "device"):
+            raise ValueError(
+                f"materialize must be 'host' or 'device', got "
+                f"{self.config.materialize!r}"
+            )
         # derived cap kept on the instance — the caller's config object is
         # never mutated (it may be shared across batchers)
         self.max_queue_rows = (
@@ -305,11 +310,17 @@ class DynamicBatcher:
             # ONE device→host transfer for the whole batch, off the event
             # loop; callers then get zero-copy numpy row views.
             self._inflight += 1
-            loop = asyncio.get_running_loop()
-            fetch = loop.run_in_executor(None, _fetch_host, out)
-            fetch.add_done_callback(
-                lambda f: self._on_batch_done(f, items, aux)
-            )
+            try:
+                loop = asyncio.get_running_loop()
+                fetch = loop.run_in_executor(None, _fetch_host, out)
+                fetch.add_done_callback(
+                    lambda f: self._on_batch_done(f, items, aux)
+                )
+            except BaseException:
+                # a leaked slot would eventually wedge every flush at the
+                # in-flight cap
+                self._release_slot()
+                raise
             return
         self._deliver(out, items, aux)
 
@@ -324,14 +335,16 @@ class DynamicBatcher:
     def _on_batch_done(self, fetch: asyncio.Future, items, aux) -> None:
         """Runs on the event loop when a batch's host fetch finishes."""
         try:
-            host = fetch.result()
-        except Exception as e:
-            for p in items:
-                if not p.future.done():
-                    p.future.set_exception(e)
-        else:
-            self._deliver(host, items, aux)
-        self._release_slot()
+            try:
+                host = fetch.result()
+            except Exception as e:
+                for p in items:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+            else:
+                self._deliver(host, items, aux)
+        finally:
+            self._release_slot()
 
     async def _acquire_slot(self) -> bool:
         """Wait for an in-flight slot (host mode with a cap); True if taken."""

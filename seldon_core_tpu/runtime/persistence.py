@@ -80,7 +80,6 @@ class FileStateStore:
 
     def __init__(self, root: str, require_owner: bool = False):
         self.root = root
-        existed = os.path.isdir(root)
         os.makedirs(root, exist_ok=True)
         if require_owner and hasattr(os, "getuid"):
             st = os.stat(root)
@@ -90,7 +89,10 @@ class FileStateStore:
                     f"the current user ({os.getuid()}): refusing to load "
                     "state from a directory another user controls"
                 )
-            if not existed and st.st_mode & 0o022:
+            if st.st_mode & 0o022:
+                # group/world write on the default dir reopens the attack
+                # (anyone could swap state files) — tighten it even when the
+                # dir pre-existed with a permissive umask
                 os.chmod(root, st.st_mode & ~0o022)
 
     def _path(self, key: str) -> str:

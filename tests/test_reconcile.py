@@ -1,0 +1,248 @@
+"""Reconcile-loop tests against the fake apiserver: the full
+watch → validate/default → createOrReplace → prune → status cycle the
+reference leaves untested (SURVEY.md §4.1 "the k8s client layer itself is
+untested"; behavior matched: SeldonDeploymentWatcher.java:122-197,
+SeldonDeploymentControllerImpl.java:261, KubeCRDHandlerImpl.java:48-180,
+DeploymentWatcher.java:60-146, CRDCreator.java:31-140)."""
+
+import copy
+import json
+
+from seldon_core_tpu.operator.reconcile import (
+    FakeKubeApi,
+    SeldonDeploymentController,
+    SeldonDeploymentWatcher,
+    crd_manifest,
+    ensure_crd,
+)
+
+NS = "default"
+
+
+def make_cr(name="iris-dep", replicas=1, predictor="main"):
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "name": name,
+            "predictors": [
+                {
+                    "name": predictor,
+                    "replicas": replicas,
+                    "graph": {
+                        "name": "classifier",
+                        "type": "MODEL",
+                        "parameters": [
+                            {
+                                "name": "model_class",
+                                "value": "seldon_core_tpu.models.iris:IrisClassifier",
+                                "type": "STRING",
+                            }
+                        ],
+                    },
+                }
+            ],
+        },
+    }
+
+
+def boot():
+    api = FakeKubeApi()
+    watcher = SeldonDeploymentWatcher(api, namespace=NS)
+    return api, watcher
+
+
+def test_crd_registration_idempotent():
+    api = FakeKubeApi()
+    assert ensure_crd(api) is True
+    assert ensure_crd(api) is False  # second call: already registered
+    crd = api.get(
+        "CustomResourceDefinition", "", "seldondeployments.machinelearning.seldon.io"
+    )
+    assert crd["spec"]["names"]["shortNames"] == ["sdep"]
+    assert crd["spec"]["versions"][0]["subresources"] == {"status": {}}
+
+
+def test_create_flow_creates_owned_resources_and_status():
+    api, watcher = boot()
+    api.create(make_cr())
+    actions = watcher.run_once()
+    assert actions == {"iris-dep": "reconciled"}
+
+    deployments = api.list("Deployment", NS)
+    services = api.list("Service", NS)
+    assert [d["metadata"]["name"] for d in deployments] == ["iris-dep-main"]
+    assert services, "deployment-wide Service expected"
+    for obj in deployments + services:
+        assert obj["metadata"]["labels"]["seldon-deployment-id"] == "iris-dep"
+        refs = obj["metadata"]["ownerReferences"]
+        assert refs[0]["kind"] == "SeldonDeployment"
+        assert refs[0]["uid"]  # GC wiring
+
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    assert cr["status"]["state"] == "Creating"  # no replicas available yet
+    assert cr["status"]["predictorStatus"] == [
+        {"name": "main", "replicas": 1, "replicasAvailable": 0}
+    ]
+
+
+def test_replica_availability_flows_into_status():
+    api, watcher = boot()
+    api.create(make_cr(replicas=2))
+    watcher.run_once()
+    api.set_workload_available(NS, "iris-dep-main", 1)
+    watcher.run_once()
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    assert cr["status"]["state"] == "Creating"
+    assert cr["status"]["predictorStatus"][0]["replicasAvailable"] == 1
+
+    api.set_workload_available(NS, "iris-dep-main", 2)
+    watcher.run_once()
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    assert cr["status"]["state"] == "Available"
+
+
+def test_unchanged_cr_causes_no_writes():
+    api, watcher = boot()
+    api.create(make_cr())
+    watcher.run_once()
+    api.set_workload_available(NS, "iris-dep-main", 1)
+    watcher.run_once()  # status converges to Available
+    before = list(api.actions)
+    watcher.run_once()
+    watcher.run_once()
+    new = api.actions[len(before):]
+    assert not new, f"steady state should be write-free, saw {new}"
+
+
+def test_spec_change_updates_workload():
+    api, watcher = boot()
+    api.create(make_cr(replicas=1))
+    watcher.run_once()
+
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    cr["spec"]["predictors"][0]["replicas"] = 3
+    api.update(cr)
+    watcher.run_once()
+
+    d = api.get("Deployment", NS, "iris-dep-main")
+    assert d["spec"]["replicas"] == 3
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    assert cr["status"]["predictorStatus"][0]["replicas"] == 3
+
+
+def test_renamed_predictor_prunes_orphan_workload():
+    api, watcher = boot()
+    api.create(make_cr(predictor="main"))
+    watcher.run_once()
+    assert api.get("Deployment", NS, "iris-dep-main") is not None
+
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    cr["spec"]["predictors"][0]["name"] = "canary"
+    api.update(cr)
+    watcher.run_once()
+
+    assert api.get("Deployment", NS, "iris-dep-main") is None  # orphan pruned
+    assert api.get("Deployment", NS, "iris-dep-canary") is not None
+
+
+def test_invalid_cr_writes_failed_status():
+    api, watcher = boot()
+    bad = make_cr()
+    bad["spec"]["predictors"][0]["graph"] = {
+        "name": "orphan",
+        "type": "MODEL",
+        # no implementation / model_class / endpoint / container
+    }
+    api.create(bad)
+    watcher.run_once()
+    cr = api.get("SeldonDeployment", NS, "iris-dep")
+    assert cr["status"]["state"] == "Failed"
+    assert cr["status"]["description"]
+    # nothing half-created
+    assert api.list("Deployment", NS) == []
+
+
+def test_deleted_cr_prunes_owned_resources():
+    api, watcher = boot()
+    api.create(make_cr())
+    watcher.run_once()
+    assert api.list("Deployment", NS) and api.list("Service", NS)
+
+    api.delete("SeldonDeployment", NS, "iris-dep")
+    actions = watcher.run_once()
+    assert actions == {"iris-dep": "pruned"}
+    assert api.list("Deployment", NS) == []
+    assert api.list("Service", NS) == []
+
+
+def test_two_deployments_are_isolated():
+    api, watcher = boot()
+    api.create(make_cr(name="dep-a"))
+    api.create(make_cr(name="dep-b"))
+    watcher.run_once()
+    assert len(api.list("Deployment", NS)) == 2
+
+    api.delete("SeldonDeployment", NS, "dep-a")
+    watcher.run_once()
+    names = [d["metadata"]["name"] for d in api.list("Deployment", NS)]
+    assert names == ["dep-b-main"]
+
+
+def test_controller_reconcile_is_idempotent():
+    api = FakeKubeApi()
+    ctl = SeldonDeploymentController(api)
+    cr = api.create(make_cr())
+    ctl.reconcile(cr)
+    n_after_first = len(api.list("Deployment", NS)) + len(api.list("Service", NS))
+    before = list(api.actions)
+    ctl.reconcile(api.get("SeldonDeployment", NS, "iris-dep"))
+    creates = [a for a in api.actions[len(before):] if a[0] in ("create", "update", "delete")]
+    assert creates == []
+    assert len(api.list("Deployment", NS)) + len(api.list("Service", NS)) == n_after_first
+
+
+def test_multihost_statefulset_status_aggregates():
+    """Predictors compiled to per-replica StatefulSets (multi-host slices,
+    named <dep>-<pred>-r<i>) must still reach Available via label lookup."""
+    api, watcher = boot()
+    cr = make_cr(name="llm", replicas=2)
+    cr["spec"]["predictors"][0]["annotations"] = {
+        "seldon.io/tpu-chips": "16"  # 2 hosts per slice -> StatefulSets
+    }
+    api.create(cr)
+    watcher.run_once()
+
+    sts = api.list("StatefulSet", NS)
+    names = sorted(s["metadata"]["name"] for s in sts)
+    assert names == ["llm-main-r0", "llm-main-r1"]
+    status = api.get("SeldonDeployment", NS, "llm")["status"]
+    assert status["state"] == "Creating"
+
+    for n in names:
+        api.set_workload_available(NS, n, 2)  # both hosts of each slice up
+    watcher.run_once()
+    status = api.get("SeldonDeployment", NS, "llm")["status"]
+    assert status["state"] == "Available"
+    assert status["predictorStatus"][0]["replicasAvailable"] == 4  # pods
+
+
+def test_stale_hash_triggers_update_but_fresh_does_not():
+    api = FakeKubeApi()
+    ctl = SeldonDeploymentController(api)
+    ctl.reconcile(api.create(make_cr()))
+    d = api.get("Deployment", NS, "iris-dep-main")
+    assert d["metadata"]["annotations"]["seldon.io/spec-hash"]
+    # simulate apiserver defaulting extra fields: no update should follow
+    d["spec"]["progressDeadlineSeconds"] = 600
+    api.update(d)
+    before = list(api.actions)
+    ctl.reconcile(api.get("SeldonDeployment", NS, "iris-dep"))
+    writes = [a for a in api.actions[len(before):] if a[0] != "patch_status"]
+    assert writes == [], f"defaulted fields must not cause writes: {writes}"
+
+
+def test_crd_manifest_round_trips_json():
+    # the manifest is emitted to users (kubectl apply -f) — must be pure JSON
+    json.loads(json.dumps(crd_manifest()))
