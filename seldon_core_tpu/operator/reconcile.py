@@ -294,7 +294,7 @@ class SeldonDeploymentController:
                 "state": "Failed",
                 "description": f"{type(e).__name__}: {e}",
             }
-            self._write_status(ns, name, status)
+            self._write_status(ns, name, status, prev=cr.get("status"))
             return status
 
         owner_ref = self._owner_ref(cr)
@@ -345,7 +345,7 @@ class SeldonDeploymentController:
             self.api.delete(kind, ns, obj_name)
 
         status = self.compute_status(dep, ns, owner=name)
-        self._write_status(ns, name, status)
+        self._write_status(ns, name, status, prev=cr.get("status"))
         return status
 
     def prune(self, namespace: str, name: str) -> int:
@@ -417,7 +417,14 @@ class SeldonDeploymentController:
             "blockOwnerDeletion": True,
         }
 
-    def _write_status(self, ns: str, name: str, status: dict) -> None:
+    def _write_status(
+        self, ns: str, name: str, status: dict, prev: Optional[dict] = None
+    ) -> None:
+        # Skip no-op writes: an unconditional patch bumps resourceVersion
+        # every sweep, which the watcher would read as "CR changed" and
+        # re-reconcile forever.
+        if prev is not None and prev == status:
+            return
         out = self.api.patch_status(KIND, ns, name, status)
         if out is None:
             logger.warning("status write failed: %s/%s not found", ns, name)
@@ -462,14 +469,31 @@ class SeldonDeploymentWatcher:
                 # changes without touching the CR (DeploymentWatcher.java)
                 self._refresh_status(cr)
                 continue
-            self.controller.reconcile(cr)
-            # re-read: reconcile's status write bumped the rv
-            cur = self.api.get(KIND, self.namespace, name)
-            self._seen[name] = (
-                cur.get("metadata", {}).get("resourceVersion", rv)
-                if cur
-                else rv
-            )
+            # Per-CR isolation: an API failure against one CR (e.g. a 409 on
+            # a pre-existing unowned Deployment) must not starve the CRs
+            # after it in the sweep, and must surface on the CR's status.
+            try:
+                self.controller.reconcile(cr)
+            except Exception as e:
+                logger.exception("reconcile of %s failed", name)
+                actions[name] = f"error: {type(e).__name__}"
+                try:
+                    self.controller._write_status(
+                        self.namespace, name,
+                        {"state": "Failed",
+                         "description": f"{type(e).__name__}: {e}"},
+                    )
+                except Exception:
+                    pass
+                # leave _seen untouched so the next sweep retries
+                continue
+            # Record the rv we RECONCILED (read before the sweep), not the
+            # post-status-write rv: a user spec edit landing between
+            # reconcile() and a re-read would otherwise be marked seen and
+            # silently dropped.  The status write bumps the rv, so the next
+            # sweep re-reconciles once more and converges (reconcile is
+            # idempotent — hash-guarded update path).
+            self._seen[name] = rv
             actions[name] = "reconciled"
         # deletions
         for name in list(self._seen):
@@ -567,8 +591,6 @@ class HttpKubeApi:
             except OSError:
                 token = ""
         self.token = token
-        import os
-
         self.verify = verify if verify is not None else (
             f"{_SA_DIR}/ca.crt" if os.path.exists(f"{_SA_DIR}/ca.crt") else None
         )

@@ -1,0 +1,37 @@
+"""Test & bench tooling (SURVEY.md §2.6).
+
+- :mod:`seldon_core_tpu.tools.contract` — contract-driven tensor generation
+  (reference ``wrappers/testing/tester.py`` semantics).
+- :mod:`seldon_core_tpu.tools.tester` — component + external-API testers
+  (reference ``util/api_tester/api-tester.py``).
+- :mod:`seldon_core_tpu.tools.loadtest` — async socket load harness over
+  REST/gRPC/framed (reference ``util/loadtester`` locust scripts).
+
+CLI: ``python -m seldon_core_tpu.tools {contract-test,api-test,load}``.
+"""
+
+from seldon_core_tpu.tools.contract import Contract, FeatureDef, validate_response
+from seldon_core_tpu.tools.loadtest import (
+    FramedDriver,
+    GrpcDriver,
+    LoadResult,
+    RestDriver,
+    oauth_token,
+    run_load,
+)
+from seldon_core_tpu.tools.tester import TestReport, test_api, test_component
+
+__all__ = [
+    "Contract",
+    "FeatureDef",
+    "validate_response",
+    "LoadResult",
+    "RestDriver",
+    "GrpcDriver",
+    "FramedDriver",
+    "oauth_token",
+    "run_load",
+    "TestReport",
+    "test_api",
+    "test_component",
+]

@@ -1,0 +1,161 @@
+"""CLI: ``python -m seldon_core_tpu.tools <subcommand>``.
+
+Subcommands (reference counterparts in parens):
+
+- ``contract-test``  standalone component tester (``wrappers/testing/tester.py``)
+- ``api-test``       deployed-graph tester incl. OAuth (``util/api_tester/api-tester.py``)
+- ``load``           socket load harness (``util/loadtester`` locust scripts)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from seldon_core_tpu.tools.contract import Contract
+
+
+def _add_common(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("contract", help="path to contract.json")
+    ap.add_argument("-n", "--n-requests", type=int, default=1)
+    ap.add_argument("-b", "--batch-size", type=int, default=1)
+    ap.add_argument("--ndarray", action="store_true", help="ndarray payload (default: tensor)")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("-v", "--verbose", action="store_true", help="print responses")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="seldon-tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    ct = sub.add_parser("contract-test", help="drive a standalone component")
+    _add_common(ct)
+    ct.add_argument("--host", default="127.0.0.1")
+    ct.add_argument("-p", "--port", type=int, default=8000)
+    ct.add_argument("-t", "--transport", choices=["rest", "grpc", "framed"], default="rest")
+    ct.add_argument("--endpoint", choices=["predict", "send-feedback"], default="predict")
+
+    at = sub.add_parser("api-test", help="drive a deployed graph via the external API")
+    _add_common(at)
+    at.add_argument("--url", default="http://127.0.0.1:8080", help="gateway/engine base URL")
+    at.add_argument("--grpc-target", default="", help="host:port → use gRPC Seldon service")
+    at.add_argument("--oauth-key", default="")
+    at.add_argument("--oauth-secret", default="")
+    at.add_argument("--endpoint", choices=["predict", "feedback"], default="predict")
+
+    ld = sub.add_parser("load", help="socket load harness")
+    ld.add_argument("contract", help="path to contract.json")
+    ld.add_argument("--url", default="http://127.0.0.1:8080")
+    ld.add_argument("--grpc-target", default="")
+    ld.add_argument("--framed-target", default="", help="host:port for SELF-framed TCP")
+    ld.add_argument("--path", default="/api/v0.1/predictions")
+    ld.add_argument("--grpc-service", default="Seldon", choices=["Seldon", "Model"])
+    ld.add_argument("--oauth-key", default="")
+    ld.add_argument("--oauth-secret", default="")
+    ld.add_argument("-c", "--concurrency", type=int, default=64)
+    ld.add_argument("-s", "--seconds", type=float, default=5.0)
+    ld.add_argument("--warmup", type=float, default=0.5)
+    ld.add_argument("-b", "--batch-size", type=int, default=1)
+    ld.add_argument("--seed", type=int, default=0)
+
+    args = ap.parse_args(argv)
+    contract = Contract.load(args.contract)
+
+    if args.cmd == "contract-test":
+        from seldon_core_tpu.tools.tester import test_component
+
+        report = asyncio.run(
+            test_component(
+                contract,
+                host=args.host,
+                port=args.port,
+                transport=args.transport,
+                endpoint=args.endpoint,
+                n_requests=args.n_requests,
+                batch_size=args.batch_size,
+                tensor=not args.ndarray,
+                seed=args.seed,
+            )
+        )
+        out = report.to_dict()
+        if not args.verbose:
+            out.pop("responses")
+        print(json.dumps(out, indent=2))
+        return 0 if report.ok else 1
+
+    if args.cmd == "api-test":
+        from seldon_core_tpu.tools.tester import test_api
+
+        report = asyncio.run(
+            test_api(
+                contract,
+                base_url=args.url,
+                oauth_key=args.oauth_key,
+                oauth_secret=args.oauth_secret,
+                grpc_target=args.grpc_target,
+                endpoint=args.endpoint,
+                n_requests=args.n_requests,
+                batch_size=args.batch_size,
+                tensor=not args.ndarray,
+                seed=args.seed,
+            )
+        )
+        out = report.to_dict()
+        if not args.verbose:
+            out.pop("responses")
+        print(json.dumps(out, indent=2))
+        return 0 if report.ok else 1
+
+    # load
+    from seldon_core_tpu.tools.loadtest import (
+        FramedDriver,
+        GrpcDriver,
+        RestDriver,
+        oauth_token,
+        run_load,
+    )
+
+    import numpy as np
+
+    payload = contract.rest_request(
+        args.batch_size, rng=np.random.default_rng(args.seed)
+    )
+
+    async def _run():
+        token = ""
+        if args.oauth_key:
+            token = await oauth_token(args.url, args.oauth_key, args.oauth_secret)
+        if args.grpc_target:
+            driver = GrpcDriver(
+                args.grpc_target, payload, service=args.grpc_service, token=token
+            )
+            proto = "grpc"
+        elif args.framed_target:
+            host, _, port = args.framed_target.rpartition(":")
+            driver = FramedDriver(
+                host or "127.0.0.1", int(port), payload, pool=args.concurrency
+            )
+            proto = "framed"
+        else:
+            driver = RestDriver(
+                args.url, payload, path=args.path, token=token,
+                connections=max(args.concurrency, 16),
+            )
+            proto = "rest"
+        return await run_load(
+            driver,
+            seconds=args.seconds,
+            concurrency=args.concurrency,
+            warmup_s=args.warmup,
+            protocol=proto,
+        )
+
+    result = asyncio.run(_run())
+    print(json.dumps(result.to_dict(), indent=2))
+    return 0 if result.failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,271 @@
+"""Async socket load harness — the locust-equivalent.
+
+Drives the REAL servers over real sockets (REST/aiohttp, gRPC, SELF-framed
+TCP), closed-loop with N concurrent workers, recording per-request latency
+and reporting throughput + percentiles in the reference's benchmark format
+(docs/benchmarking.md: req/s, p50/p75/p90/p95/p99).
+
+Reference counterparts: ``util/loadtester/scripts/predict_rest_locust.py``
+(OAuth dance at :70-80), ``predict_grpc_locust.py``; deployed via
+``helm-charts/seldon-core-loadtesting``.  Ours is a single asyncio process —
+one core of a TPU-VM host drives far more traffic than locust's
+process-per-slave model needed for the same numbers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class LoadResult:
+    protocol: str
+    requests: int
+    failures: int
+    seconds: float
+    latencies_ms: List[float] = field(default_factory=list)
+
+    @property
+    def req_per_s(self) -> float:
+        return self.requests / self.seconds if self.seconds > 0 else 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return float(np.percentile(np.asarray(self.latencies_ms), p))
+
+    def to_dict(self) -> dict:
+        return {
+            "protocol": self.protocol,
+            "requests": self.requests,
+            "failures": self.failures,
+            "seconds": round(self.seconds, 3),
+            "req_per_s": round(self.req_per_s, 1),
+            "latency_ms": {
+                "p50": round(self.percentile(50), 3),
+                "p75": round(self.percentile(75), 3),
+                "p90": round(self.percentile(90), 3),
+                "p95": round(self.percentile(95), 3),
+                "p99": round(self.percentile(99), 3),
+                "mean": round(float(np.mean(self.latencies_ms)), 3)
+                if self.latencies_ms
+                else 0.0,
+            },
+        }
+
+
+async def oauth_token(
+    base_url: str, key: str, secret: str, session=None
+) -> str:
+    """Client-credentials token dance (reference locust ``getToken``,
+    ``predict_rest_locust.py:70-80``; gateway ``/oauth/token``)."""
+    import aiohttp
+
+    own = session is None
+    sess = session or aiohttp.ClientSession()
+    try:
+        async with sess.post(
+            f"{base_url.rstrip('/')}/oauth/token",
+            data={"grant_type": "client_credentials"},
+            auth=aiohttp.BasicAuth(key, secret),
+        ) as resp:
+            body = await resp.json(content_type=None)
+            if resp.status != 200:
+                raise RuntimeError(f"token endpoint HTTP {resp.status}: {body}")
+            return body["access_token"]
+    finally:
+        if own:
+            await sess.close()
+
+
+# ---------------------------------------------------------------------------
+# protocol drivers: async callables () -> None, raising on failure
+# ---------------------------------------------------------------------------
+
+
+class RestDriver:
+    """POST /api/v0.1/predictions (engine/gateway) or /predict (component)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        payload: dict,
+        path: str = "/api/v0.1/predictions",
+        token: str = "",
+        connections: int = 128,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.path = path
+        self.body = json.dumps(payload).encode()
+        self.headers = {"Content-Type": "application/json"}
+        if token:
+            self.headers["Authorization"] = f"Bearer {token}"
+        self._connections = connections
+        self._session = None
+
+    async def __aenter__(self):
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(
+                limit=self._connections, keepalive_timeout=60
+            ),
+            timeout=aiohttp.ClientTimeout(total=30),
+        )
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._session is not None:
+            await self._session.close()
+
+    async def __call__(self) -> None:
+        async with self._session.post(
+            self.base_url + self.path, data=self.body, headers=self.headers
+        ) as resp:
+            await resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}")
+
+
+class GrpcDriver:
+    """Seldon.Predict (external) or Model.Predict (component) over one
+    persistent aio channel."""
+
+    def __init__(
+        self,
+        target: str,
+        payload: dict,
+        service: str = "Seldon",
+        token: str = "",
+    ):
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.proto.convert import message_to_proto
+
+        self.target = target
+        self.service = service
+        self.request_pb = message_to_proto(SeldonMessage.from_dict(payload))
+        self.token = token
+        self._channel = None
+        self._call = None
+
+    async def __aenter__(self):
+        import grpc.aio
+
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.serving.grpc_api import _PKG, grpc_options
+
+        self._channel = grpc.aio.insecure_channel(
+            self.target, options=grpc_options()
+        )
+        self._call = self._channel.unary_unary(
+            f"/{_PKG}.{self.service}/Predict",
+            request_serializer=pb.SeldonMessage.SerializeToString,
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._channel is not None:
+            await self._channel.close()
+
+    async def __call__(self) -> None:
+        md = (("oauth_token", self.token),) if self.token else ()
+        await self._call(self.request_pb, timeout=30, metadata=md)
+
+
+class FramedDriver:
+    """SELF-framed TCP path (native epoll server): a pool of event-loop
+    native connections, one checked out per in-flight request."""
+
+    def __init__(self, host: str, port: int, payload: dict, pool: int = 16):
+        self.host, self.port = host, port
+        self.payload = payload
+        self.pool = pool
+        self._clients: list = []
+        self._free: Optional[asyncio.Queue] = None
+
+    async def __aenter__(self):
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.serving.framed import AsyncFramedClient
+
+        self._msg = SeldonMessage.from_dict(self.payload)
+        self._free = asyncio.Queue()
+        for _ in range(self.pool):
+            c = await AsyncFramedClient().connect(self.host, self.port)
+            self._clients.append(c)
+            self._free.put_nowait(c)
+        return self
+
+    async def __aexit__(self, *exc):
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    async def __call__(self) -> None:
+        client = await self._free.get()
+        try:
+            await client.predict(self._msg)
+        finally:
+            self._free.put_nowait(client)
+
+
+# ---------------------------------------------------------------------------
+# the loop
+# ---------------------------------------------------------------------------
+
+
+async def run_load(
+    driver: Any,
+    seconds: float = 5.0,
+    concurrency: int = 64,
+    warmup_s: float = 0.5,
+    protocol: str = "",
+) -> LoadResult:
+    """Closed-loop: ``concurrency`` workers each issue requests back-to-back
+    for ``seconds`` after a warmup window (excluded from stats)."""
+    async with driver:
+        lat: List[float] = []
+        failures = 0
+        count = 0
+        t_start = time.perf_counter() + warmup_s
+        t_end = t_start + seconds
+
+        async def worker():
+            nonlocal failures, count
+            while True:
+                now = time.perf_counter()
+                if now >= t_end:
+                    return
+                t0 = now
+                try:
+                    await driver()
+                except Exception:
+                    if t0 >= t_start:
+                        failures += 1
+                    continue
+                t1 = time.perf_counter()
+                if t0 >= t_start:
+                    count += 1
+                    lat.append((t1 - t0) * 1000.0)
+
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+        measured = time.perf_counter() - t_start
+        return LoadResult(
+            protocol=protocol or type(driver).__name__,
+            requests=count,
+            failures=failures,
+            seconds=min(measured, seconds) or seconds,
+            latencies_ms=lat,
+        )
+
+
+def run_load_sync(driver, **kw) -> LoadResult:
+    return asyncio.run(run_load(driver, **kw))

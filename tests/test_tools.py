@@ -1,0 +1,334 @@
+"""Tools tests: contract generation + real-socket testers and load harness.
+
+The servers here are the REAL aiohttp/gRPC/framed servers bound to ephemeral
+localhost ports — nothing is mocked (strengthens the reference pattern, which
+drove Flask test clients in-process: ``wrappers/python/test_model_microservice.py``).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.component import ComponentHandle
+from seldon_core_tpu.tools.contract import Contract, validate_response
+from seldon_core_tpu.tools.loadtest import GrpcDriver, RestDriver, run_load
+from seldon_core_tpu.tools.tester import test_api as run_api_test
+from seldon_core_tpu.tools.tester import test_component as run_component_test
+
+CONTRACT = {
+    "features": [
+        {"name": "x", "ftype": "continuous", "dtype": "FLOAT",
+         "range": [0, 1], "shape": [2]},
+        {"name": "age", "ftype": "continuous", "dtype": "INT", "range": [18, 65]},
+        {"name": "r", "ftype": "continuous", "dtype": "FLOAT", "repeat": 2},
+    ],
+    "targets": [
+        {"name": "proba", "ftype": "continuous", "dtype": "FLOAT",
+         "range": [0, 1], "shape": [5]}
+    ],
+}
+
+
+class EchoWidth:
+    """Identity-ish model: returns (n, 5) to match CONTRACT targets."""
+
+    def predict(self, X, names=None):
+        return np.ones((np.asarray(X).shape[0], 5), dtype=np.float64) * 0.2
+
+
+class TestContractGeneration:
+    def test_shapes_and_names(self):
+        c = Contract.from_dict(CONTRACT)
+        # widths: x→2, age→1, r1→1, r2→1 = 5
+        assert len(c.feature_names()) == 5
+        batch = c.generate_batch(7, rng=np.random.default_rng(0))
+        assert batch.shape == (7, 5)
+
+    def test_ranges_respected(self):
+        c = Contract.from_dict(CONTRACT)
+        batch = c.generate_batch(500, rng=np.random.default_rng(1))
+        x = batch[:, 0:2]
+        assert x.min() >= 0.0 and x.max() <= 1.0
+        age = batch[:, 2]
+        assert age.min() >= 18 and age.max() <= 65
+        assert np.all(age == np.floor(age))  # INT dtype
+
+    def test_unbounded_and_halfbounded(self):
+        c = Contract.from_dict(
+            {"features": [
+                {"name": "a", "ftype": "continuous"},
+                {"name": "b", "ftype": "continuous", "range": [3, "inf"]},
+                {"name": "d", "ftype": "continuous", "range": ["inf", -1]},
+            ]}
+        )
+        batch = c.generate_batch(200, rng=np.random.default_rng(2))
+        assert batch[:, 1].min() >= 3.0
+        assert batch[:, 2].max() <= -1.0
+
+    def test_categorical(self):
+        c = Contract.from_dict(
+            {"features": [{"name": "c", "ftype": "categorical", "values": [0, 5, 9]}]}
+        )
+        batch = c.generate_batch(100, rng=np.random.default_rng(3))
+        assert set(np.unique(batch)) <= {0.0, 5.0, 9.0}
+
+    def test_rest_request_tensor_and_ndarray(self):
+        c = Contract.from_dict(CONTRACT)
+        rng = np.random.default_rng(4)
+        t = c.rest_request(3, tensor=True, rng=rng)
+        assert t["data"]["tensor"]["shape"] == [3, 5]
+        assert len(t["data"]["tensor"]["values"]) == 15
+        nd = c.rest_request(3, tensor=False, rng=rng)
+        assert len(nd["data"]["ndarray"]) == 3
+        # both parse as SeldonMessage
+        assert SeldonMessage.from_dict(t).host_data().shape == (3, 5)
+        assert SeldonMessage.from_dict(nd).host_data().shape == (3, 5)
+
+    def test_feedback_request(self):
+        c = Contract.from_dict(CONTRACT)
+        fb = c.feedback_request(2, reward=0.5, rng=np.random.default_rng(5))
+        assert fb["reward"] == 0.5
+        assert np.asarray(fb["response"]["data"]["ndarray"]).shape == (2, 5)
+
+    def test_validate_response(self):
+        c = Contract.from_dict(CONTRACT)
+        good = {"data": {"ndarray": [[0.1] * 5]}}
+        assert validate_response(c, good) == []
+        bad = {"data": {"ndarray": [[0.1] * 3]}}
+        assert validate_response(c, bad)
+        failed = {"status": {"status": "FAILURE", "info": "boom"}}
+        assert validate_response(c, failed)
+
+
+def _start_rest(handle_or_engine, component=True):
+    """Start a real REST server on an ephemeral port; returns (runner, port)."""
+    from seldon_core_tpu.serving.rest import build_app, start_server
+
+    async def _go():
+        app = build_app(
+            component=handle_or_engine if component else None,
+            engine=None if component else handle_or_engine,
+        )
+        runner = await start_server(app, host="127.0.0.1", port=0)
+        port = runner.addresses[0][1]
+        return runner, port
+
+    return _go()
+
+
+class TestComponentTester:
+    async def test_rest_socket(self):
+        runner, port = await _start_rest(
+            ComponentHandle(EchoWidth(), name="echo", service_type="MODEL")
+        )
+        try:
+            report = await run_component_test(
+                Contract.from_dict(CONTRACT),
+                port=port, n_requests=3, batch_size=4, seed=0,
+            )
+            assert report.ok, report.failures
+            assert report.sent == 3
+        finally:
+            await runner.cleanup()
+
+    async def test_rest_socket_bad_width_fails(self):
+        class Wrong:
+            def predict(self, X, names=None):
+                return np.zeros((np.asarray(X).shape[0], 2))
+
+        runner, port = await _start_rest(
+            ComponentHandle(Wrong(), name="wrong", service_type="MODEL")
+        )
+        try:
+            report = await run_component_test(
+                Contract.from_dict(CONTRACT), port=port, n_requests=1, seed=0
+            )
+            assert not report.ok
+        finally:
+            await runner.cleanup()
+
+    async def test_grpc_socket(self):
+        from seldon_core_tpu.serving.grpc_api import (
+            GrpcServer,
+            component_service_handlers,
+        )
+
+        handle = ComponentHandle(EchoWidth(), name="echo", service_type="MODEL")
+        server = GrpcServer(
+            component_service_handlers(handle, "MODEL"), port=0, host="127.0.0.1"
+        )
+        port = await server.start()
+        try:
+            report = await run_component_test(
+                Contract.from_dict(CONTRACT),
+                port=port, transport="grpc", n_requests=2, batch_size=3, seed=0,
+            )
+            assert report.ok, report.failures
+        finally:
+            await server.stop()
+
+    async def test_framed_socket(self):
+        from seldon_core_tpu.native import load
+
+        if load() is None:
+            pytest.skip("native library unavailable")
+        from seldon_core_tpu.serving.framed import FramedComponentServer
+
+        handle = ComponentHandle(EchoWidth(), name="echo", service_type="MODEL")
+        with FramedComponentServer(handle) as srv:
+            report = await run_component_test(
+                Contract.from_dict(CONTRACT),
+                port=srv.port, transport="framed", n_requests=2, seed=0,
+            )
+            assert report.ok, report.failures
+
+
+class TestApiTester:
+    async def test_engine_rest(self):
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        runner, port = await _start_rest(eng, component=False)
+        try:
+            report = await run_api_test(
+                Contract.from_dict(
+                    {"features": CONTRACT["features"], "targets": []}
+                ),
+                base_url=f"http://127.0.0.1:{port}",
+                n_requests=2, batch_size=2, seed=0,
+            )
+            assert report.ok, report.failures
+        finally:
+            await runner.cleanup()
+
+    async def test_gateway_oauth_dance(self):
+        """Full api-tester path: token endpoint → Bearer predict through the
+        gateway → engine (reference api-tester.py --oauth-key semantics)."""
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+        from seldon_core_tpu.serving.rest import start_server
+
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        eng_runner, eng_port = await _start_rest(eng, component=False)
+        store = DeploymentStore()
+        store.put(
+            DeploymentRecord(
+                name="dep1",
+                oauth_key="key1",
+                oauth_secret="sec1",
+                engine_url=f"http://127.0.0.1:{eng_port}",
+            )
+        )
+        gw = Gateway(store)
+        gw_runner = await start_server(gw.build_app(), host="127.0.0.1", port=0)
+        gw_port = gw_runner.addresses[0][1]
+        try:
+            report = await run_api_test(
+                Contract.from_dict(
+                    {"features": CONTRACT["features"], "targets": []}
+                ),
+                base_url=f"http://127.0.0.1:{gw_port}",
+                oauth_key="key1", oauth_secret="sec1",
+                n_requests=2, seed=0,
+            )
+            assert report.ok, report.failures
+        finally:
+            await gw_runner.cleanup()
+            await eng_runner.cleanup()
+            await gw.close()
+
+
+class TestLoadHarness:
+    async def test_rest_load(self):
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        runner, port = await _start_rest(eng, component=False)
+        try:
+            c = Contract.from_dict(CONTRACT)
+            driver = RestDriver(
+                f"http://127.0.0.1:{port}",
+                c.rest_request(1, rng=np.random.default_rng(0)),
+            )
+            res = await run_load(
+                driver, seconds=0.5, concurrency=8, warmup_s=0.1, protocol="rest"
+            )
+            assert res.failures == 0
+            assert res.requests > 10
+            d = res.to_dict()
+            assert d["latency_ms"]["p99"] >= d["latency_ms"]["p50"] >= 0
+        finally:
+            await runner.cleanup()
+
+    async def test_grpc_load(self):
+        from seldon_core_tpu.serving.grpc_api import (
+            GrpcServer,
+            seldon_service_handler,
+        )
+
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        server = GrpcServer([seldon_service_handler(eng)], port=0, host="127.0.0.1")
+        port = await server.start()
+        try:
+            c = Contract.from_dict(CONTRACT)
+            driver = GrpcDriver(
+                f"127.0.0.1:{port}",
+                c.rest_request(1, rng=np.random.default_rng(0)),
+            )
+            res = await run_load(
+                driver, seconds=0.5, concurrency=8, warmup_s=0.1, protocol="grpc"
+            )
+            assert res.failures == 0
+            assert res.requests > 10
+        finally:
+            await server.stop()
+
+
+class TestCli:
+    def test_contract_test_cli(self, tmp_path):
+        """End-to-end CLI: server in-process, CLI drives it over the socket."""
+        import threading
+
+        from seldon_core_tpu.tools.__main__ import main
+
+        cpath = tmp_path / "contract.json"
+        cpath.write_text(json.dumps(CONTRACT))
+
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        state = {}
+
+        def serve():
+            asyncio.set_event_loop(loop)
+
+            async def boot():
+                from seldon_core_tpu.serving.rest import build_app, start_server
+
+                handle = ComponentHandle(
+                    EchoWidth(), name="echo", service_type="MODEL"
+                )
+                runner = await start_server(
+                    build_app(component=handle), host="127.0.0.1", port=0
+                )
+                state["port"] = runner.addresses[0][1]
+                state["runner"] = runner
+                started.set()
+
+            loop.run_until_complete(boot())
+            loop.run_forever()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert started.wait(10)
+        try:
+            rc = main(
+                ["contract-test", str(cpath), "-p", str(state["port"]),
+                 "-n", "2", "-b", "3", "--seed", "0"]
+            )
+            assert rc == 0
+        finally:
+            asyncio.run_coroutine_threadsafe(
+                state["runner"].cleanup(), loop
+            ).result(10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(5)
