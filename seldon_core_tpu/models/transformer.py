@@ -63,8 +63,10 @@ class TransformerConfig:
     seq_shard: bool = True
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
     # Pallas flash-attention kernel (ops/attention.py) on the dense path:
-    # O(L) memory, scores never hit HBM.  Off on sharded meshes — GSPMD
-    # can't partition through pallas_call; ring attention covers that case.
+    # O(L) memory, scores never hit HBM.  On sharded meshes the kernel is
+    # invoked per-device inside a shard_map over (dp, tp) — batch and heads
+    # are embarrassingly parallel, the sequence stays whole per device —
+    # so GSPMD is never asked to partition through pallas_call.
     use_flash: bool = False
 
     @property
@@ -227,10 +229,27 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None):
         q = c(q, "dp", None, "tp", None)
         k = c(k, "dp", None, "tp", None)
         v = c(v, "dp", None, "tp", None)
-        if cfg.use_flash and mesh is None:
+        if cfg.use_flash:
             from seldon_core_tpu.ops.attention import flash_attention
 
-            attn = flash_attention(q, k, v, causal=True)
+            if mesh is None:
+                attn = flash_attention(q, k, v, causal=True)
+            else:
+                # Per-device flash: batch over dp, heads over tp — both
+                # independent in attention, sequence whole per shard, so the
+                # manual per-device kernel is exact.  Inside the pp pipeline
+                # the context mesh already marks pp Manual; pass mesh=None to
+                # adopt it (partial-manual shard_map, same as the ring path).
+                ctx = jax.sharding.get_abstract_mesh()
+                spec = P("dp", None, "tp", None)
+                attn = jax.shard_map(
+                    partial(flash_attention, causal=True),
+                    mesh=None if not ctx.empty else mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    axis_names={"dp", "tp"},
+                    check_vma=False,
+                )(q, k, v)
         else:
             attn = dense_attention(q, k, v, causal=True)
     out = jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(x.dtype))

@@ -478,10 +478,13 @@ class SeldonDeploymentWatcher:
                 logger.exception("reconcile of %s failed", name)
                 actions[name] = f"error: {type(e).__name__}"
                 try:
+                    # prev guard: a persistently failing CR would otherwise
+                    # get an identical Failed patch (and rv bump) every sweep
                     self.controller._write_status(
                         self.namespace, name,
                         {"state": "Failed",
                          "description": f"{type(e).__name__}: {e}"},
+                        prev=cr.get("status"),
                     )
                 except Exception:
                     pass
@@ -517,9 +520,11 @@ class SeldonDeploymentWatcher:
         prev = cr.get("status")
         if prev != status:
             self.api.patch_status(KIND, self.namespace, name, status)
-            cur = self.api.get(KIND, self.namespace, name)
-            if cur is not None:
-                self._seen[name] = cur["metadata"].get("resourceVersion", "")
+            # Deliberately do NOT adopt the post-write resourceVersion as
+            # "reconciled": a user spec edit landing between the sweep's
+            # list and a re-read here would be marked seen and silently
+            # dropped (same race run_once documents).  Our own rv bump just
+            # triggers one extra idempotent reconcile next sweep.
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "SeldonDeploymentWatcher":

@@ -134,12 +134,47 @@ def _flash_bhld(q, k, v, causal: bool, scale: float, block_q: int,
     )(q, k, v)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_blhd(q, k, v, causal: bool, scale: float, block_q: int,
+                block_k: int, interpret: bool):
+    """Differentiable wrapper: Pallas kernel forward, dense-recompute
+    backward (custom_vjp below).  Serving never differentiates; the backward
+    exists so the same config trains (dryrun_multichip runs a full train
+    step) — a flash backward kernel is a future optimization."""
+    B, L, H, D = q.shape
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    out = _flash_bhld(qt, kt, vt, causal, scale, block_q, block_k, interpret)
+    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+def _flash_blhd_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_blhd(q, k, v, causal, scale, block_q, block_k,
+                       interpret), (q, k, v)
+
+
+def _flash_blhd_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    from seldon_core_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal,
+                                           scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_blhd.defvjp(_flash_blhd_fwd, _flash_blhd_bwd)
+
+
 def flash_attention(
     q, k, v,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ):
     """Flash attention on ``(batch, seq, heads, d_head)`` tensors.
@@ -147,31 +182,42 @@ def flash_attention(
     Falls back to the dense reference path when the sequence doesn't tile
     (shorter than a block and not divisible) — the caller never has to
     special-case shapes.
+
+    Default 512x512 blocks: measured on v5e (B=4, H=8, D=64) they run
+    1.5-2.3x faster than XLA's fused dense attention at L=1k-4k, where the
+    128x128 blocks of the textbook schedule are *slower* than dense (too
+    little MXU work per grid step).  At L>=8k dense attention fails to
+    compile at all (the (B,H,L,L) score tensor exceeds HBM) while the flash
+    path keeps serving — the kernel is what unlocks long-context.
     """
     B, L, H, D = q.shape
     if scale is None:
         scale = D ** -0.5
     if interpret is None:
         interpret = use_interpret()
-    block_q = min(block_q, L)
-    block_k = min(block_k, L)
-    # Mosaic tiling wants sublane-aligned blocks: a non-multiple-of-8 block
-    # (e.g. L=20 → block 20) passes in interpreter mode but can fail when
-    # actually compiled on TPU — CPU tests cannot catch that, so route any
-    # non-aligned shape to the dense fallback instead.
-    if (
-        L % block_q
-        or L % block_k
-        or block_q % 8
-        or block_k % 8
-    ):
+    # Mosaic tiling wants sublane-aligned blocks that divide L: shrink the
+    # requested block to the largest multiple of 8 that divides L (e.g.
+    # L=8320 with the 512 default → 128) so long-but-unaligned sequences
+    # still take the flash kernel — the dense fallback materializes the
+    # (B,H,L,L) score tensor and stops compiling around L=8k.  A
+    # non-multiple-of-8 block would pass in interpreter mode but fail when
+    # compiled on TPU (CPU tests can't catch that), so if no aligned block
+    # exists (L<8 or L%8) fall back to dense.
+    block_q = _fit_block(L, block_q)
+    block_k = _fit_block(L, block_k)
+    if block_q is None or block_k is None:
         from seldon_core_tpu.parallel.ring_attention import dense_attention
 
         return dense_attention(q, k, v, causal=causal, scale=scale)
-    # (B, L, H, D) -> (B*H, L, D)
-    qt = q.transpose(0, 2, 1, 3).reshape(B * H, L, D)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * H, L, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * H, L, D)
-    out = _flash_bhld(qt, kt, vt, causal, float(scale), block_q, block_k,
-                      bool(interpret))
-    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+    return _flash_blhd(q, k, v, causal, float(scale), block_q, block_k,
+                       bool(interpret))
+
+
+def _fit_block(L: int, want: int) -> Optional[int]:
+    """Largest multiple of 8 that divides L and is <= want (None if none)."""
+    b = min(want, L) // 8 * 8
+    while b >= 8:
+        if L % b == 0:
+            return b
+        b -= 8
+    return None

@@ -210,11 +210,12 @@ class AsyncFramedClient:
     executor hop per request, so a pool of these saturates the native epoll
     server from a single-core host."""
 
-    def __init__(self):
+    def __init__(self, timeout: float = 30.0):
         self._codec = FrameCodec()
         self._reader = None
         self._writer = None
         self._lock = None  # created on connect (needs the running loop)
+        self._timeout = timeout  # parity with FramedClient's socket timeout
 
     async def connect(self, host: str = "127.0.0.1", port: int = 0) -> "AsyncFramedClient":
         import asyncio
@@ -227,14 +228,22 @@ class AsyncFramedClient:
         return self
 
     async def _roundtrip(self, payload: bytes) -> Frame:
+        import asyncio
+
         # serialize concurrent callers: interleaved reads on one StreamReader
         # would otherwise swap responses between requests
         async with self._lock:
-            self._writer.write(struct.pack("<I", len(payload)) + payload)
-            await self._writer.drain()
-            hdr = await self._reader.readexactly(4)
-            (n,) = struct.unpack("<I", hdr)
-            body = await self._reader.readexactly(n)
+
+            async def io() -> bytes:
+                self._writer.write(struct.pack("<I", len(payload)) + payload)
+                await self._writer.drain()
+                hdr = await self._reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                return await self._reader.readexactly(n)
+
+            # a wedged server must not hang the caller forever (the blocking
+            # FramedClient gets this from its socket timeout)
+            body = await asyncio.wait_for(io(), self._timeout)
         frame = self._codec.decode(body)
         if frame.msg_type == MSG_ERROR:
             msg = decode_message(frame)

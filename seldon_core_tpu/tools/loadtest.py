@@ -75,10 +75,14 @@ async def oauth_token(
             data={"grant_type": "client_credentials"},
             auth=aiohttp.BasicAuth(key, secret),
         ) as resp:
-            body = await resp.json(content_type=None)
+            # status first: a 401 with an HTML body (gateway/proxy error
+            # page) must surface as the auth failure, not a JSON decode error
+            text = await resp.text()
             if resp.status != 200:
-                raise RuntimeError(f"token endpoint HTTP {resp.status}: {body}")
-            return body["access_token"]
+                raise RuntimeError(
+                    f"token endpoint HTTP {resp.status}: {text[:500]}"
+                )
+            return json.loads(text)["access_token"]
     finally:
         if own:
             await sess.close()
@@ -210,10 +214,26 @@ class FramedDriver:
                 pass
 
     async def __call__(self) -> None:
+        from seldon_core_tpu.serving.framed import AsyncFramedClient
+
         client = await self._free.get()
         try:
+            if client is None:  # prior failure parked a tombstone: reconnect
+                client = await AsyncFramedClient().connect(self.host, self.port)
+                self._clients.append(client)
             await client.predict(self._msg)
-        finally:
+        except BaseException:
+            # the stream may be desynced mid-frame — never reuse it
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:
+                    pass
+                if client in self._clients:
+                    self._clients.remove(client)
+            self._free.put_nowait(None)
+            raise
+        else:
             self._free.put_nowait(client)
 
 

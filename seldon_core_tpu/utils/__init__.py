@@ -1,0 +1,33 @@
+"""Shared utilities: metrics, tracing, compile-cache setup."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Persistent XLA compilation cache.
+
+    Remote compiles over the device tunnel cost 20-40 s each; with the cache
+    warm a bench/dryrun run spends seconds, not minutes, in compilation.
+    Resolution order: explicit arg > ``JAX_COMPILATION_CACHE_DIR`` env >
+    ``<repo root>/.jax_cache``.  Safe to call multiple times; never raises
+    (older jax versions without the knobs just skip them).
+    """
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+                ".jax_cache",
+            ),
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass

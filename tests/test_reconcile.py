@@ -164,6 +164,22 @@ def test_invalid_cr_writes_failed_status():
     assert api.list("Deployment", NS) == []
 
 
+def test_persistently_failing_cr_does_not_churn_status():
+    """A CR that fails every sweep must get ONE Failed status write, not an
+    identical patch (and resourceVersion bump) every 5 s forever."""
+    api, watcher = boot()
+    bad = make_cr()
+    bad["spec"]["predictors"][0]["graph"] = {"name": "orphan", "type": "MODEL"}
+    api.create(bad)
+    watcher.run_once()
+    assert api.get("SeldonDeployment", NS, "iris-dep")["status"]["state"] == "Failed"
+    before = list(api.actions)
+    watcher.run_once()
+    watcher.run_once()
+    new = api.actions[len(before):]
+    assert not new, f"failing CR should be write-free at steady state, saw {new}"
+
+
 def test_deleted_cr_prunes_owned_resources():
     api, watcher = boot()
     api.create(make_cr())

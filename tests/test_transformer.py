@@ -93,6 +93,45 @@ def test_pipeline_forward_matches_flat():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+def test_flash_sharded_forward_matches_unsharded():
+    """Pallas flash kernel per-device under shard_map(dp, tp) — the
+    load-bearing serving config — must match the unsharded dense path."""
+    mesh = make_mesh(n_devices=8, tp=2, pp=1)  # dp=4, tp=2
+    cfg_f = TransformerConfig(**{**TINY.__dict__, "use_flash": True})
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    ids = tiny_batch()["input_ids"]
+    ref, _ = forward(params, ids, TINY)
+    p_sh = shard_params(params, mesh, cfg_f)
+    f = jax.jit(lambda p, i: forward(p, i, cfg_f, mesh=mesh)[0])
+    out = f(p_sh, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_flash_inside_pipeline_matches_flat():
+    """Flash under partial-manual shard_map nested in the pp pipeline's
+    manual region (the dryrun tp=2/pp=2 config)."""
+    mesh = make_mesh(n_devices=8, tp=2, pp=2)
+    cfg_f = TransformerConfig(**{**TINY.__dict__, "use_flash": True})
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    ids = tiny_batch()["input_ids"]
+    ref, _ = forward(params, ids, TINY)
+    p_sh = shard_params(params, mesh, cfg_f, pp=2)
+    f = jax.jit(
+        lambda p, i: forward(p, i, cfg_f, mesh=mesh, pp=2, n_microbatches=2)[0]
+    )
+    out = f(p_sh, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_ring_plus_pp_formally_rejected():
+    mesh = make_mesh(n_devices=8, tp=2, pp=2)
+    cfg = TransformerConfig(**{**TINY.__dict__, "attention": "ring"})
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    with pytest.raises(ValueError, match="ring"):
+        forward(params, tiny_batch()["input_ids"], cfg, mesh=mesh, pp=2,
+                n_microbatches=2)
+
+
 def test_moe_transformer_forward_and_aux():
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
