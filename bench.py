@@ -143,10 +143,13 @@ def _chained_ms(fn, x, n: int = 32, overhead_probe: bool = True) -> float:
 
     base = timed(1) if overhead_probe else 0.0
     total = timed(n + (1 if overhead_probe else 0))
-    return (total - base) / n * 1000.0
+    # clamp: when per-iter chip time << dispatch jitter (~tens of ms over
+    # the tunnel) the subtraction can go negative — report a floor instead
+    # of a nonsense negative
+    return max((total - base) / n * 1000.0, 1e-3)
 
 
-def bench_resnet50(seconds_budget: float = 60.0, batches=(64, 256)) -> dict:
+def bench_resnet50(batches=(64, 256)) -> dict:
     """ResNet50 forward img/s on the accelerator: batch sweep, on-chip
     timing (see _chained_ms), MFU estimate against v5e bf16 peak."""
     import jax
@@ -193,6 +196,8 @@ def bench_flash_attention(B: int = 4, H: int = 8, D: int = 64) -> dict:
         q = jax.random.normal(jax.random.PRNGKey(0), (B, L, H, D), jnp.bfloat16)
         k = jax.random.normal(jax.random.PRNGKey(1), (B, L, H, D), jnp.bfloat16)
         v = jax.random.normal(jax.random.PRNGKey(2), (B, L, H, D), jnp.bfloat16)
+        # enough iterations that chip time >> dispatch jitter at small L
+        n_iter = 256 if L <= 2048 else 64
         row: dict = {}
         if L >= 8192:
             # measured: dense at L=8192 crashes the remote compiler (the
@@ -204,17 +209,83 @@ def bench_flash_attention(B: int = 4, H: int = 8, D: int = 64) -> dict:
             try:
                 row["dense_ms"] = round(
                     _chained_ms(lambda c: dense_attention(c, k, v, causal=True),
-                                q, n=32), 2)
+                                q, n=n_iter), 2)
             except Exception as e:
                 row["dense_ms"] = None
                 row["dense_error"] = type(e).__name__
         row["flash_ms"] = round(
             _chained_ms(lambda c: flash_attention(c, k, v, causal=True),
-                        q, n=32), 2)
+                        q, n=n_iter), 2)
         if row.get("dense_ms"):
             row["speedup"] = round(row["dense_ms"] / row["flash_ms"], 2)
         out["sweep"][str(L)] = row
     return out
+
+
+def bench_llm_decode(batch: int = 8, n_layers: int = 4, d_model: int = 4096,
+                     n_steps: int = 64) -> dict:
+    """Autoregressive decode throughput, bf16 weights vs int8-quantized FFN
+    (ops/quant.py wired into the flagship transformer).  Decode at small
+    batch is HBM-bandwidth-bound on weight streaming — the regime int8
+    weight quantization exists for.  The decode loop runs INSIDE one jit
+    program (lax.fori_loop over decode_step with argmax feedback), so this
+    measures the chip, not the dispatch tunnel."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from seldon_core_tpu.models.transformer import (
+        TransformerConfig,
+        cast_params,
+        decode_step,
+        init_cache,
+        init_params,
+        quantize_ffn_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_layers=n_layers,
+        n_heads=d_model // 128, d_ff=4 * d_model, max_seq=512,
+        dtype=jnp.bfloat16,
+    )
+    params = cast_params(init_params(jax.random.PRNGKey(0), cfg))
+
+    def run(p) -> float:
+        def decode_n(p, cache, tok, n):
+            def body(i, carry):
+                cache, tok = carry
+                logits, cache = decode_step(p, cache, tok, cfg)
+                return cache, jnp.argmax(logits, -1).astype(tok.dtype)
+
+            cache, tok = lax.fori_loop(0, n, body, (cache, tok))
+            # scalar result + float(): block_until_ready is a no-op over
+            # the remote device tunnel; only a host materialization waits
+            return tok.sum()
+
+        f = jax.jit(decode_n)
+        cache = init_cache(cfg, batch, max_len=256)
+        tok = jnp.zeros((batch,), jnp.int32)
+
+        def timed(k):
+            float(f(p, cache, tok, k))  # compile + warm
+            t0 = time.perf_counter()
+            float(f(p, cache, tok, k))
+            return time.perf_counter() - t0
+
+        # clamp like _chained_ms: dispatch jitter over the tunnel can exceed
+        # the n-step delta for tiny models
+        dt = max((timed(n_steps + 1) - timed(1)) / n_steps, 1e-6)
+        return batch / dt  # tokens/s across the batch
+
+    bf16_tps = run(params)
+    int8_tps = run(quantize_ffn_params(params))
+    return {
+        "batch": batch,
+        "model": f"L{n_layers} d{d_model}",
+        "bf16_tokens_per_s": round(bf16_tps),
+        "int8_ffn_tokens_per_s": round(int8_tps),
+        "int8_speedup": round(int8_tps / bf16_tps, 2),
+    }
 
 
 def bench_batched_serving(seconds: float = 3.0, concurrency: int = 1024) -> float:
@@ -263,6 +334,83 @@ def bench_batched_serving(seconds: float = 3.0, concurrency: int = 1024) -> floa
         return count / (time.perf_counter() - t0)
 
     return asyncio.run(run())
+
+
+def bench_resnet_serving(seconds: float = 6.0, concurrency: int = 64) -> dict:
+    """BASELINE.md north-star metric: ResNet50 req/s/chip + p50 through the
+    FULL serving stack — framed binary socket server -> graph engine ->
+    dynamic batcher -> compiled ResNet50 on the TPU.  One uint8 image per
+    request (the realistic serving payload; JSON would pay float formatting
+    of 150k values per request).  Context: client, server, batcher, and the
+    device tunnel all share this host's single core — on a real TPU VM the
+    chip is local and cores are plentiful."""
+    import numpy as np
+
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.models.resnet import ResNet50Model
+    from seldon_core_tpu.native import load
+    from seldon_core_tpu.runtime.batcher import BatchedModel, BatcherConfig
+    from seldon_core_tpu.runtime.component import ComponentHandle
+    from seldon_core_tpu.serving.framed import AsyncFramedComponentServer
+    from seldon_core_tpu.tools.loadtest import FramedDriver, run_load
+
+    if load() is None:
+        raise RuntimeError("native library unavailable")
+    bm = BatchedModel(
+        ComponentHandle(ResNet50Model(), name="resnet50"),
+        BatcherConfig(
+            max_batch_size=64,
+            max_delay_ms=2.0,
+            max_inflight=8,
+            max_queue_rows=0,  # closed-loop bench: no shedding
+        ),
+    )
+    eng = GraphEngine({"name": "resnet50", "type": "MODEL"},
+                      resolver=lambda u: bm)
+    img = np.random.default_rng(0).integers(
+        0, 256, size=(1, 224, 224, 3), dtype=np.uint8
+    )
+    payload = SeldonMessage.from_ndarray(img)
+    bm.warmup(img[0])
+
+    async def run(engine) -> dict:
+        async with AsyncFramedComponentServer(engine) as srv:
+            res = await run_load(
+                FramedDriver("127.0.0.1", srv.port, payload,
+                             pool=concurrency),
+                seconds=seconds,
+                concurrency=concurrency,
+                warmup_s=1.0,
+                protocol="framed",
+            )
+        return res.to_dict()
+
+    out = asyncio.run(run(eng))
+    out["payload"] = "1x224x224x3 uint8"
+
+    # Attribution: the same socket/engine/batcher path with a no-device stub
+    # model (identical payload sizes) isolates the framework's own ceiling
+    # from the environment's device tunnel (~10 MB/s H2D here, so a 64-image
+    # uint8 batch pays ~1 s in transfer alone; a real TPU VM moves GB/s).
+    class _Stub:
+        name = "stub"
+
+        def has(self, m):
+            return m == "predict"
+
+        async def predict(self, msg):
+            from seldon_core_tpu.messages import SeldonMessage as _SM
+
+            rows = int(np.shape(msg.data)[0]) if msg.data is not None else 1
+            return _SM(data=np.zeros((rows, 1000), np.float32))
+
+    stub_eng = GraphEngine({"name": "resnet50", "type": "MODEL"},
+                           resolver=lambda u: _Stub())
+    stack = asyncio.run(run(stub_eng))
+    out["stack_only_req_per_s"] = stack["req_per_s"]
+    out["stack_only_p50_ms"] = stack["latency_ms"]["p50"]
+    return out
 
 
 def bench_rest_socket(seconds: float = 3.0, concurrency: int = 64) -> dict:
@@ -469,9 +617,19 @@ def main() -> None:
         except Exception as e:
             extras["resnet50_error"] = f"{type(e).__name__}: {e}"
         try:
+            extras["resnet50_serving"] = bench_resnet_serving(
+                seconds=max(args.seconds, 6.0), concurrency=256
+            )
+        except Exception as e:
+            extras["resnet50_serving_error"] = f"{type(e).__name__}: {e}"
+        try:
             extras["flash_attention"] = bench_flash_attention()
         except Exception as e:
             extras["flash_attention_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extras["llm_decode"] = bench_llm_decode()
+        except Exception as e:
+            extras["llm_decode_error"] = f"{type(e).__name__}: {e}"
 
     result = {
         "metric": "graph_orchestrator_req_per_s_1core",

@@ -1,0 +1,183 @@
+"""Int8 ResNet50 serving variant — wires ops/quant.py into the benchmark
+model (BASELINE.md config 3; the reference's closest analog is the TensorRT
+proxy path ``integrations/nvidia-inference-server/TRTProxy.py:31-80``, where
+int8 is TensorRT's job; here the framework owns the quantized compute).
+
+Inference-only redesign of :class:`~seldon_core_tpu.models.resnet.ResNet`:
+
+- **BatchNorm folding**: inference BN is an affine per-channel transform, so
+  it folds into the preceding conv's per-output-channel scale — the folded
+  network is conv(+bias)+relu only, no BN work at serving time.
+- **1x1 convs as int8 matmuls**: a 1x1 conv is exactly a (B*H*W, Cin) @
+  (Cin, Cout) matmul.  ResNet50's bottleneck design puts most weights in the
+  1x1s, which run through the int8 MXU kernel (ops/quant.py) — int8 weights
+  also halve HBM traffic on the weight-streaming path.  The folded BN scale
+  merges into the quantizer's per-channel scales for free.
+- **3x3 / 7x7 convs stay bf16** (spatial convs need im2col to reach the
+  matmul kernel; XLA already MXU-tiles them well) with the BN scale folded
+  into the kernel.
+
+Weights come from a float ResNet50Model via :func:`convert_params`, so the
+int8 variant serves the *same function* — verified by top-1 agreement tests
+(tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.ops.quant import QuantizedLinear, int8_matmul, quantize_int8
+
+_BN_EPS = 1e-5  # flax nn.BatchNorm default
+
+
+def _fold_bn(kernel, bn):
+    """Fold an inference BatchNorm into the preceding conv.
+
+    y = gamma * (conv(x) - mean) / sqrt(var + eps) + beta
+      = conv_scaled(x) + bias, with the per-output-channel scale folded into
+    the kernel's last axis.  Returns (folded_kernel f32, bias f32)."""
+    gamma = bn.get("scale", jnp.ones_like(bn["mean"]))
+    beta = bn.get("bias", jnp.zeros_like(bn["mean"]))
+    inv = gamma * jax.lax.rsqrt(bn["var"] + _BN_EPS)
+    return kernel * inv, beta - bn["mean"] * inv
+
+
+def _conv(x, kernel, bias, strides: int, dtype):
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        kernel.astype(dtype),
+        window_strides=(strides, strides),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + bias.astype(dtype)
+
+
+def _conv1x1_int8(x, q: QuantizedLinear, bias, strides: int):
+    if strides > 1:
+        x = x[:, ::strides, ::strides, :]
+    B, H, W, C = x.shape
+    y = int8_matmul(x.reshape(B * H * W, C), q, out_dtype=x.dtype)
+    return y.reshape(B, H, W, -1) + bias.astype(x.dtype)
+
+
+def convert_params(params: dict) -> dict:
+    """Float flax ResNet50 params -> folded/quantized serving weights.
+
+    Walks the flax tree by the deterministic names ``nn.Module`` assigns in
+    creation order (Conv_0/BatchNorm_0 ... inside Bottleneck_i; see
+    models/resnet.py layer order), pairing each conv with its BatchNorm,
+    folding, then quantizing every 1x1.
+    """
+    p = params["params"]
+    bn = params["batch_stats"]
+
+    def fold(scope_p, scope_bn, conv_name, bn_name):
+        k, b = _fold_bn(scope_p[conv_name]["kernel"],
+                        {**scope_bn[bn_name], **scope_p[bn_name]})
+        return k, b
+
+    out: dict = {}
+    # stem: Conv_0 + BatchNorm_0 (7x7 stride 2) — stays float, folded
+    k, b = fold(p, bn, "Conv_0", "BatchNorm_0")
+    out["stem"] = {"kernel": k, "bias": b}
+
+    blocks = []
+    i = 0
+    while f"Bottleneck_{i}" in p:
+        bp, bb = p[f"Bottleneck_{i}"], bn[f"Bottleneck_{i}"]
+        blk: dict[str, Any] = {}
+        # creation order in Bottleneck.__call__: Conv_0/BatchNorm_0 (1x1),
+        # Conv_1/BatchNorm_1 (3x3, stride), Conv_2/BatchNorm_2 (1x1, zero-init
+        # BN scale), then optional proj/proj_bn (1x1, stride)
+        for conv_name, bn_name, key in (
+            ("Conv_0", "BatchNorm_0", "c1"),
+            ("Conv_2", "BatchNorm_2", "c3"),
+        ):
+            k, b = fold(bp, bb, conv_name, bn_name)
+            kin, kout = k.shape[2], k.shape[3]
+            blk[key] = {
+                "q": quantize_int8(k.reshape(kin, kout)),
+                "bias": b,
+            }
+        k, b = fold(bp, bb, "Conv_1", "BatchNorm_1")
+        blk["c2"] = {"kernel": k, "bias": b}
+        if "proj" in bp:
+            k, b = fold(bp, bb, "proj", "proj_bn")
+            kin, kout = k.shape[2], k.shape[3]
+            blk["proj"] = {"q": quantize_int8(k.reshape(kin, kout)),
+                           "bias": b}
+        blocks.append(blk)
+        i += 1
+    out["blocks"] = blocks
+    dense = p["Dense_0"]
+    out["head"] = {"q": quantize_int8(dense["kernel"]),
+                   "bias": dense["bias"]}
+    return out
+
+
+# ResNet50 stage layout (models/resnet.py stage_sizes) — block index -> stride
+def _block_strides(stage_sizes=(3, 4, 6, 3)):
+    strides = []
+    for i, n in enumerate(stage_sizes):
+        for j in range(n):
+            strides.append(2 if i > 0 and j == 0 else 1)
+    return strides
+
+
+def forward(weights: dict, x, dtype=jnp.bfloat16, stage_sizes=(3, 4, 6, 3)):
+    """Folded int8/bf16 ResNet50 forward.  x: [B, H, W, 3] any float/int
+    dtype; returns softmax probabilities [B, 1000] float32."""
+    x = jnp.asarray(x).astype(dtype)
+    x = jax.nn.relu(_conv(x, weights["stem"]["kernel"],
+                          weights["stem"]["bias"], 2, dtype))
+    # flax nn.max_pool (3,3)/2 SAME
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for blk, strides in zip(weights["blocks"], _block_strides(stage_sizes)):
+        residual = x
+        y = jax.nn.relu(_conv1x1_int8(x, blk["c1"]["q"], blk["c1"]["bias"], 1))
+        y = jax.nn.relu(_conv(y, blk["c2"]["kernel"], blk["c2"]["bias"],
+                              strides, dtype))
+        y = _conv1x1_int8(y, blk["c3"]["q"], blk["c3"]["bias"], 1)
+        if "proj" in blk:
+            residual = _conv1x1_int8(residual, blk["proj"]["q"],
+                                     blk["proj"]["bias"], strides)
+        x = jax.nn.relu(y + residual)
+    x = jnp.mean(x, axis=(1, 2))
+    logits = int8_matmul(x.astype(jnp.float32), weights["head"]["q"],
+                         out_dtype=jnp.float32) + weights["head"]["bias"]
+    return jax.nn.softmax(logits, axis=-1)
+
+
+class Int8ResNet50Model:
+    """Graph MODEL component: int8-quantized ResNet50 (serving contract
+    matches models/resnet.py ResNet50Model)."""
+
+    def __init__(self, seed: int = 0, num_classes: int = 1000,
+                 image_size: int = 224, source=None):
+        from seldon_core_tpu.models.resnet import ResNet50Model
+
+        src = source or ResNet50Model(
+            seed=seed, num_classes=num_classes, image_size=image_size
+        )
+        self.image_size = image_size
+        self.weights = convert_params(src.params)
+        self.class_names = src.class_names
+
+    def predict_fn(self, weights, X):
+        return forward(weights, X)
+
+    # engine ComponentHandle duck-type: expose weights as the variables arg
+    @property
+    def params(self):
+        return self.weights
+
+    def tags(self):
+        return {"model": "resnet50-int8", "image_size": self.image_size}

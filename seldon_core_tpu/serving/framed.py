@@ -46,7 +46,9 @@ __all__ = [
     "encode_feedback",
     "decode_feedback",
     "FramedComponentServer",
+    "AsyncFramedComponentServer",
     "FramedClient",
+    "AsyncFramedClient",
 ]
 
 
@@ -201,6 +203,114 @@ class FramedComponentServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class AsyncFramedComponentServer:
+    """Asyncio framed server — the accelerator-path transport tier.
+
+    Same wire protocol as :class:`FramedComponentServer`, different
+    concurrency model: the native epoll server runs its handler
+    synchronously on the IO thread, which is right for microsecond CPU
+    components but SERIALIZES a device-bound model — each request would
+    spin a fresh event loop (destroying the dynamic batcher's cross-request
+    timers/futures) and block the transport for a full device round trip.
+    Here every connection is an asyncio task awaiting ``engine.predict``
+    directly on ONE persistent loop, so N client connections put N requests
+    into the batcher concurrently and batching actually forms.
+
+    Per-connection requests are handled in order (the framed protocol is
+    strict request/response per connection; clients pool connections for
+    parallelism, see AsyncFramedClient/FramedDriver).
+    """
+
+    def __init__(self, target, port: int = 0, bind: str = "127.0.0.1"):
+        self._codec = FrameCodec()
+        self._target = target
+        self._port_req = port
+        self._bind = bind
+        self._server: Optional[object] = None
+
+    async def start(self) -> "AsyncFramedComponentServer":
+        import asyncio
+
+        self._server = await asyncio.start_server(
+            self._on_conn, self._bind, self._port_req
+        )
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def __aenter__(self) -> "AsyncFramedComponentServer":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    async def _on_conn(self, reader, writer) -> None:
+        import asyncio
+
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                # a disconnect can land mid-header, mid-body, or during the
+                # response write — all of them are a silent close, not an
+                # unhandled task exception
+                try:
+                    hdr = await reader.readexactly(4)
+                    (n,) = struct.unpack("<I", hdr)
+                    body = await reader.readexactly(n)
+                    resp = await self._handle(body)
+                    writer.write(struct.pack("<I", len(resp)) + resp)
+                    await writer.drain()
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+        finally:
+            writer.close()
+
+    async def _handle(self, req: bytes) -> bytes:
+        try:
+            frame = self._codec.decode(req)
+            if frame.msg_type == MSG_FEEDBACK:
+                fb = decode_feedback(frame)
+                for part in (fb.request, fb.response, fb.truth):
+                    if part is not None:
+                        _writable(part)
+                out = await self._feedback(fb)
+            else:
+                msg = decode_message(frame)
+                _writable(msg)
+                out = await self._predict(msg)
+            return encode_message(self._codec, out, MSG_RESPONSE)
+        except Exception as e:  # noqa: BLE001 — all errors go on the wire
+            err = SeldonMessage(status=Status.failure(500, str(e)))
+            return encode_message(self._codec, err, MSG_ERROR)
+
+    async def _predict(self, msg: SeldonMessage) -> SeldonMessage:
+        import inspect
+
+        out = self._target.predict(msg)
+        if inspect.isawaitable(out):  # GraphEngine / BatchedModel
+            return await out
+        return out  # plain sync component (already computed)
+
+    async def _feedback(self, fb: Feedback):
+        import inspect
+
+        t = self._target
+        out = t.send_feedback(fb)
+        if inspect.isawaitable(out):
+            out = await out
+        return out if out is not None else SeldonMessage()
 
 
 class AsyncFramedClient:

@@ -198,7 +198,11 @@ class FramedDriver:
         from seldon_core_tpu.messages import SeldonMessage
         from seldon_core_tpu.serving.framed import AsyncFramedClient
 
-        self._msg = SeldonMessage.from_dict(self.payload)
+        self._msg = (
+            self.payload
+            if isinstance(self.payload, SeldonMessage)
+            else SeldonMessage.from_dict(self.payload)
+        )
         self._free = asyncio.Queue()
         for _ in range(self.pool):
             c = await AsyncFramedClient().connect(self.host, self.port)

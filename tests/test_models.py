@@ -31,6 +31,34 @@ def test_mnist_mlp_component():
     assert out.names[0] == "class:0"
 
 
+def test_resnet_int8_matches_float():
+    """BN-folded int8 variant (models/resnet_int8.py): same top-1 as the
+    float flax model on random inputs — validates BN folding, the 1x1-conv-
+    as-int8-matmul path, and the flax param-tree walk."""
+    import jax
+
+    from seldon_core_tpu.models import resnet_int8
+    from seldon_core_tpu.models.resnet import ResNet
+
+    module = ResNet(stage_sizes=(1, 1), num_classes=16, dtype=jnp.float32)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
+    )
+    weights = resnet_int8.convert_params(params)
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(4, 32, 32, 3)), jnp.float32
+    )
+    ref = np.asarray(module.apply(params, x))
+    out = np.asarray(
+        resnet_int8.forward(weights, x, dtype=jnp.float32,
+                            stage_sizes=(1, 1))
+    )
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
+    assert (ref.argmax(-1) == out.argmax(-1)).mean() >= 0.99
+    np.testing.assert_allclose(out, ref, atol=2e-2)
+
+
 def test_resnet50_tiny_forward():
     from seldon_core_tpu.models.resnet import ResNet, ResNet50Model
 

@@ -173,6 +173,29 @@ def test_train_step_sharded_full_parallelism():
     assert np.isfinite(float(loss))
 
 
+def test_quantized_ffn_forward_and_decode():
+    """int8 weight-quantized FFN serving path (ops/quant.py wired into the
+    flagship model): same top-1 as float, decode path runs, mesh rejected."""
+    from seldon_core_tpu.models.transformer import quantize_ffn_params
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    ids = tiny_batch()["input_ids"]
+    ref, _ = forward(params, ids, TINY)
+    qp = quantize_ffn_params(params)
+    out, _ = forward(qp, ids, TINY)
+    agree = (np.asarray(ref).argmax(-1) == np.asarray(out).argmax(-1)).mean()
+    assert agree >= 0.99, agree
+
+    cache = init_cache(TINY, 4, max_len=8)
+    logits, cache2 = decode_step(qp, cache, ids[:, 0], TINY)
+    assert logits.shape == (4, TINY.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    mesh = make_mesh(n_devices=8, tp=2, pp=1)
+    with pytest.raises(ValueError, match="int8"):
+        forward(qp, ids, TINY, mesh=mesh)
+
+
 def test_decode_matches_forward():
     params = init_params(jax.random.PRNGKey(0), TINY)
     ids = tiny_batch(B=2, L=8)["input_ids"]
