@@ -247,8 +247,11 @@ def main(argv: Optional[list] = None) -> None:
     import os
 
     ap = argparse.ArgumentParser(description="seldon-core-tpu API gateway")
-    ap.add_argument("--config", required=True,
-                    help="deployments JSON (see DeploymentStore.refresh)")
+    ap.add_argument("--config",
+                    default=os.environ.get("SELDON_GATEWAY_CONFIG") or None,
+                    help="deployments JSON (see DeploymentStore.refresh); "
+                         "env SELDON_GATEWAY_CONFIG; without it the gateway "
+                         "starts empty and picks up deployments on refresh")
     ap.add_argument("--port", type=int,
                     default=int(os.environ.get("GATEWAY_PORT", "8080")))
     ap.add_argument("--grpc-port", type=int,

@@ -22,7 +22,9 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-DEFAULT_TOKEN_TTL_S = 43200.0  # 12h, Spring OAuth2 default
+# 12h, Spring OAuth2 default; overridable per-install (chart gateway.tokenTtl
+# → env SELDON_TOKEN_TTL)
+DEFAULT_TOKEN_TTL_S = float(os.environ.get("SELDON_TOKEN_TTL", 43200.0))
 
 
 @dataclass

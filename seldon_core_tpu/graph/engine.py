@@ -46,10 +46,7 @@ logger = logging.getLogger(__name__)
 NodeImpl = Any
 
 
-async def _maybe_await(x: Union[Any, Awaitable[Any]]) -> Any:
-    if inspect.isawaitable(x):
-        return await x
-    return x
+from seldon_core_tpu.utils import maybe_await as _maybe_await  # noqa: E402
 
 
 class _Node:

@@ -33,7 +33,15 @@ ENGINE_PORT = 8000
 GRPC_PORT = 5001
 METRICS_PORT = 8000
 PU_PORT_BASE = 9000
+# default engine image; per-install override via env SELDON_ENGINE_IMAGE
+# (the chart's engine.image value — charts/seldon-core-tpu/values.yaml)
 ENGINE_IMAGE = "seldon-core-tpu/engine:latest"
+
+
+def engine_image() -> str:
+    import os
+
+    return os.environ.get("SELDON_ENGINE_IMAGE", ENGINE_IMAGE)
 
 # v5e host topology: chips per VM host; slices larger than one host need a
 # multi-host JobSet-style rollout (emitted as replicated pods with
@@ -171,7 +179,7 @@ def _colocated_predictor(
     workload_name = f"{dep.name}-{p.name}"
     container: dict[str, Any] = {
         "name": "engine",
-        "image": ENGINE_IMAGE,
+        "image": engine_image(),
         "args": ["serve", "--colocated"],
         "env": _engine_env(dep, p),
         "ports": [
@@ -313,7 +321,7 @@ def _distributed_predictor(
                     "containers": [
                         {
                             "name": "engine",
-                            "image": ENGINE_IMAGE,
+                            "image": engine_image(),
                             "args": ["serve"],
                             "env": _engine_env(dep, p),
                             "ports": [{"containerPort": ENGINE_PORT}],
@@ -336,7 +344,7 @@ def _distributed_predictor(
         name = service_name(dep, p, unit.name)
         container = containers.get(
             unit.name,
-            {"name": unit.name, "image": ENGINE_IMAGE, "args": ["component"]},
+            {"name": unit.name, "image": engine_image(), "args": ["component"]},
         ).copy()
         container.setdefault("env", []).extend(
             [

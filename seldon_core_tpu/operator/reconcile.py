@@ -679,14 +679,59 @@ class HttpKubeApi:
         )
 
 
+def _start_health_server(port: int, watcher: "SeldonDeploymentWatcher"):
+    """Tiny /ready // /live endpoint for the operator pod's probes (the
+    chart's readinessProbe targets it; reference operator exposes Spring
+    actuator health the same way).  Returns the server, or None if port=0."""
+    if not port:
+        return None
+    import http.server
+    import json as _json
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path in ("/ready", "/live", "/healthz"):
+                alive = watcher._thread is not None and watcher._thread.is_alive()
+                body = _json.dumps({"ready": alive}).encode()
+                self.send_response(200 if alive else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *a):  # quiet probes
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="operator-health")
+    t.start()
+    return srv
+
+
 def main(argv: Optional[list[str]] = None) -> None:
-    """Operator entrypoint: register the CRD and reconcile forever."""
+    """Operator entrypoint: register the CRD and reconcile forever.
+
+    Env fallbacks mirror the chart's values (charts/seldon-core-tpu):
+    ``SELDON_NAMESPACE``, ``SELDON_RECONCILE_INTERVAL``,
+    ``SELDON_HEALTH_PORT``, and ``SELDON_ENGINE_IMAGE`` (consumed by
+    operator/compile.py when building engine pods)."""
     import argparse
+    import os
 
     ap = argparse.ArgumentParser(description="seldon-core-tpu operator")
-    ap.add_argument("--namespace", default="default")
-    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--namespace",
+                    default=os.environ.get("SELDON_NAMESPACE", "default"))
+    ap.add_argument("--interval", type=float,
+                    default=float(os.environ.get("SELDON_RECONCILE_INTERVAL",
+                                                 "5.0")))
     ap.add_argument("--kube-url", default=None)
+    ap.add_argument("--health-port", type=int,
+                    default=int(os.environ.get("SELDON_HEALTH_PORT", "8081")),
+                    help="probe endpoint port (0 disables)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -697,11 +742,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     logger.info("operator watching %s every %.1fs", args.namespace, args.interval)
     watcher.start()
+    health = _start_health_server(args.health_port, watcher)
     try:
         while True:
             time.sleep(60)
     except KeyboardInterrupt:
         watcher.stop()
+        if health is not None:
+            health.shutdown()
 
 
 if __name__ == "__main__":

@@ -85,10 +85,7 @@ def grpc_options(max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE) -> list:
     ]
 
 
-async def _maybe_await(x):
-    if inspect.isawaitable(x):
-        return await x
-    return x
+from seldon_core_tpu.utils import maybe_await as _maybe_await  # noqa: E402
 
 
 def _branch_message(branch: int) -> SeldonMessage:

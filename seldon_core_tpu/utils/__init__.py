@@ -2,7 +2,18 @@
 
 from __future__ import annotations
 
+import inspect
 import os
+from typing import Any
+
+
+async def maybe_await(x: Any) -> Any:
+    """Await ``x`` if it is awaitable, else return it — components may be
+    sync (ComponentHandle) or async (RemoteComponent/BatchedModel) with the
+    same method surface."""
+    if inspect.isawaitable(x):
+        return await x
+    return x
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:

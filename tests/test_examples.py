@@ -1,0 +1,234 @@
+"""Example graphs + chart packaging (VERDICT r1 #6).
+
+Every BASELINE.md config ships as a deployable manifest under
+examples/graphs/ (reference: helm-charts/seldon-single-model/templates/
+model.json, seldon-abtest, seldon-mab/values.yaml) and must BOOT — parse,
+validate, default, resolve every component, serve a prediction — through
+LocalDeployment, the same code path the engine pod runs.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+from seldon_core_tpu.operator.local import LocalDeployment, load_deployment_file
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "graphs")
+CHART = os.path.join(os.path.dirname(__file__), "..", "charts",
+                     "seldon-core-tpu")
+
+
+def boot(name: str) -> LocalDeployment:
+    return LocalDeployment(
+        load_deployment_file(os.path.join(EXAMPLES, name)), seed=0
+    )
+
+
+def predict(local: LocalDeployment, msg: SeldonMessage) -> SeldonMessage:
+    out = asyncio.run(local.predict(msg))
+    assert out.status is None or out.status.status == "SUCCESS"
+    return out
+
+
+def test_iris_example_boots_and_serves():
+    local = boot("iris.json")
+    out = predict(
+        local,
+        SeldonMessage.from_ndarray(
+            np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)
+        ),
+    )
+    probs = np.asarray(out.host_data())
+    assert probs.shape[0] == 1
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+
+
+def test_mnist_example_boots_with_batching():
+    local = boot("mnist.json")
+    # annotation-driven batching: the resolved component is a BatchedModel
+    from seldon_core_tpu.runtime.batcher import BatchedModel
+
+    eng = local.predictors[0].engine
+    comp = eng.node_impl(eng.root.unit.name)
+    assert isinstance(comp, BatchedModel)
+    assert comp._batcher.config.max_batch_size == 256
+    x = np.zeros((1, 784), np.float32)
+    out = predict(local, SeldonMessage.from_ndarray(x))
+    assert np.asarray(out.host_data()).shape == (1, 10)
+
+
+def test_resnet50_example_boots():
+    local = boot("resnet50-v5e8.json")
+    x = np.zeros((1, 224, 224, 3), np.float32)
+    out = predict(local, SeldonMessage.from_ndarray(x))
+    assert np.asarray(out.host_data()).shape == (1, 1000)
+
+
+def test_resnet50_example_compiles_to_tpu_manifests():
+    from seldon_core_tpu.operator.compile import compile_deployment
+
+    dep = load_deployment_file(os.path.join(EXAMPLES, "resnet50-v5e8.json"))
+    objs = compile_deployment(dep)
+    tpu_limits = [
+        c["resources"]["limits"]["google.com/tpu"]
+        for o in objs
+        if o["kind"] in ("Deployment", "StatefulSet")
+        for c in o["spec"]["template"]["spec"]["containers"]
+        if c.get("resources", {}).get("limits", {}).get("google.com/tpu")
+    ]
+    assert tpu_limits, "v5e-8 example must request TPU chips"
+    selectors = [
+        o["spec"]["template"]["spec"].get("nodeSelector", {})
+        for o in objs if o["kind"] in ("Deployment", "StatefulSet")
+    ]
+    assert any(
+        s.get("cloud.google.com/gke-tpu-topology") == "2x4" for s in selectors
+    ), selectors
+
+
+def test_mab_example_routes_and_learns():
+    local = boot("epsilon-greedy-mab.json")
+    x = np.zeros((1, 784), np.float32)
+    out = predict(local, SeldonMessage.from_ndarray(x))
+    routing = out.meta.routing
+    assert routing.get("eg-router") in (0, 1)
+    fb = Feedback(request=SeldonMessage.from_ndarray(x), response=out,
+                  reward=1.0)
+    asyncio.run(local.send_feedback(fb))
+    router = local.predictors[0].engine.node_impl("eg-router").user
+    assert router.counts.sum() == 1  # reward credited to the branch taken
+
+
+def test_ensemble_example_averages_members():
+    local = boot("ensemble.json")
+    x = np.zeros((1, 784), np.float32)
+    out = predict(local, SeldonMessage.from_ndarray(x))
+    probs = np.asarray(out.host_data())
+    assert probs.shape == (1, 10)
+    eng = local.predictors[0].engine
+    members = [eng.node_impl(f"member-{i}") for i in range(3)]
+    import asyncio as aio
+
+    async def member_out(m):
+        from seldon_core_tpu.utils import maybe_await
+
+        r = await maybe_await(m.predict(SeldonMessage.from_ndarray(x)))
+        return np.asarray(r.host_data())
+
+    outs = [aio.run(member_out(m)) for m in members]
+    np.testing.assert_allclose(probs, np.mean(outs, axis=0), atol=1e-5)
+
+
+
+
+
+# ---------------------------------------------------------------------------
+# chart packaging
+# ---------------------------------------------------------------------------
+
+
+class TestChart:
+    def test_renders_and_parses(self):
+        from seldon_core_tpu.operator.chart import manifests
+
+        docs = manifests(CHART)
+        kinds = sorted({d["kind"] for d in docs})
+        assert "Deployment" in kinds
+        assert "CustomResourceDefinition" in kinds
+        assert "ClusterRole" in kinds
+        assert "Service" in kinds
+        # every doc fully rendered: no template braces survive
+        import json
+
+        assert "{{" not in json.dumps(docs)
+
+    def test_value_overrides(self):
+        from seldon_core_tpu.operator.chart import manifests
+
+        docs = manifests(CHART, ["gateway.replicas=3",
+                                 "namespace=custom-ns"])
+        gw = next(d for d in docs if d["kind"] == "Deployment"
+                  and d["metadata"]["name"] == "seldon-gateway")
+        assert gw["spec"]["replicas"] == 3
+        assert gw["metadata"]["namespace"] == "custom-ns"
+
+    def test_toggles_gate_manifests(self):
+        from seldon_core_tpu.operator.chart import manifests
+
+        docs = manifests(CHART, ["gateway.enabled=false", "crd.create=false",
+                                 "rbac.create=false"])
+        kinds = {d["kind"] for d in docs}
+        assert "CustomResourceDefinition" not in kinds
+        assert "ClusterRole" not in kinds
+        names = {d["metadata"]["name"] for d in docs}
+        assert "seldon-gateway" not in names
+        # the operator itself always installs
+        assert "seldon-operator" in names
+
+    def test_gateway_command_matches_cli(self):
+        """The chart's container command must actually boot: every flag it
+        passes has to exist on the gateway CLI (round-1 chart drift lesson)."""
+        from seldon_core_tpu.operator.chart import manifests
+
+        import inspect
+
+        from seldon_core_tpu.gateway import app as gwapp
+        from seldon_core_tpu.operator import reconcile
+
+        gw = next(d for d in manifests(CHART) if d["kind"] == "Deployment"
+                  and d["metadata"]["name"] == "seldon-gateway")
+        args = gw["spec"]["template"]["spec"]["containers"][0]["args"]
+        src = inspect.getsource(gwapp.main)
+        for flag in [str(a) for a in args if str(a).startswith("--")]:
+            assert f'"{flag}"' in src, f"chart passes unknown flag {flag}"
+
+        op = next(d for d in manifests(CHART) if d["kind"] == "Deployment"
+                  and d["metadata"]["name"] == "seldon-operator")
+        op_spec = op["spec"]["template"]["spec"]["containers"][0]
+        op_src = inspect.getsource(reconcile.main)
+        for flag in [str(a) for a in op_spec.get("args", [])
+                     if str(a).startswith("--")]:
+            assert f'"{flag}"' in op_src, f"chart passes unknown flag {flag}"
+        # every env var the chart sets must be read somewhere in the package
+        for env in op_spec.get("env", []):
+            assert env["name"] in inspect.getsource(reconcile.main) or \
+                env["name"] == "SELDON_ENGINE_IMAGE", env["name"]
+
+    def test_operator_health_endpoint_serves_probes(self):
+        import json
+        import urllib.request
+
+        from seldon_core_tpu.operator.reconcile import (
+            FakeKubeApi,
+            SeldonDeploymentWatcher,
+            _start_health_server,
+        )
+
+        watcher = SeldonDeploymentWatcher(FakeKubeApi())
+        watcher.start()
+        srv = _start_health_server(0, watcher)  # port=0 → disabled
+        assert srv is None
+        srv = _start_health_server(18946, watcher)
+        try:
+            with urllib.request.urlopen(
+                "http://127.0.0.1:18946/ready", timeout=5
+            ) as r:
+                assert r.status == 200
+                assert json.load(r)["ready"] is True
+        finally:
+            srv.shutdown()
+            watcher.stop()
+
+    def test_chart_crd_matches_operator(self):
+        """The chart's static CRD must stay identical to the operator's
+        programmatic one (reconcile.crd_manifest) — drift here means helm
+        installs and operator self-registration disagree."""
+        from seldon_core_tpu.operator.chart import manifests
+        from seldon_core_tpu.operator.reconcile import crd_manifest
+
+        chart_crd = next(d for d in manifests(CHART)
+                         if d["kind"] == "CustomResourceDefinition")
+        assert chart_crd == crd_manifest()
