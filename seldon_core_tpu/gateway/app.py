@@ -37,11 +37,17 @@ class Gateway:
         firehose=None,
         token_spill: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
+        retries: int = 2,
+        retry_backoff_s: float = 0.05,
     ):
         self.store = store
         self.oauth = OAuthProvider(store, TokenStore(token_spill))
         self.firehose = firehose or NullFirehose()
         self.registry = registry or MetricsRegistry()
+        # connection-failure retries on the engine forward (reference apife
+        # HttpRetryHandler.java); retries=2 → 3 attempts total
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
         self._session: Optional[aiohttp.ClientSession] = None
         self._grpc_channels: dict[str, object] = {}
 
@@ -106,19 +112,44 @@ class Gateway:
             )
         body = await request.read()
         sess = await self.session()
-        try:
-            async with sess.post(
-                rec.engine_url.rstrip("/") + path,
-                data=body,
-                headers={"Content-Type": request.headers.get(
-                    "Content-Type", "application/json")},
-            ) as resp:
-                out_body = await resp.read()
-                out_status = resp.status
-        except aiohttp.ClientError as e:
+        # Retry with backoff on connection-level failures (reference apife
+        # HttpRetryHandler.java: 3 attempts).  POST predict is safe to retry
+        # ONLY when the request never reached the engine — connection errors
+        # qualify; once a response (any status) arrives we pass it through.
+        last_err: Optional[Exception] = None
+        out_body, out_status = b"", 0
+        for attempt in range(self.retries + 1):
+            if attempt:
+                await asyncio.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+                self.registry.counter_inc(
+                    "seldon_api_gateway_retries_total",
+                    {"deployment": rec.name, "path": path},
+                )
+            try:
+                async with sess.post(
+                    rec.engine_url.rstrip("/") + path,
+                    data=body,
+                    headers={"Content-Type": request.headers.get(
+                        "Content-Type", "application/json")},
+                ) as resp:
+                    out_body = await resp.read()
+                    out_status = resp.status
+                last_err = None
+                break
+            except aiohttp.ClientConnectorError as e:
+                # connection never established — the request cannot have
+                # reached the engine, so replaying it is safe
+                last_err = e
+            except aiohttp.ClientError as e:
+                # includes ServerDisconnectedError: the engine may have
+                # executed the (non-idempotent) request before dying — a
+                # replay could e.g. apply a MAB feedback reward twice
+                last_err = e
+                break
+        if last_err is not None:
             return web.json_response(
                 {"status": {"code": 503, "status": "FAILURE",
-                            "info": f"engine unreachable: {e}"}},
+                            "info": f"engine unreachable: {last_err}"}},
                 status=503,
             )
         if path.endswith("/predictions") and not isinstance(

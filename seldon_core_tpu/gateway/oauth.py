@@ -22,9 +22,26 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-# 12h, Spring OAuth2 default; overridable per-install (chart gateway.tokenTtl
-# → env SELDON_TOKEN_TTL)
-DEFAULT_TOKEN_TTL_S = float(os.environ.get("SELDON_TOKEN_TTL", 43200.0))
+DEFAULT_TOKEN_TTL_S = 43200.0  # 12h, Spring OAuth2 default
+
+
+def _token_ttl_s() -> float:
+    """Per-install TTL override (chart gateway.tokenTtl → env
+    SELDON_TOKEN_TTL).  Read lazily — an import-time read would freeze the
+    value before embedders/tests can set it, and a malformed value would
+    crash the import with an opaque traceback instead of logging."""
+    raw = os.environ.get("SELDON_TOKEN_TTL")
+    if not raw:
+        return DEFAULT_TOKEN_TTL_S
+    try:
+        return float(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed SELDON_TOKEN_TTL=%r (want seconds)", raw
+        )
+        return DEFAULT_TOKEN_TTL_S
 
 
 @dataclass
@@ -48,7 +65,10 @@ class TokenStore:
             except (ValueError, OSError):
                 pass
 
-    def issue(self, client_id: str, ttl_s: float = DEFAULT_TOKEN_TTL_S) -> tuple[str, float]:
+    def issue(self, client_id: str,
+              ttl_s: Optional[float] = None) -> tuple[str, float]:
+        if ttl_s is None:
+            ttl_s = _token_ttl_s()
         token = secrets.token_urlsafe(32)
         with self._lock:
             self._tokens[token] = _TokenInfo(client_id, time.time() + ttl_s)

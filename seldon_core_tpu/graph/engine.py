@@ -25,10 +25,9 @@ Key departures from the reference:
 from __future__ import annotations
 
 import asyncio
-import inspect
 import logging
 import time
-from typing import Any, Awaitable, Callable, Optional, Union
+from typing import Any, Callable, Optional
 
 from seldon_core_tpu.graph.builtins import make_builtin
 from seldon_core_tpu.graph.spec import (

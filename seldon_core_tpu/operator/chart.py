@@ -37,7 +37,15 @@ def load_values(chart_dir: str, overrides: Iterable[str] = ()) -> dict:
         node = values
         keys = path.split(".")
         for k in keys[:-1]:
-            node = node.setdefault(k, {})
+            nxt = node.get(k)
+            if not isinstance(nxt, dict):
+                if nxt is not None:
+                    raise ValueError(
+                        f"--set {item!r}: {'.'.join(keys)} traverses the "
+                        f"non-mapping value {nxt!r} at {k!r}"
+                    )
+                nxt = node[k] = {}
+            node = nxt
         try:
             node[keys[-1]] = json.loads(raw)
         except ValueError:
@@ -45,10 +53,15 @@ def load_values(chart_dir: str, overrides: Iterable[str] = ()) -> dict:
     return values
 
 
-def _lookup(values: dict, path: str) -> Any:
+_MISSING = object()
+
+
+def _lookup(values: dict, path: str, default: Any = _MISSING) -> Any:
     node: Any = values
     for k in path.split("."):
         if not isinstance(node, dict) or k not in node:
+            if default is not _MISSING:
+                return default
             raise KeyError(f".Values.{path} is not set (chart values.yaml)")
         node = node[k]
     return node
@@ -66,7 +79,10 @@ def render_template(text: str, values: dict) -> str:
     for line in text.splitlines():
         m = _IF.match(line)
         if m:
-            stack.append(bool(_lookup(values, m.group(1))))
+            # helm semantics: a missing values key is falsey, not an error
+            # (substitution of a missing key still raises, matching helm's
+            # <no value> hard-fail under --strict)
+            stack.append(bool(_lookup(values, m.group(1), default=None)))
             continue
         if _END.match(line):
             if not stack:

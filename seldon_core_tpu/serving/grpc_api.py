@@ -20,7 +20,6 @@ noted hot-spot) — ``GrpcComponentClient`` holds one persistent aio channel.
 from __future__ import annotations
 
 import asyncio
-import inspect
 import logging
 from typing import Any, Optional, Sequence
 

@@ -1,0 +1,324 @@
+"""Analytics stack generator: metric catalog → Grafana dashboard +
+Prometheus scrape/alert config.
+
+Reference counterpart: ``helm-charts/seldon-core-analytics/templates/`` (12
+manifests with a hand-built "prediction analytics" dashboard) and
+``docs/analytics.md`` (metric catalog).  Here the catalog is CODE — the
+single source the dashboard, the alerts, and the docs are generated from,
+so a metric rename cannot silently orphan its panels (tests assert the
+chart's static copies equal these generators' output).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# metric catalog — every metric the framework emits (grep-locked by tests)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MetricInfo:
+    name: str
+    kind: str  # counter | histogram | gauge
+    help: str
+    labels: tuple = ()
+
+
+CATALOG: tuple[MetricInfo, ...] = (
+    MetricInfo(
+        "seldon_api_executor_server_requests_seconds", "histogram",
+        "Engine northbound request latency (reference "
+        "seldon_api_executor_server_requests_seconds timer, "
+        "SeldonRestTemplateExchangeTagsProvider.java:40-141)",
+        ("deployment", "predictor"),
+    ),
+    MetricInfo(
+        "seldon_api_executor_client_requests_seconds", "histogram",
+        "Per-graph-node southbound latency (model/router/combiner/"
+        "transformer calls)",
+        ("deployment", "predictor", "model_name"),
+    ),
+    MetricInfo(
+        "seldon_api_server_ingress_seconds", "histogram",
+        "Gateway ingress latency per deployment (apife "
+        "AuthorizedWebMvcTagsProvider parity)",
+        ("deployment", "path"),
+    ),
+    MetricInfo(
+        "seldon_api_gateway_retries_total", "counter",
+        "Gateway->engine forward retries after connection failures "
+        "(apife HttpRetryHandler parity)",
+        ("deployment", "path"),
+    ),
+    MetricInfo(
+        "seldon_api_model_feedback_total", "counter",
+        "Feedback events per model (reference PredictiveUnitBean.java:283)",
+        ("deployment", "model_name"),
+    ),
+    MetricInfo(
+        "seldon_api_model_feedback_reward_total", "counter",
+        "Cumulative reward per model (MAB learning signal)",
+        ("deployment", "model_name"),
+    ),
+    MetricInfo(
+        "seldon_batcher_batches_total", "counter",
+        "Device batches dispatched by the dynamic batcher (no reference "
+        "counterpart: the reference has no server-side batching)",
+        ("batcher",),
+    ),
+    MetricInfo(
+        "seldon_batcher_batch_rows", "histogram",
+        "Rows per dispatched batch (fill efficiency; compare to the "
+        "configured max batch)",
+        ("batcher",),
+    ),
+    MetricInfo(
+        "seldon_batcher_pad_rows_total", "counter",
+        "Padding rows added to reach bucket sizes (wasted device FLOPs)",
+        ("batcher",),
+    ),
+    MetricInfo(
+        "seldon_batcher_shed_total", "counter",
+        "Requests shed by backpressure (reason=queue_full|deadline)",
+        ("batcher", "reason"),
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# prometheus
+# ---------------------------------------------------------------------------
+
+
+def prometheus_config(scrape_interval: str = "15s") -> dict:
+    """Scrape config: kubernetes pod discovery keyed on the
+    ``prometheus.io/scrape`` annotations the operator stamps
+    (compile.py; reference SeldonDeploymentOperatorImpl.java:608-610)."""
+    return {
+        "global": {"scrape_interval": scrape_interval},
+        "rule_files": ["/etc/prometheus/alerts.yaml"],
+        "alerting": {
+            "alertmanagers": [
+                {"static_configs": [{"targets": ["alertmanager:9093"]}]}
+            ]
+        },
+        "scrape_configs": [
+            {
+                "job_name": "seldon-pods",
+                "kubernetes_sd_configs": [{"role": "pod"}],
+                "relabel_configs": [
+                    {
+                        "source_labels":
+                            ["__meta_kubernetes_pod_annotation_prometheus_io_scrape"],
+                        "action": "keep",
+                        "regex": "true",
+                    },
+                    {
+                        "source_labels":
+                            ["__meta_kubernetes_pod_annotation_prometheus_io_path"],
+                        "action": "replace",
+                        "target_label": "__metrics_path__",
+                        "regex": "(.+)",
+                    },
+                    {
+                        "source_labels":
+                            ["__address__",
+                             "__meta_kubernetes_pod_annotation_prometheus_io_port"],
+                        "action": "replace",
+                        "regex": r"([^:]+)(?::\d+)?;(\d+)",
+                        "replacement": r"$1:$2",
+                        "target_label": "__address__",
+                    },
+                    {
+                        "source_labels": ["__meta_kubernetes_namespace"],
+                        "action": "replace",
+                        "target_label": "namespace",
+                    },
+                    {
+                        "source_labels": ["__meta_kubernetes_pod_name"],
+                        "action": "replace",
+                        "target_label": "pod",
+                    },
+                ],
+            }
+        ],
+    }
+
+
+def alert_rules() -> dict:
+    """Starter alerts over the catalog (reference analytics ships
+    alertmanager with no rules; these cover the serving SLO basics)."""
+    return {
+        "groups": [
+            {
+                "name": "seldon-serving",
+                "rules": [
+                    {
+                        "alert": "SeldonHighP99Latency",
+                        "expr": (
+                            "histogram_quantile(0.99, sum(rate("
+                            "seldon_api_executor_server_requests_seconds_bucket"
+                            "[5m])) by (le, deployment)) > 1"
+                        ),
+                        "for": "5m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "p99 predict latency above 1s for "
+                                "{{ $labels.deployment }}",
+                        },
+                    },
+                    {
+                        "alert": "SeldonBatcherShedding",
+                        "expr": (
+                            "sum(rate(seldon_batcher_shed_total[5m])) "
+                            "by (batcher, reason) > 0"
+                        ),
+                        "for": "2m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "batcher {{ $labels.batcher }} shedding "
+                                "({{ $labels.reason }}) — overloaded",
+                        },
+                    },
+                    {
+                        "alert": "SeldonGatewayRetrying",
+                        "expr": (
+                            "sum(rate(seldon_api_gateway_retries_total[5m])) "
+                            "by (deployment) > 1"
+                        ),
+                        "for": "5m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "gateway retrying engine forwards for "
+                                "{{ $labels.deployment }} — engine flapping",
+                        },
+                    },
+                ],
+            }
+        ]
+    }
+
+
+# ---------------------------------------------------------------------------
+# grafana
+# ---------------------------------------------------------------------------
+
+
+def _panel(panel_id: int, title: str, expr: str, y: int, x: int = 0,
+           w: int = 12, unit: Optional[str] = None) -> dict:
+    fieldcfg: dict = {"defaults": {}, "overrides": []}
+    if unit:
+        fieldcfg["defaults"]["unit"] = unit
+    return {
+        "id": panel_id,
+        "type": "timeseries",
+        "title": title,
+        "gridPos": {"h": 8, "w": w, "x": x, "y": y},
+        "datasource": {"type": "prometheus", "uid": "prometheus"},
+        "fieldConfig": fieldcfg,
+        "targets": [{"expr": expr, "refId": "A"}],
+    }
+
+
+def grafana_dashboard() -> dict:
+    """The "prediction analytics" dashboard, generated from the catalog
+    (reference: seldon-core-analytics' prebuilt dashboard)."""
+    panels = [
+        _panel(1, "Predict rate (req/s) by deployment",
+               "sum(rate(seldon_api_executor_server_requests_seconds_count[1m]))"
+               " by (deployment)", y=0, x=0),
+        _panel(2, "Predict latency p50/p99",
+               "histogram_quantile(0.99, sum(rate("
+               "seldon_api_executor_server_requests_seconds_bucket[5m])) "
+               "by (le, deployment))", y=0, x=12, unit="s"),
+        _panel(3, "Per-node southbound latency p99",
+               "histogram_quantile(0.99, sum(rate("
+               "seldon_api_executor_client_requests_seconds_bucket[5m])) "
+               "by (le, model_name))", y=8, x=0, unit="s"),
+        _panel(4, "Gateway ingress latency p99",
+               "histogram_quantile(0.99, sum(rate("
+               "seldon_api_server_ingress_seconds_bucket[5m])) "
+               "by (le, deployment))", y=8, x=12, unit="s"),
+        _panel(5, "Batch fill (mean rows per batch)",
+               "sum(rate(seldon_batcher_batch_rows_sum[5m])) by (batcher) / "
+               "sum(rate(seldon_batcher_batch_rows_count[5m])) by (batcher)",
+               y=16, x=0),
+        _panel(6, "Batcher sheds + gateway retries",
+               "sum(rate(seldon_batcher_shed_total[5m])) by (batcher, reason)",
+               y=16, x=12),
+        _panel(7, "Feedback reward rate",
+               "sum(rate(seldon_api_model_feedback_reward_total[5m])) "
+               "by (deployment, model_name)", y=24, x=0),
+        _panel(8, "Padding overhead (rows/s)",
+               "sum(rate(seldon_batcher_pad_rows_total[5m])) by (batcher)",
+               y=24, x=12),
+    ]
+    return {
+        "title": "Seldon Core TPU — Prediction Analytics",
+        "uid": "seldon-core-tpu",
+        "schemaVersion": 39,
+        "tags": ["seldon", "tpu"],
+        "timezone": "browser",
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": panels,
+    }
+
+
+def metric_docs() -> str:
+    """docs/analytics.md content (reference docs/analytics.md)."""
+    lines = [
+        "# Metrics catalog",
+        "",
+        "Generated from `seldon_core_tpu/utils/analytics.py` CATALOG — do "
+        "not edit by hand (`python -m seldon_core_tpu.utils.analytics docs`).",
+        "",
+        "| Metric | Type | Labels | Description |",
+        "|---|---|---|---|",
+    ]
+    for m in CATALOG:
+        lines.append(
+            f"| `{m.name}` | {m.kind} | {', '.join(m.labels) or '—'} "
+            f"| {m.help} |"
+        )
+    lines += [
+        "",
+        "Custom component metrics (COUNTER/GAUGE/TIMER returned from a "
+        "component's `metrics()`) flow through the engine registry under "
+        "their own names (reference `CustomMetricsManager.java:30-43`, "
+        "`docs/custom_metrics.md`).",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="emit analytics artifacts")
+    ap.add_argument("what", choices=["dashboard", "prometheus", "alerts",
+                                     "docs"])
+    args = ap.parse_args(argv)
+    if args.what == "dashboard":
+        print(json.dumps(grafana_dashboard(), indent=2))
+    elif args.what == "prometheus":
+        import yaml
+
+        print(yaml.safe_dump(prometheus_config(), sort_keys=False))
+    elif args.what == "alerts":
+        import yaml
+
+        print(yaml.safe_dump(alert_rules(), sort_keys=False))
+    else:
+        print(metric_docs())
+
+
+if __name__ == "__main__":
+    main()

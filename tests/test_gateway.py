@@ -329,3 +329,87 @@ class TestGrpcGateway:
             await gw.close()
             await gw_server.stop()
             await engine_server.stop()
+
+
+class TestForwardRetry:
+    """Connection-failure retry on the engine forward (reference apife
+    HttpRetryHandler.java: 3 attempts)."""
+
+    async def _token(self, client):
+        resp = await client.post(
+            "/oauth/token",
+            data={"grant_type": "client_credentials"},
+            headers={"Authorization": basic_auth("key1", "sec1")},
+        )
+        return (await resp.json())["access_token"]
+
+    def test_unreachable_engine_retries_then_503(self):
+        async def run():
+            gw, client, _ = await make_gateway(
+                engine_url="http://127.0.0.1:1"  # nothing listens here
+            )
+            gw.retries = 2
+            gw.retry_backoff_s = 0.01
+            token = await self._token(client)
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert resp.status == 503
+            snap = gw.registry.render()
+            assert "seldon_api_gateway_retries_total" in snap
+            await client.close()
+            await gw.close()
+
+        asyncio.run(run())
+
+    def test_engine_up_after_first_failure_succeeds(self):
+        async def run():
+            import socket
+
+            # reserve a port, keep it CLOSED for the first attempt
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+
+            gw, client, _ = await make_gateway(
+                engine_url=f"http://127.0.0.1:{port}"
+            )
+            gw.retries = 10
+            gw.retry_backoff_s = 0.05
+            token = await self._token(client)
+
+            started = asyncio.Event()
+
+            async def start_engine_late():
+                # wait until the FIRST attempt has already failed (retry
+                # counter moved) so the success is guaranteed to come from
+                # a retry, however loaded the host is
+                while "seldon_api_gateway_retries_total" not in \
+                        gw.registry.render():
+                    await asyncio.sleep(0.01)
+                runner = web.AppRunner(await fake_engine_app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", port)
+                await site.start()
+                started.set()
+                return runner
+
+            engine_task = asyncio.ensure_future(start_engine_late())
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert started.is_set()  # success came via the retry path
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["meta"]["tags"]["engine"] == "fake"
+            assert "seldon_api_gateway_retries_total" in gw.registry.render()
+            await (await engine_task).cleanup()
+            await client.close()
+            await gw.close()
+
+        asyncio.run(run())
