@@ -173,15 +173,39 @@ def _listvalue_to_ndarray(lv: struct_pb2.ListValue) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _is_device_array(x) -> bool:
+    """jax.Array (device-resident) without importing jax at module load."""
+    return type(x).__module__.startswith("jax") or hasattr(
+        x, "addressable_shards"
+    )
+
+
 def message_to_proto(
-    msg: SeldonMessage, out: Optional[pb.SeldonMessage] = None
+    msg: SeldonMessage, out: Optional[pb.SeldonMessage] = None,
+    device_refs: bool = False,
 ) -> pb.SeldonMessage:
+    """``device_refs=True`` encodes device-resident payloads as
+    ``DeviceTensorRef`` HBM handles instead of bytes — ONLY for proto hops
+    between co-scheduled endpoints in the same process (in-process gRPC /
+    framed loopback); the registry rejects refs from other processes.  The
+    default downgrades to binTensor, which is always transport-safe."""
     p = out if out is not None else pb.SeldonMessage()
     if msg.status is not None:
         _status_to_proto(msg.status, p.status)
     md = msg.meta
     if md.puid or md.tags or md.routing or md.request_path or md.metrics:
         _meta_to_proto(md, p.meta)
+    if msg.data is not None and device_refs and _is_device_array(msg.data):
+        from seldon_core_tpu.runtime.device_registry import registry
+
+        arr = msg.data
+        p.data.names.extend(msg.names)
+        p.data.device.buffer_uuid = registry.put(arr)
+        p.data.device.dtype = str(arr.dtype)
+        p.data.device.shape.extend(int(s) for s in arr.shape)
+        sharding = getattr(arr, "sharding", None)
+        p.data.device.sharding = str(sharding) if sharding is not None else ""
+        return p
     if msg.data is not None:
         arr = msg.host_data()
         p.data.names.extend(msg.names)
@@ -232,10 +256,14 @@ def message_from_proto(p: pb.SeldonMessage) -> SeldonMessage:
             )
             msg.encoding = "binTensor"
         elif dwhich == "device":
-            raise ValueError(
-                "DeviceTensorRef crossed a transport boundary; the sender "
-                "must downgrade device-resident payloads to binTensor"
-            )
+            from seldon_core_tpu.runtime.device_registry import registry
+
+            # same-process co-scheduled hop: hand back the registered
+            # jax.Array itself — zero copies, tensor never leaves HBM.
+            # A ref minted by another process raises ForeignProcessRef with
+            # downgrade guidance (HBM handles cannot cross OS processes).
+            msg.data = registry.resolve(p.data.device.buffer_uuid)
+            msg.encoding = "device"
     elif which == "binData":
         msg.bin_data = p.binData
     elif which == "strData":

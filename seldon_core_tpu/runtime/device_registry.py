@@ -1,0 +1,101 @@
+"""Device-buffer registry backing ``DeviceTensorRef`` (proto/prediction.proto).
+
+The reference serializes tensors at every graph hop (SURVEY.md §3.2: one
+RPC + JSON/proto conversion per node).  In this framework, in-process graph
+edges already pass ``jax.Array``s by reference; this registry extends that
+zero-copy property to edges that ride the *proto codec* between
+co-scheduled endpoints — an in-process gRPC loopback, the framed server in
+the same process, tests — where the payload would otherwise pay a
+device→host→device round trip for nothing.
+
+Semantics:
+
+- ``put(array)`` registers a device array and returns a ref string
+  ``<process-token>/<uuid>``; ``resolve(ref)`` hands back the same array.
+- Refs are **process-scoped by construction**: the token is minted at
+  import, so a ref arriving in another process (a real transport boundary)
+  fails with a clear error telling the sender to downgrade — HBM handles
+  cannot cross OS processes without PJRT-level buffer donation, which JAX
+  does not expose.  ``proto/convert.py`` only emits refs when asked
+  (``device_refs=True``) and downgrades to ``binTensor`` otherwise, so the
+  wire default is always safe.
+- Entries are one-shot by default (``resolve`` consumes), with a bounded
+  capacity so a producer whose consumer died cannot leak HBM.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any
+
+__all__ = ["DeviceBufferRegistry", "registry", "process_token"]
+
+_BASE = uuid.uuid4().hex
+
+
+def process_token() -> str:
+    """Identity baked into every ref.  The pid component is evaluated at
+    call time, NOT import time: a forked worker inherits the module (and
+    ``_BASE``) from its parent, but gets a fresh pid — so refs minted
+    before the fork are correctly rejected as foreign in the child instead
+    of resolving to a fork-copied, invalid HBM handle."""
+    return f"{_BASE}-{os.getpid()}"
+
+
+class ForeignProcessRef(ValueError):
+    """A DeviceTensorRef crossed a real process/transport boundary."""
+
+
+class DeviceBufferRegistry:
+    def __init__(self, capacity: int = 256, ttl_s: float = 300.0):
+        self.capacity = capacity
+        self.ttl_s = ttl_s
+        self._entries: "OrderedDict[str, tuple[Any, float]]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def put(self, array: Any) -> str:
+        """Register ``array``; returns the ref string for the wire."""
+        key = uuid.uuid4().hex
+        now = time.monotonic()
+        with self._lock:
+            self._entries[key] = (array, now)
+            # evict expired, then oldest-over-capacity (never grows unbounded
+            # when a consumer dies between put and resolve)
+            while self._entries:
+                k, (_, t) = next(iter(self._entries.items()))
+                if now - t > self.ttl_s or len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                else:
+                    break
+        return f"{process_token()}/{key}"
+
+    def resolve(self, ref: str, consume: bool = True) -> Any:
+        token, _, key = ref.partition("/")
+        if token != process_token():
+            raise ForeignProcessRef(
+                "DeviceTensorRef crossed a transport boundary (minted by "
+                "another process); the sender must downgrade device-resident "
+                "payloads to binTensor (proto/convert.py message_to_proto "
+                "default)"
+            )
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                raise KeyError(
+                    f"DeviceTensorRef {key!r} not registered (already "
+                    "consumed, expired, or evicted)"
+                )
+            if consume:
+                del self._entries[key]
+        return entry[0]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+registry = DeviceBufferRegistry()

@@ -146,6 +146,15 @@ class _ComponentRpc:
         return message_to_proto(out)
 
 
+def _device_refs_enabled() -> bool:
+    """Process-wide DeviceTensorRef opt-in (env SELDON_DEVICE_REFS=1): only
+    for in-process loopback serving — the receiving registry rejects refs
+    from any other process (runtime/device_registry.py)."""
+    import os
+
+    return os.environ.get("SELDON_DEVICE_REFS", "") == "1"
+
+
 def _unary_handler(rpc: Any, method: str, req_cls, resp_cls):
     async def handler(request_pb, context):
         return await rpc.call(method, request_pb)
@@ -320,6 +329,7 @@ class GrpcComponentClient:
         methods: Sequence[str] = (),
         timeout_s: float = 30.0,
         max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE,
+        device_refs: Optional[bool] = None,
     ):
         self._channel = grpc.aio.insecure_channel(
             target, options=grpc_options(max_message_size)
@@ -334,6 +344,20 @@ class GrpcComponentClient:
             "send_feedback",
         }
         self.timeout = timeout_s
+        # DeviceTensorRef on the request payload: zero-copy HBM handoff when
+        # client and server are co-scheduled in ONE process (the server-side
+        # registry rejects refs from any other process, so this must only be
+        # enabled for true in-process loopback).  Default from env
+        # SELDON_DEVICE_REFS=1 so colocated embedders can switch it on
+        # without code changes.
+        if device_refs is None:
+            import os
+
+            device_refs = os.environ.get("SELDON_DEVICE_REFS", "") == "1"
+        self.device_refs = device_refs
+
+    def _encode(self, msg: SeldonMessage):
+        return message_to_proto(msg, device_refs=self.device_refs)
 
     def has(self, method: str) -> bool:
         return method in self._methods
@@ -349,23 +373,23 @@ class GrpcComponentClient:
         return resp
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        resp = await self._unary("Model", "Predict", message_to_proto(msg))
+        resp = await self._unary("Model", "Predict", self._encode(msg))
         return self._ok(message_from_proto(resp))
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
         resp = await self._unary(
-            "Transformer", "TransformInput", message_to_proto(msg)
+            "Transformer", "TransformInput", self._encode(msg)
         )
         return self._ok(message_from_proto(resp))
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
         resp = await self._unary(
-            "OutputTransformer", "TransformOutput", message_to_proto(msg)
+            "OutputTransformer", "TransformOutput", self._encode(msg)
         )
         return self._ok(message_from_proto(resp))
 
     async def route(self, msg: SeldonMessage) -> int:
-        resp = await self._unary("Router", "Route", message_to_proto(msg))
+        resp = await self._unary("Router", "Route", self._encode(msg))
         return _extract_branch(self._ok(message_from_proto(resp)))
 
     async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:

@@ -94,19 +94,29 @@ CATALOG: tuple[MetricInfo, ...] = (
 # ---------------------------------------------------------------------------
 
 
-def prometheus_config(scrape_interval: str = "15s") -> dict:
+def prometheus_config(scrape_interval: str = "15s",
+                      alertmanager: bool = True) -> dict:
     """Scrape config: kubernetes pod discovery keyed on the
     ``prometheus.io/scrape`` annotations the operator stamps
-    (compile.py; reference SeldonDeploymentOperatorImpl.java:608-610)."""
-    return {
-        "global": {"scrape_interval": scrape_interval},
-        "rule_files": ["/etc/prometheus/alerts.yaml"],
-        "alerting": {
+    (compile.py; reference SeldonDeploymentOperatorImpl.java:608-610).
+
+    ``alertmanager=False`` (chart ``--set alertmanager.enabled=false``)
+    drops the alerting target and rule file — otherwise Prometheus would
+    log a notification-send error for every firing alert, forever."""
+    cfg: dict = {"global": {"scrape_interval": scrape_interval}}
+    if alertmanager:
+        cfg["rule_files"] = ["/etc/prometheus/alerts.yaml"]
+        cfg["alerting"] = {
             "alertmanagers": [
                 {"static_configs": [{"targets": ["alertmanager:9093"]}]}
             ]
-        },
-        "scrape_configs": [
+        }
+    cfg["scrape_configs"] = _scrape_configs()
+    return cfg
+
+
+def _scrape_configs() -> list:
+    return [
             {
                 "job_name": "seldon-pods",
                 "kubernetes_sd_configs": [{"role": "pod"}],
@@ -145,8 +155,7 @@ def prometheus_config(scrape_interval: str = "15s") -> dict:
                     },
                 ],
             }
-        ],
-    }
+    ]
 
 
 def alert_rules() -> dict:
@@ -211,8 +220,10 @@ def alert_rules() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _panel(panel_id: int, title: str, expr: str, y: int, x: int = 0,
+def _panel(panel_id: int, title: str, exprs, y: int, x: int = 0,
            w: int = 12, unit: Optional[str] = None) -> dict:
+    if isinstance(exprs, str):
+        exprs = [exprs]
     fieldcfg: dict = {"defaults": {}, "overrides": []}
     if unit:
         fieldcfg["defaults"]["unit"] = unit
@@ -223,7 +234,10 @@ def _panel(panel_id: int, title: str, expr: str, y: int, x: int = 0,
         "gridPos": {"h": 8, "w": w, "x": x, "y": y},
         "datasource": {"type": "prometheus", "uid": "prometheus"},
         "fieldConfig": fieldcfg,
-        "targets": [{"expr": expr, "refId": "A"}],
+        "targets": [
+            {"expr": e, "refId": chr(ord("A") + i)}
+            for i, e in enumerate(exprs)
+        ],
     }
 
 
@@ -235,9 +249,12 @@ def grafana_dashboard() -> dict:
                "sum(rate(seldon_api_executor_server_requests_seconds_count[1m]))"
                " by (deployment)", y=0, x=0),
         _panel(2, "Predict latency p50/p99",
-               "histogram_quantile(0.99, sum(rate("
-               "seldon_api_executor_server_requests_seconds_bucket[5m])) "
-               "by (le, deployment))", y=0, x=12, unit="s"),
+               ["histogram_quantile(0.50, sum(rate("
+                "seldon_api_executor_server_requests_seconds_bucket[5m])) "
+                "by (le, deployment))",
+                "histogram_quantile(0.99, sum(rate("
+                "seldon_api_executor_server_requests_seconds_bucket[5m])) "
+                "by (le, deployment))"], y=0, x=12, unit="s"),
         _panel(3, "Per-node southbound latency p99",
                "histogram_quantile(0.99, sum(rate("
                "seldon_api_executor_client_requests_seconds_bucket[5m])) "
@@ -251,8 +268,9 @@ def grafana_dashboard() -> dict:
                "sum(rate(seldon_batcher_batch_rows_count[5m])) by (batcher)",
                y=16, x=0),
         _panel(6, "Batcher sheds + gateway retries",
-               "sum(rate(seldon_batcher_shed_total[5m])) by (batcher, reason)",
-               y=16, x=12),
+               ["sum(rate(seldon_batcher_shed_total[5m])) by (batcher, reason)",
+                "sum(rate(seldon_api_gateway_retries_total[5m])) "
+                "by (deployment)"], y=16, x=12),
         _panel(7, "Feedback reward rate",
                "sum(rate(seldon_api_model_feedback_reward_total[5m])) "
                "by (deployment, model_name)", y=24, x=0),

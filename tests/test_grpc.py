@@ -339,3 +339,55 @@ class TestEngineOverGrpcSouthbound:
         finally:
             await client.close()
             await server.stop()
+
+
+class TestDeviceRefsLoopback:
+    """SELDON_DEVICE_REFS in-process gRPC loopback: the request payload
+    crosses the proto codec as an HBM handle (DeviceTensorRef), not bytes —
+    the component receives the SAME device array the client sent."""
+
+    async def test_request_payload_stays_on_device(self):
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.messages import SeldonMessage as SM
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.grpc_api import GrpcComponentClient
+
+        from seldon_core_tpu.runtime.device_registry import registry
+
+        class Doubler:
+            # compiled-component contract: X arrives as-is (device array on
+            # the zero-copy path); duck-type predict(X, names) components
+            # get host numpy per their contract instead
+            params = None
+
+            def predict_fn(self, params, X):
+                return X * 2
+
+        handle = ComponentHandle(Doubler(), name="dbl", service_type="MODEL")
+        server, port = await _component_server(handle)
+        try:
+            client = GrpcComponentClient(f"127.0.0.1:{port}",
+                                         device_refs=True)
+            arr = jnp.asarray(np.array([[1.0, 2.0]], np.float32))
+            resolved = []
+            orig_resolve = registry.resolve
+
+            def spy(ref, consume=True):
+                resolved.append(ref)
+                return orig_resolve(ref, consume)
+
+            registry.resolve = spy
+            try:
+                out = await client.predict(SM(data=arr, names=["a", "b"]))
+            finally:
+                registry.resolve = orig_resolve
+            np.testing.assert_array_equal(out.host_data(), [[2.0, 4.0]])
+            # the payload crossed the socket as a DeviceTensorRef and was
+            # resolved server-side (same-buffer identity is proven at the
+            # codec level in test_messages); nothing leaked in the registry
+            assert len(resolved) == 1
+            assert len(registry) == 0
+            await client.close()
+        finally:
+            await server.stop()

@@ -123,3 +123,73 @@ def test_device_resident_flag():
 
 def test_puid_unique():
     assert new_puid() != new_puid()
+
+
+class TestDeviceTensorRef:
+    """DeviceTensorRef (proto/prediction.proto): HBM-handle passing between
+    co-scheduled endpoints through the proto codec (VERDICT r1 #9 — was
+    declared but unimplemented)."""
+
+    def test_roundtrip_same_process_is_zero_copy(self):
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.proto.convert import (
+            message_from_proto,
+            message_to_proto,
+        )
+
+        arr = jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4)
+        msg = SeldonMessage(data=arr, names=["a", "b", "c", "d"])
+        p = message_to_proto(msg, device_refs=True)
+        assert p.data.WhichOneof("data_oneof") == "device"
+        assert list(p.data.device.shape) == [3, 4]
+        out = message_from_proto(p)
+        assert out.data is arr  # the SAME device buffer, not a copy
+        assert out.names == ["a", "b", "c", "d"]
+
+    def test_default_encoding_downgrades_to_bintensor(self):
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.proto.convert import message_to_proto
+
+        msg = SeldonMessage(data=jnp.ones((2, 2), jnp.float32))
+        p = message_to_proto(msg)  # no device_refs: transport-safe default
+        assert p.data.WhichOneof("data_oneof") != "device"
+
+    def test_numpy_payload_never_uses_device_ref(self):
+        from seldon_core_tpu.proto.convert import message_to_proto
+
+        msg = SeldonMessage(data=np.ones((2, 2), np.float32))
+        p = message_to_proto(msg, device_refs=True)
+        assert p.data.WhichOneof("data_oneof") != "device"
+
+    def test_foreign_process_ref_rejected_with_guidance(self):
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.proto.convert import message_from_proto
+        from seldon_core_tpu.runtime.device_registry import ForeignProcessRef
+
+        p = pb.SeldonMessage()
+        p.data.device.buffer_uuid = "deadbeef0000/feedface1111"  # other proc
+        p.data.device.dtype = "float32"
+        p.data.device.shape.extend([1])
+        with pytest.raises(ForeignProcessRef, match="downgrade"):
+            message_from_proto(p)
+
+    def test_refs_are_consumed_once_and_bounded(self):
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.runtime.device_registry import (
+            DeviceBufferRegistry,
+            process_token,
+        )
+
+        reg = DeviceBufferRegistry(capacity=4, ttl_s=300.0)
+        arr = jnp.ones((2,))
+        ref = reg.put(arr)
+        assert ref.startswith(process_token() + "/")
+        assert reg.resolve(ref) is arr
+        with pytest.raises(KeyError):  # one-shot
+            reg.resolve(ref)
+        refs = [reg.put(jnp.ones((1,))) for _ in range(10)]
+        assert len(reg) <= 4  # producer leak bounded
+        assert reg.resolve(refs[-1]) is not None
