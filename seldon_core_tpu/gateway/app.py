@@ -80,6 +80,7 @@ class Gateway:
         app.router.add_get("/ready", self._handle_ready)
         app.router.add_get("/live", self._handle_ready)
         app.router.add_get("/metrics", self._handle_metrics)
+        app.router.add_get("/seldon.json", self._handle_openapi)
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -188,6 +189,11 @@ class Gateway:
     async def _handle_ready(self, request: web.Request) -> web.Response:
         return web.Response(text="ready")
 
+    async def _handle_openapi(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.serving.rest import _openapi_handler
+
+        return await _openapi_handler("gateway")(request)
+
     async def _handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(
             text=self.registry.render(), content_type="text/plain"
@@ -288,7 +294,8 @@ def main(argv: Optional[list] = None) -> None:
     ap.add_argument("--grpc-port", type=int,
                     default=int(os.environ.get("GATEWAY_GRPC_PORT", "5000")))
     ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--firehose", choices=["none", "jsonl", "memory"],
+    ap.add_argument("--firehose",
+                    choices=["none", "jsonl", "segmented", "memory"],
                     default="none")
     ap.add_argument("--firehose-dir", default="./firehose")
     ap.add_argument("--token-spill", default="")

@@ -56,7 +56,9 @@ def load_annotations(path: str = ANNOTATIONS_FILE) -> dict:
     return ann
 
 
-def main(argv: Optional[list] = None) -> None:
+def build_parser() -> argparse.ArgumentParser:
+    """The CLI contract — exposed so packaging (containers/s2i/bin/run) can
+    be drift-locked against the real parser in tests."""
     ap = argparse.ArgumentParser()
     ap.add_argument("interface_name", help="module or module:Class of the user component")
     ap.add_argument("api_type", nargs="?", default="REST",
@@ -72,7 +74,11 @@ def main(argv: Optional[list] = None) -> None:
                          "(reference wrappers/python/persistence.py)")
     ap.add_argument("--push-frequency", type=float,
                     default=float(os.environ.get("PUSH_FREQUENCY", "60")))
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = build_parser().parse_args(argv)
     from seldon_core_tpu.operator.local import _honor_jax_platforms_env
 
     _honor_jax_platforms_env()

@@ -1,0 +1,285 @@
+"""OpenAPI (OAS3) specs for the three HTTP surfaces.
+
+Reference: ``openapi/create_openapis.py`` + ``openapi/{apife,engine,
+wrapper}.oas3.json`` (hand-maintained JSON, served at ``/seldon.json`` by
+the wrappers).  Here the specs are generated from shared schema components
+— and tests assert every aiohttp route is documented, so the spec cannot
+drift from the server (the reference had no such check).
+
+Surfaces:
+- :func:`gateway_spec`   — external API (apife parity: OAuth2 + predict/feedback)
+- :func:`engine_spec`    — per-deployment engine (predictions/feedback + ops)
+- :func:`component_spec` — internal microservice API (predict/route/…)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+OAS_VERSION = "3.0.3"
+
+
+# ---------------------------------------------------------------------------
+# shared schema components (SeldonMessage and friends)
+# ---------------------------------------------------------------------------
+
+
+def _schemas() -> dict:
+    return {
+        "SeldonMessage": {
+            "type": "object",
+            "properties": {
+                "status": {"$ref": "#/components/schemas/Status"},
+                "meta": {"$ref": "#/components/schemas/Meta"},
+                "data": {"$ref": "#/components/schemas/DefaultData"},
+                "binData": {"type": "string", "format": "byte"},
+                "strData": {"type": "string"},
+                "jsonData": {},
+            },
+        },
+        "DefaultData": {
+            "type": "object",
+            "properties": {
+                "names": {"type": "array", "items": {"type": "string"}},
+                "tensor": {"$ref": "#/components/schemas/LegacyTensor"},
+                "ndarray": {"type": "array", "items": {}},
+                "binTensor": {"$ref": "#/components/schemas/Tensor"},
+            },
+        },
+        "LegacyTensor": {
+            "type": "object",
+            "description": "Reference wire parity: {shape, values} doubles "
+                           "(reference prediction.proto:31-34)",
+            "properties": {
+                "shape": {"type": "array", "items": {"type": "integer"}},
+                "values": {"type": "array", "items": {"type": "number"}},
+            },
+        },
+        "Tensor": {
+            "type": "object",
+            "description": "dtype-rich tensor: raw little-endian buffer + "
+                           "numpy dtype name",
+            "properties": {
+                "dtype": {"type": "string"},
+                "shape": {"type": "array", "items": {"type": "integer"}},
+                "raw": {"type": "string", "format": "byte"},
+            },
+        },
+        "Meta": {
+            "type": "object",
+            "properties": {
+                "puid": {"type": "string"},
+                "tags": {"type": "object", "additionalProperties": True},
+                "routing": {
+                    "type": "object",
+                    "additionalProperties": {"type": "integer"},
+                },
+                "requestPath": {
+                    "type": "object",
+                    "additionalProperties": {"type": "string"},
+                },
+                "metrics": {
+                    "type": "array",
+                    "items": {"$ref": "#/components/schemas/Metric"},
+                },
+            },
+        },
+        "Metric": {
+            "type": "object",
+            "properties": {
+                "key": {"type": "string"},
+                "type": {"type": "string",
+                         "enum": ["COUNTER", "GAUGE", "TIMER"]},
+                "value": {"type": "number"},
+            },
+        },
+        "Status": {
+            "type": "object",
+            "properties": {
+                "code": {"type": "integer"},
+                "info": {"type": "string"},
+                "reason": {"type": "string"},
+                "status": {"type": "string",
+                           "enum": ["SUCCESS", "FAILURE"]},
+            },
+        },
+        "Feedback": {
+            "type": "object",
+            "properties": {
+                "request": {"$ref": "#/components/schemas/SeldonMessage"},
+                "response": {"$ref": "#/components/schemas/SeldonMessage"},
+                "truth": {"$ref": "#/components/schemas/SeldonMessage"},
+                "reward": {"type": "number"},
+            },
+        },
+        "SeldonMessageList": {
+            "type": "object",
+            "properties": {
+                "seldonMessages": {
+                    "type": "array",
+                    "items": {"$ref": "#/components/schemas/SeldonMessage"},
+                },
+            },
+        },
+    }
+
+
+def _msg_op(summary: str, body_schema: str = "SeldonMessage",
+            tags: list | None = None) -> dict:
+    return {
+        "summary": summary,
+        "tags": tags or [],
+        "requestBody": {
+            "required": True,
+            "content": {"application/json": {"schema": {
+                "$ref": f"#/components/schemas/{body_schema}"}}},
+        },
+        "responses": {
+            "200": {
+                "description": "SeldonMessage response",
+                "content": {"application/json": {"schema": {
+                    "$ref": "#/components/schemas/SeldonMessage"}}},
+            },
+            "400": {"description": "malformed request (FAILURE status)"},
+        },
+    }
+
+
+def _ops_paths() -> dict:
+    text_ok = {"200": {"description": "OK", "content": {"text/plain": {}}}}
+    return {
+        "/ready": {"get": {"summary": "readiness probe",
+                           "tags": ["ops"], "responses": dict(text_ok)}},
+        "/live": {"get": {"summary": "liveness probe",
+                          "tags": ["ops"], "responses": dict(text_ok)}},
+        "/metrics": {"get": {"summary": "prometheus exposition",
+                             "tags": ["ops"], "responses": dict(text_ok)}},
+    }
+
+
+def gateway_spec() -> dict:
+    """External API (reference apife.oas3.json)."""
+    paths = {
+        "/oauth/token": {
+            "post": {
+                "summary": "OAuth2 client-credentials token endpoint",
+                "tags": ["auth"],
+                "security": [{"basicAuth": []}],
+                "requestBody": {
+                    "content": {"application/x-www-form-urlencoded": {
+                        "schema": {"type": "object", "properties": {
+                            "grant_type": {"type": "string",
+                                           "enum": ["client_credentials"]},
+                        }}}},
+                },
+                "responses": {
+                    "200": {"description": "access token"},
+                    "401": {"description": "bad client credentials"},
+                },
+            }
+        },
+        "/api/v0.1/predictions": {
+            "post": {**_msg_op("predict via deployment routed by principal",
+                               tags=["predict"]),
+                     "security": [{"bearerAuth": []}]},
+        },
+        "/api/v0.1/feedback": {
+            "post": {**_msg_op("send reward feedback", "Feedback",
+                               tags=["predict"]),
+                     "security": [{"bearerAuth": []}]},
+        },
+        **_ops_paths(),
+    }
+    return {
+        "openapi": OAS_VERSION,
+        "info": {"title": "seldon-core-tpu external API (gateway)",
+                 "version": "0.2.0"},
+        "paths": paths,
+        "components": {
+            "schemas": _schemas(),
+            "securitySchemes": {
+                "bearerAuth": {"type": "http", "scheme": "bearer"},
+                "basicAuth": {"type": "http", "scheme": "basic"},
+            },
+        },
+    }
+
+
+def engine_spec() -> dict:
+    """Per-deployment engine API (reference engine.oas3.json)."""
+    paths = {
+        "/api/v0.1/predictions": {
+            "post": _msg_op("run the predictor graph", tags=["predict"])},
+        "/api/v1.0/predictions": {
+            "post": _msg_op("run the predictor graph (alias)",
+                            tags=["predict"])},
+        "/api/v0.1/feedback": {
+            "post": _msg_op("propagate reward feedback down the graph",
+                            "Feedback", tags=["predict"])},
+        "/pause": {"get": {"summary": "stop accepting (pre-drain)",
+                           "tags": ["ops"],
+                           "responses": {"200": {"description": "paused"}}}},
+        "/unpause": {"get": {"summary": "resume accepting", "tags": ["ops"],
+                             "responses": {"200": {"description": "ok"}}}},
+        "/trace": {"get": {"summary": "recent request trace spans",
+                           "tags": ["ops"],
+                           "responses": {"200": {"description": "traces"}}}},
+        **_ops_paths(),
+    }
+    return {
+        "openapi": OAS_VERSION,
+        "info": {"title": "seldon-core-tpu engine API", "version": "0.2.0"},
+        "paths": paths,
+        "components": {"schemas": _schemas()},
+    }
+
+
+def component_spec() -> dict:
+    """Internal microservice API (reference wrapper.oas3.json +
+    docs/reference/internal-api.md)."""
+    paths = {
+        "/predict": {"post": _msg_op("MODEL predict", tags=["component"])},
+        "/transform-input": {
+            "post": _msg_op("TRANSFORMER input transform",
+                            tags=["component"])},
+        "/transform-output": {
+            "post": _msg_op("OUTPUT_TRANSFORMER output transform",
+                            tags=["component"])},
+        "/route": {"post": _msg_op("ROUTER branch choice (1x1 tensor)",
+                                   tags=["component"])},
+        "/aggregate": {
+            "post": _msg_op("COMBINER aggregation", "SeldonMessageList",
+                            tags=["component"])},
+        "/send-feedback": {
+            "post": _msg_op("reward feedback", "Feedback",
+                            tags=["component"])},
+        "/health/status": {
+            "get": {"summary": "component health", "tags": ["ops"],
+                    "responses": {"200": {"description": "healthy"}}}},
+        "/metrics": {"get": {"summary": "prometheus exposition",
+                             "tags": ["ops"],
+                             "responses": {"200": {"description": "OK"}}}},
+    }
+    return {
+        "openapi": OAS_VERSION,
+        "info": {"title": "seldon-core-tpu internal component API",
+                 "version": "0.2.0"},
+        "paths": paths,
+        "components": {"schemas": _schemas()},
+    }
+
+
+def main(argv=None) -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description="emit OAS3 specs")
+    ap.add_argument("which", choices=["gateway", "engine", "component"])
+    args = ap.parse_args(argv)
+    spec = {"gateway": gateway_spec, "engine": engine_spec,
+            "component": component_spec}[args.which]()
+    print(json.dumps(spec, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -199,6 +199,22 @@ class EngineServer:
         app.router.add_get("/unpause", self.unpause)
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_get("/trace", self.trace)
+        app.router.add_get("/seldon.json", _openapi_handler("engine"))
+
+
+def _openapi_handler(which: str):
+    """GET /seldon.json — the surface's OAS3 spec (reference wrappers serve
+    their spec at /seldon.json, openapi/README.md)."""
+
+    async def handler(request: web.Request) -> web.Response:
+        from seldon_core_tpu.serving import openapi
+
+        spec = {"engine": openapi.engine_spec,
+                "component": openapi.component_spec,
+                "gateway": openapi.gateway_spec}[which]()
+        return web.json_response(spec)
+
+    return handler
 
 
 class ComponentServer:
@@ -288,12 +304,15 @@ class ComponentServer:
         app.router.add_post("/aggregate", self.aggregate)
         app.router.add_post("/send-feedback", self.send_feedback)
         app.router.add_get("/health/status", self.health)
-        # an EngineServer registered first may already own /metrics
-        if not any(
-            getattr(r.resource, "canonical", "") == "/metrics"
-            for r in app.router.routes()
-        ):
+        # an EngineServer registered first may already own /metrics (and its
+        # engine-flavored /seldon.json)
+        existing = {
+            getattr(r.resource, "canonical", "") for r in app.router.routes()
+        }
+        if "/metrics" not in existing:
             app.router.add_get("/metrics", self.prometheus)
+        if "/seldon.json" not in existing:
+            app.router.add_get("/seldon.json", _openapi_handler("component"))
 
 
 def build_app(

@@ -1,0 +1,252 @@
+"""Container packaging + OpenAPI + durable firehose (VERDICT r1 missing
+#5/#6/#8).
+
+Reference counterparts: wrappers/s2i/python/s2i/bin/{assemble,run},
+openapi/{apife,engine,wrapper}.oas3.json, kafka request/response firehose.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+S2I_BIN = os.path.join(REPO, "containers", "s2i", "bin")
+
+
+# ---------------------------------------------------------------------------
+# s2i scripts
+# ---------------------------------------------------------------------------
+
+
+def run_script(name: str, env: dict) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["bash", os.path.join(S2I_BIN, name)],
+        env={"PATH": os.environ["PATH"], **env},
+        capture_output=True, text=True, timeout=30,
+    )
+
+
+FULL_ENV = {"MODEL_NAME": "MyModel", "API_TYPE": "REST",
+            "SERVICE_TYPE": "MODEL", "PERSISTENCE": "0", "DRY_RUN": "1"}
+
+
+class TestS2iScripts:
+    def test_run_produces_microservice_command(self):
+        out = run_script("run", FULL_ENV)
+        assert out.returncode == 0, out.stderr
+        cmd = out.stdout.strip().splitlines()[-1]
+        assert "seldon_core_tpu.serving.microservice" in cmd
+        assert "MyModel REST --service-type MODEL --persistence 0" in cmd
+
+    @pytest.mark.parametrize("missing", ["MODEL_NAME", "API_TYPE",
+                                         "SERVICE_TYPE", "PERSISTENCE"])
+    def test_run_requires_env(self, missing):
+        env = {k: v for k, v in FULL_ENV.items() if k != missing}
+        out = run_script("run", env)
+        assert out.returncode == 1
+        assert "required env" in out.stdout
+
+    @pytest.mark.parametrize("missing", ["MODEL_NAME", "API_TYPE",
+                                         "SERVICE_TYPE", "PERSISTENCE"])
+    def test_assemble_requires_env(self, missing):
+        env = {k: v for k, v in FULL_ENV.items() if k != missing}
+        out = run_script("assemble", env)
+        assert out.returncode == 1
+        assert missing in out.stdout
+
+    def test_run_command_parses_against_real_cli(self):
+        """The command run emits must be accepted by the actual CLI parser
+        (s2i-vs-code drift lock, same pattern as the chart tests)."""
+        out = run_script("run", FULL_ENV)
+        argv = out.stdout.strip().splitlines()[-1].split()
+        # strip "python -u -m seldon_core_tpu.serving.microservice"
+        args = argv[argv.index("seldon_core_tpu.serving.microservice") + 1:]
+        from seldon_core_tpu.serving.microservice import build_parser
+
+        # the REAL parser: renaming a flag or the positional in the CLI
+        # without updating containers/s2i/bin/run fails here
+        ns, unknown = build_parser().parse_known_args(args)
+        assert not unknown, unknown
+        assert ns.interface_name == "MyModel"
+        assert ns.api_type == "REST"
+        assert ns.service_type == "MODEL"
+        assert ns.persistence == 0
+
+    def test_dockerfile_template_references_s2i_layout(self):
+        with open(os.path.join(REPO, "containers", "Dockerfile.tmpl")) as f:
+            text = f.read()
+        assert "io.openshift.s2i.scripts-url" in text
+        assert "/usr/libexec/s2i" in text
+        assert "%JAX_VERSION%" in text
+
+
+# ---------------------------------------------------------------------------
+# OpenAPI
+# ---------------------------------------------------------------------------
+
+
+class TestOpenApi:
+    def test_specs_cover_every_registered_route(self):
+        """Every aiohttp route on each surface must be documented in its
+        spec (the reference's hand-maintained JSON had no such check)."""
+        from aiohttp import web
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import DeploymentStore
+        from seldon_core_tpu.graph.engine import GraphEngine
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving import openapi
+        from seldon_core_tpu.serving.rest import ComponentServer, build_app
+
+        def routes(app: web.Application) -> set:
+            return {
+                r.resource.canonical
+                for r in app.router.routes()
+                if r.resource is not None
+            }
+
+        eng_app = build_app(
+            engine=GraphEngine({"name": "m",
+                                "implementation": "SIMPLE_MODEL"})
+        )
+        assert routes(eng_app) <= set(openapi.engine_spec()["paths"]) | {
+            "/seldon.json"
+        }
+
+        class M:
+            def predict(self, X, names):
+                return X
+
+        comp_app = web.Application()
+        ComponentServer(ComponentHandle(M(), name="m")).register(comp_app)
+        assert routes(comp_app) <= set(openapi.component_spec()["paths"]) | {
+            "/seldon.json"
+        }
+
+        gw_app = Gateway(DeploymentStore()).build_app()
+        assert routes(gw_app) <= set(openapi.gateway_spec()["paths"]) | {
+            "/seldon.json"
+        }
+
+    def test_schema_refs_resolve(self):
+        from seldon_core_tpu.serving import openapi
+
+        for spec in (openapi.gateway_spec(), openapi.engine_spec(),
+                     openapi.component_spec()):
+            schemas = spec["components"]["schemas"]
+            text = json.dumps(spec)
+            for ref in set(
+                part.split('"')[0]
+                for part in text.split("#/components/schemas/")[1:]
+            ):
+                assert ref in schemas, f"dangling $ref {ref}"
+
+    def test_served_at_seldon_json(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.graph.engine import GraphEngine
+        from seldon_core_tpu.serving.rest import build_app
+
+        async def run():
+            app = build_app(
+                engine=GraphEngine({"name": "m",
+                                    "implementation": "SIMPLE_MODEL"})
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            resp = await client.get("/seldon.json")
+            assert resp.status == 200
+            spec = await resp.json()
+            assert spec["openapi"].startswith("3.")
+            assert "/api/v0.1/predictions" in spec["paths"]
+            await client.close()
+
+        asyncio.run(run())
+
+    def test_cli(self):
+        for which in ("gateway", "engine", "component"):
+            out = subprocess.run(
+                [sys.executable, "-m", "seldon_core_tpu.serving.openapi",
+                 which],
+                capture_output=True, text=True, cwd=REPO, timeout=60,
+            )
+            assert out.returncode == 0, out.stderr
+            assert json.loads(out.stdout)["openapi"].startswith("3.")
+
+
+# ---------------------------------------------------------------------------
+# segmented firehose
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentedFirehose:
+    def make(self, tmp_path, **kw):
+        from seldon_core_tpu.gateway.firehose import SegmentedFirehose
+
+        return SegmentedFirehose(str(tmp_path), **kw)
+
+    def test_offsets_monotonic_and_readable(self, tmp_path):
+        fh = self.make(tmp_path)
+        for i in range(5):
+            fh.publish("client-a", {"i": i}, {"o": i})
+        recs = fh.read("client-a")
+        assert [r["offset"] for r in recs] == [0, 1, 2, 3, 4]
+        assert recs[3]["request"] == {"i": 3}
+        # resume from a committed offset
+        assert [r["offset"] for r in fh.read("client-a", from_offset=3)] == [3, 4]
+
+    def test_rotation_and_retention(self, tmp_path):
+        fh = self.make(tmp_path, segment_bytes=200, retain_segments=3)
+        for i in range(50):
+            fh.publish("c", {"i": i}, {"o": i})
+        segs = fh._segments("c")
+        assert len(segs) <= 3
+        recs = fh.read("c")
+        offs = [r["offset"] for r in recs]
+        assert offs == sorted(offs)
+        assert offs[-1] == 49  # newest records survive retention
+
+    def test_restart_resumes_offsets(self, tmp_path):
+        fh = self.make(tmp_path)
+        for i in range(3):
+            fh.publish("c", {"i": i}, {})
+        fh2 = self.make(tmp_path)  # fresh instance, same dir
+        fh2.publish("c", {"i": 3}, {})
+        assert [r["offset"] for r in fh2.read("c")] == [0, 1, 2, 3]
+
+    def test_client_isolation(self, tmp_path):
+        fh = self.make(tmp_path)
+        fh.publish("a", {"x": 1}, {})
+        fh.publish("b", {"y": 2}, {})
+        assert len(fh.read("a")) == 1
+        assert fh.read("b")[0]["request"] == {"y": 2}
+
+    def test_sanitization_collisions_stay_isolated(self, tmp_path):
+        """'a/b' and 'a b' both sanitize to 'a_b' — the hash suffix keeps
+        their topics (and offset sequences) separate (cross-principal
+        isolation)."""
+        fh = self.make(tmp_path)
+        fh.publish("a/b", {"secret": "one"}, {})
+        fh.publish("a b", {"secret": "two"}, {})
+        assert [r["request"]["secret"] for r in fh.read("a/b")] == ["one"]
+        assert [r["request"]["secret"] for r in fh.read("a b")] == ["two"]
+        assert [r["offset"] for r in fh.read("a b")] == [0]
+
+    def test_torn_tail_truncated_on_restart(self, tmp_path):
+        """kill -9 mid-write leaves a partial JSON line; recovery must
+        truncate it and keep publishing (not die forever)."""
+        fh = self.make(tmp_path)
+        for i in range(3):
+            fh.publish("c", {"i": i}, {})
+        seg = os.path.join(fh._dir("c"), fh._segments("c")[-1])
+        with open(seg, "a") as f:
+            f.write('{"offset": 3, "ts": 1.0, "requ')  # torn write
+        fh2 = self.make(tmp_path)  # restart
+        fh2.publish("c", {"i": 3}, {})
+        offs = [r["offset"] for r in fh2.read("c")]
+        assert offs == [0, 1, 2, 3]
