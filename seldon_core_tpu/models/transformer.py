@@ -289,9 +289,16 @@ def _seq_axis(cfg: TransformerConfig):
     return "tp" if cfg.seq_shard else None
 
 
-def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None):
+def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
+                    return_kv: bool = False):
     """Causal self-attention.  dense: heads sharded over tp (+ Megatron SP on
-    the residual stream).  ring: sequence sharded over tp (long-context)."""
+    the residual stream).  ring: sequence sharded over tp (long-context).
+
+    ``return_kv=True`` (single-chip serving prefill) also returns the
+    post-rope K/V for the KV cache — one source of truth for the attention
+    math instead of a drifting prefill copy.  Unsupported under ring (the
+    sequence is sharded; the cache layout assumes whole sequences).
+    """
     c = _constrainer(mesh)
     h = rmsnorm(x, p["ln1"])
     if cfg.attention != "ring":
@@ -301,6 +308,9 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None):
     k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(x.dtype))
     v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(x.dtype))
     q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
+    if return_kv and cfg.attention == "ring":
+        raise ValueError("return_kv is unsupported with ring attention "
+                         "(sequence-sharded K/V has no whole-sequence cache)")
     if cfg.attention == "ring" and mesh is not None and mesh.shape.get("tp", 1) > 1:
         # manual only over tp (sequence axis); dp stays GSPMD-managed, so the
         # spec may not mention it (partial-manual shard_map contract).
@@ -346,6 +356,8 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None):
     out = jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(x.dtype))
     # SP: reduce-scatter the row-parallel output back to sequence shards
     out = c(out, "dp", _seq_axis(cfg) if cfg.attention != "ring" else None, None)
+    if return_kv:
+        return x + out, (k, v)
     return x + out
 
 
@@ -579,18 +591,91 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
     return logits[:, 0, :].astype(jnp.float32), cache
 
 
+def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
+            logit_pos=None):
+    """Batched prefill: ONE forward over the whole prompt that also fills
+    the KV cache (round-1 generate() prefilled token-by-token, one device
+    call per prompt token).  Single-chip serving path (mesh=None — sharded
+    prefill goes through the mesh-aware forward/decode_step instead; the
+    KV-cache layout assumes whole sequences per device).
+
+    Returns ``(logits, cache)`` with ``cache['pos'] = L``.  With
+    ``logit_pos`` (an index, traceable) only that position is projected
+    through the vocab matrix — logits are ``[B, V]``; the default projects
+    all positions (``[B, L, V]``).  At L=2k/V=32k the full projection is
+    ~256 MB of f32 logits no generate-style caller reads — always pass
+    ``logit_pos`` on the serving path.
+
+    Padding note for continuous batching: with a right-padded prompt,
+    causal attention keeps positions < true length unaffected; callers
+    pass ``logit_pos = true_len - 1`` and set pos accordingly.
+    """
+    B, L = input_ids.shape
+    x = params["embed"].astype(cfg.dtype)[input_ids]
+    positions = jnp.arange(L)[None, :]
+
+    def block(p, x):
+        x, (k, v) = attention_block(p, x, positions, cfg, mesh=None,
+                                    return_kv=True)
+        x, _ = ffn_block(p, x, cfg, mesh=None)
+        return x, (k, v)
+
+    if _has_q8(params["blocks"]):
+        ks, vs = [], []
+        for i in range(cfg.n_layers):
+            x, (k, v) = block(_layer_params(params["blocks"], i), x)
+            ks.append(k)
+            vs.append(v)
+        ks, vs = jnp.stack(ks), jnp.stack(vs)
+    else:
+        def scan_body(carry, p_layer):
+            y, kv = block(p_layer, carry)
+            return y, kv
+
+        x, (ks, vs) = jax.lax.scan(scan_body, x, params["blocks"])
+
+    x = rmsnorm(x, params["ln_f"])
+    if logit_pos is not None:
+        # project ONE position: (B, 1, D) through the vocab matrix
+        x = jax.lax.dynamic_slice_in_dim(x, logit_pos, 1, axis=1)
+        logits = _vocab_proj(x, params["lm_head"], cfg)[:, 0].astype(
+            jnp.float32
+        )
+    else:
+        logits = _vocab_proj(x, params["lm_head"], cfg).astype(jnp.float32)
+
+    pad = max_len - L
+    cache = {
+        # (layers, B, max_len, H, Dh): prompt K/V up front, zeros after
+        "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "pos": jnp.full((B,), L, jnp.int32),
+    }
+    return logits, cache
+
+
 def generate(params, prompt_ids, n_new: int, cfg: TransformerConfig,
              mesh=None, temperature: float = 0.0, key=None):
-    """Greedy/temperature sampling with a jitted decode step."""
+    """Greedy/temperature sampling: batched prefill (one device call for
+    the whole prompt), then a jitted incremental decode step per token.
+
+    Under a mesh the prefill stays token-by-token through the mesh-aware
+    decode_step — the single-chip prefill has no sharding constraints and
+    would replicate/blow up exactly the long-context configs the mesh
+    exists for (sequence-sharded batched prefill is future work)."""
     B, L0 = prompt_ids.shape
     if temperature > 0.0 and key is None:
         key = jax.random.PRNGKey(0)
-    cache = init_cache(cfg, B, max_len=L0 + n_new)
     step = jax.jit(partial(decode_step, cfg=cfg, mesh=mesh))
-    # prefill token-by-token (simple; batched prefill is a future optimization)
-    logits = None
-    for t in range(L0):
-        logits, cache = step(params, cache, prompt_ids[:, t])
+    if mesh is None:
+        fill = jax.jit(partial(prefill, cfg=cfg, max_len=L0 + n_new,
+                               logit_pos=L0 - 1))
+        logits, cache = fill(params, prompt_ids)
+    else:
+        cache = init_cache(cfg, B, max_len=L0 + n_new)
+        logits = None
+        for t in range(L0):
+            logits, cache = step(params, cache, prompt_ids[:, t])
     out = [prompt_ids]
     tok = None
     for t in range(n_new):

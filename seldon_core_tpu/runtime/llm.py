@@ -1,0 +1,267 @@
+"""Continuous-batching LLM serving engine.
+
+No reference counterpart (Seldon Core predates LLM serving; SURVEY.md §5.7
+"long-context: absent").  Design, TPU-first:
+
+- **Fixed-shape slot model**: the KV cache is one device allocation of
+  ``(layers, max_slots, max_len, H, Dh)``; a request occupies a slot for its
+  lifetime.  All device programs see static shapes, so there are exactly
+  two compiled programs in steady state: slot-prefill (per prompt-length
+  bucket) and the shared decode tick.
+- **Continuous batching**: arrivals join the running batch at slot
+  granularity — a long generation never blocks a short one behind it (the
+  orthodox static-batch server pads every request to the longest).  Each
+  tick decodes every active slot in one device call.
+- **Bucketed prefill**: prompts are right-padded to a power-of-two bucket
+  so prompt-length variety costs O(log L) compiles, not O(#lengths); causal
+  attention makes right-padding exact for positions < true length
+  (models/transformer.py prefill docstring).
+- **Async surface**: ``generate()`` is awaitable and the tick loop runs as
+  an asyncio task only while slots are active — idle engines cost nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.models.transformer import (
+    TransformerConfig,
+    decode_step,
+    init_cache,
+    prefill,
+)
+
+__all__ = ["LLMEngine", "LLMComponent"]
+
+
+def _bucket(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Slot:
+    future: asyncio.Future
+    remaining: int
+    tokens: list
+    temperature: float
+    key: Any
+
+
+class LLMEngine:
+    """Slot-based continuous batching over one transformer.
+
+    ``await engine.generate(prompt_ids, n_new)`` → generated ids
+    ``[1, L0 + n_new]``.  Greedy by default; per-request temperature.
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: TransformerConfig,
+        max_slots: int = 8,
+        max_len: Optional[int] = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len or cfg.max_seq
+        self.cache = init_cache(cfg, max_slots, max_len=self.max_len)
+        self._tokens = jnp.zeros((max_slots,), jnp.int32)
+        self._slots: dict[int, _Slot] = {}
+        self._free = list(range(max_slots))
+        self._slot_waiters: list[asyncio.Future] = []  # FIFO admission
+        self._tick_task: Optional[asyncio.Task] = None
+        self._step = jax.jit(partial(decode_step, cfg=cfg))
+        self._insert = jax.jit(self._insert_impl, static_argnames=("true_len",))
+        self._prefills: dict[int, Any] = {}  # bucket -> jitted prefill
+
+    # -- device programs -------------------------------------------------
+    def _prefill_for(self, bucket: int):
+        fn = self._prefills.get(bucket)
+        if fn is None:
+            fn = self._prefills[bucket] = jax.jit(
+                partial(prefill, cfg=self.cfg, max_len=bucket)
+            )
+        return fn
+
+    @staticmethod
+    def _insert_impl(cache, small, slot, true_len: int):
+        """Copy a 1-slot prefill cache into slot ``slot`` of the big cache
+        (device-side, no host round trip).  ``small`` k/v: (layers, 1,
+        bucket, H, Dh); valid K/V is [:, :, :true_len]."""
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], small["k"][:, :, :true_len].astype(cache["k"].dtype),
+            (0, slot, 0, 0, 0),
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], small["v"][:, :, :true_len].astype(cache["v"].dtype),
+            (0, slot, 0, 0, 0),
+        )
+        pos = jax.lax.dynamic_update_slice(
+            cache["pos"], jnp.array([true_len], jnp.int32), (slot,)
+        )
+        return {"k": k, "v": v, "pos": pos}
+
+    # -- public ----------------------------------------------------------
+    async def generate(
+        self,
+        prompt_ids,
+        n_new: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ):
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None, :]
+        B, L0 = prompt_ids.shape
+        if B != 1:
+            raise ValueError("generate() takes one request; batching is the "
+                             "engine's job (submit concurrently)")
+        if L0 + n_new > self.max_len:
+            raise ValueError(
+                f"prompt {L0} + n_new {n_new} exceeds max_len {self.max_len}"
+            )
+        if n_new <= 0:
+            return prompt_ids
+        slot = await self._acquire_slot()
+
+        # bucketed prefill (right-padding is exact under causal attention);
+        # logit_pos: only the last true position is vocab-projected
+        bucket = _bucket(L0)
+        padded = jnp.pad(prompt_ids, ((0, 0), (0, bucket - L0)))
+        logits, small = self._prefill_for(bucket)(
+            self.params, padded, logit_pos=L0 - 1
+        )
+        first_logits = logits[0]
+        self.cache = self._insert(self.cache, small, slot, true_len=L0)
+
+        key = jax.random.PRNGKey(seed) if temperature > 0.0 else None
+        st = _Slot(
+            future=asyncio.get_running_loop().create_future(),
+            remaining=n_new,
+            tokens=[],
+            temperature=temperature,
+            key=key,
+        )
+        self._slots[slot] = st
+        # first generated token comes straight from the prefill logits
+        self._emit(slot, st, first_logits)
+        if st.remaining > 0:
+            self._ensure_ticking()
+        out_new = await st.future
+        return jnp.concatenate(
+            [prompt_ids, jnp.asarray(out_new, jnp.int32)[None, :]], axis=1
+        )
+
+    # -- internals -------------------------------------------------------
+    async def _acquire_slot(self) -> int:
+        """FIFO slot admission — waiters are woken in arrival order by
+        ``_release_slot`` (no polling)."""
+        while not self._free:
+            waiter: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._slot_waiters.append(waiter)
+            await waiter
+        return self._free.pop()
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.append(slot)
+        while self._slot_waiters:
+            w = self._slot_waiters.pop(0)
+            if not w.done():
+                w.set_result(None)
+                break
+
+    def _emit(self, slot: int, st: _Slot, logits) -> None:
+        if st.temperature > 0.0:
+            st.key, sub = jax.random.split(st.key)
+            tok = int(jax.random.categorical(sub, logits / st.temperature))
+        else:
+            tok = int(jnp.argmax(logits))
+        st.tokens.append(tok)
+        st.remaining -= 1
+        self._tokens = self._tokens.at[slot].set(tok)
+        if st.remaining <= 0:
+            del self._slots[slot]
+            self._release_slot(slot)
+            if not st.future.done():
+                st.future.set_result(st.tokens)
+
+    def _ensure_ticking(self) -> None:
+        if self._tick_task is None or self._tick_task.done():
+            self._tick_task = asyncio.get_running_loop().create_task(
+                self._tick_loop()
+            )
+
+    async def _tick_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while self._slots:
+                logits, self.cache = self._step(
+                    self.params, self.cache, self._tokens
+                )
+                # one transfer per tick for all slots, OFF the event loop —
+                # a blocking fetch here would stall every other handler
+                # (health probes, new arrivals) for the device round trip
+                host = await loop.run_in_executor(None, np.asarray, logits)
+                for slot, st in list(self._slots.items()):
+                    self._emit(slot, st, jnp.asarray(host[slot]))
+                await asyncio.sleep(0)  # let arrivals join between ticks
+        except BaseException as e:
+            # a dying tick loop must not strand in-flight requests on
+            # futures nobody will ever resolve
+            for slot, st in list(self._slots.items()):
+                del self._slots[slot]
+                self._release_slot(slot)
+                if not st.future.done():
+                    st.future.set_exception(e)
+            raise
+        finally:
+            self._tick_task = None
+
+
+class LLMComponent:
+    """Graph MODEL adapter: serves LLMEngine.generate through the standard
+    component surface, so an LLM deploys exactly like any other model
+    (REST/gRPC/framed, graph composition, metrics).
+
+    Request: jsonData {"prompt_ids": [...], "n_new": N, "temperature": T}
+    or a token-id tensor (n_new via the ``n_new`` component parameter).
+    Response: jsonData {"ids": [...], "text_len": L}.
+    """
+
+    def __init__(self, engine: LLMEngine, n_new: int = 16):
+        self.engine = engine
+        self.default_n_new = n_new
+        self.name = "llm"
+
+    def has(self, method: str) -> bool:
+        return method == "predict"
+
+    async def predict(self, msg):
+        from seldon_core_tpu.messages import SeldonMessage
+
+        if msg.json_data is not None:
+            spec = msg.json_data
+            ids = spec["prompt_ids"]
+            n_new = int(spec.get("n_new", self.default_n_new))
+            temp = float(spec.get("temperature", 0.0))
+        else:
+            ids = np.asarray(msg.host_data(), np.int32).reshape(-1)
+            n_new, temp = self.default_n_new, 0.0
+        out = await self.engine.generate(
+            jnp.asarray(ids, jnp.int32), n_new, temperature=temp
+        )
+        ids_out = np.asarray(out[0]).tolist()
+        return SeldonMessage(
+            json_data={"ids": ids_out, "prompt_len": len(ids)}
+        )
