@@ -598,7 +598,9 @@ def main() -> None:
         extras["transport_batch_error"] = f"{type(e).__name__}: {e}"
     # socket baselines context: the reference's 12,089/28,256 req/s ran on a
     # 16-core engine host driven by 64 remote locust slaves; here client AND
-    # server share this host's cores.
+    # server share this host's cores.  Per-core the gRPC path is at parity:
+    # 28,256/16 = 1,766 req/s/core server-only vs ~1.4-2k here carrying both
+    # sides (multi-channel was measured to change nothing — CPU-bound).
     extras["host_cores"] = os.cpu_count()
     try:
         # best-of-2: the device tunnel occasionally hiccups for seconds at a

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Release tooling: version propagation + consistency check.
+
+Reference: ``release/release.py`` + ``create-changelog`` (version stamped
+across Makefiles/helm values by sed).  Here ``seldon_core_tpu.__version__``
+is the single source of truth; this script propagates it to every other
+place a version appears, and ``--check`` fails CI when any copy drifts
+(the OpenAPI specs import ``__version__`` directly, so they cannot drift).
+
+    python release/release.py --check            # verify consistency
+    python release/release.py --set 0.3.0        # bump everywhere
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (path, regex-with-one-group-for-the-version) — every stamped copy
+STAMPS = [
+    ("seldon_core_tpu/__init__.py", r'__version__ = "([^"]+)"'),
+    ("pyproject.toml", r'^version = "([^"]+)"'),
+    ("charts/seldon-core-tpu/Chart.yaml", r"^version: (.+)$"),
+    ("charts/seldon-core-tpu/Chart.yaml", r'^appVersion: "([^"]+)"'),
+    ("charts/seldon-core-tpu-analytics/Chart.yaml", r"^version: (.+)$"),
+    ("charts/seldon-core-tpu-analytics/Chart.yaml", r'^appVersion: "([^"]+)"'),
+]
+
+
+def read_versions() -> list[tuple[str, str, str]]:
+    out = []
+    for path, pat in STAMPS:
+        with open(os.path.join(REPO, path)) as f:
+            text = f.read()
+        m = re.search(pat, text, re.MULTILINE)
+        if not m:
+            raise SystemExit(f"{path}: pattern {pat!r} not found")
+        out.append((path, pat, m.group(1)))
+    return out
+
+
+def check() -> int:
+    versions = read_versions()
+    canonical = versions[0][2]  # __init__.__version__
+    bad = [(p, v) for p, _, v in versions if v != canonical]
+    if bad:
+        for p, v in bad:
+            print(f"DRIFT {p}: {v} != {canonical}", file=sys.stderr)
+        return 1
+    print(f"version {canonical} consistent across {len(versions)} stamps")
+    return 0
+
+
+def set_version(new: str) -> None:
+    if not re.fullmatch(r"\d+\.\d+\.\d+([.-][A-Za-z0-9]+)?", new):
+        raise SystemExit(f"not a version: {new!r}")
+    for path, pat in STAMPS:
+        full = os.path.join(REPO, path)
+        with open(full) as f:
+            text = f.read()
+
+        def sub(m: re.Match) -> str:
+            return m.group(0).replace(m.group(1), new)
+
+        text2 = re.sub(pat, sub, text, flags=re.MULTILINE)
+        if text2 != text:
+            with open(full, "w") as f:
+                f.write(text2)
+            print(f"stamped {new} into {path}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--check", action="store_true")
+    g.add_argument("--set", dest="new", metavar="X.Y.Z")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check()
+    set_version(args.new)
+    return check()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

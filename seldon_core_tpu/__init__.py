@@ -17,4 +17,4 @@ from seldon_core_tpu.messages import (  # noqa: F401
     new_puid,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -20,6 +20,7 @@ createResources steps (``SeldonDeploymentOperatorImpl.java:375,580``):
 from __future__ import annotations
 
 import base64
+import copy
 import json
 from typing import Any, Optional
 
@@ -261,6 +262,17 @@ def _colocated_predictor(
     for r in range(p.replicas):
         sts_name = workload_name if p.replicas == 1 else f"{workload_name}-r{r}"
         rlabels = {**labels, "seldon-slice-replica": str(r)}
+        # per-replica pod template: the jax.distributed coordinator is THIS
+        # StatefulSet's worker-0 pod under its headless service
+        # (runtime/multihost.py consumes it)
+        tmpl = copy.deepcopy(_pod_template(rlabels))
+        coord = (
+            f"{sts_name}-0.{sts_name}-hosts."
+            f"{dep.namespace}.svc.cluster.local:8476"
+        )
+        tmpl["spec"]["containers"][0]["env"].append(
+            {"name": "TPU_COORDINATOR_ADDRESS", "value": coord}
+        )
         out.append(
             {
                 "apiVersion": "apps/v1",
@@ -275,7 +287,7 @@ def _colocated_predictor(
                     "serviceName": f"{sts_name}-hosts",
                     "podManagementPolicy": "Parallel",
                     "selector": {"matchLabels": rlabels},
-                    "template": _pod_template(rlabels),
+                    "template": tmpl,
                 },
             }
         )

@@ -229,6 +229,11 @@ def engine_main(argv: Optional[list] = None) -> None:
     ap.add_argument("--host", default="0.0.0.0")
     args = ap.parse_args(argv)
     _honor_jax_platforms_env()
+    # multi-host slice pods join the jax.distributed mesh BEFORE any jax
+    # call (operator-injected env; no-op single-host)
+    from seldon_core_tpu.runtime.multihost import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
 
     if args.graph:
         dep = load_deployment_file(args.graph)

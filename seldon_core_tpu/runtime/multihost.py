@@ -1,0 +1,78 @@
+"""Multi-host slice bring-up: jax.distributed from operator-injected env.
+
+The operator compiles a multi-host predictor to one StatefulSet per slice
+replica (operator/compile.py): every pod gets
+
+- ``TPU_WORKER_ID``       — pod ordinal (apps.kubernetes.io/pod-index)
+- ``NUM_TPU_HOSTS``       — hosts in the slice
+- ``TPU_COORDINATOR_ADDRESS`` — worker 0's stable DNS name under the
+  StatefulSet's headless service, port 8476
+
+This module is the missing runtime half: the engine pod entrypoint calls
+:func:`maybe_initialize_distributed` before touching jax, so all hosts join
+one PJRT client and ``jax.devices()`` spans the whole slice — the reference
+has no analog (its scaling unit is the single-process pod; SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["multihost_env", "maybe_initialize_distributed"]
+
+
+def multihost_env() -> Optional[dict]:
+    """Parse the operator's multi-host env contract; None when single-host.
+
+    Raises on a HALF-configured contract (NUM_TPU_HOSTS > 1 but no worker
+    id / coordinator): silently proceeding single-host would wedge the
+    slice at its first collective with a shape mismatch — fail at boot with
+    the reason instead.
+    """
+    hosts = int(os.environ.get("NUM_TPU_HOSTS", "1") or 1)
+    if hosts <= 1:
+        return None
+    wid = os.environ.get("TPU_WORKER_ID", "")
+    coord = os.environ.get("TPU_COORDINATOR_ADDRESS", "")
+    if wid == "" or not coord:
+        raise RuntimeError(
+            f"NUM_TPU_HOSTS={hosts} but TPU_WORKER_ID={wid!r} / "
+            f"TPU_COORDINATOR_ADDRESS={coord!r}: multi-host pods must run "
+            "under the operator's StatefulSet (operator/compile.py) which "
+            "injects both"
+        )
+    return {
+        "num_processes": hosts,
+        "process_id": int(wid),
+        "coordinator_address": coord,
+    }
+
+
+def maybe_initialize_distributed(initialize=None) -> bool:
+    """Join the slice if the env says so; returns True when distributed.
+
+    ``initialize`` is injectable for tests (defaults to
+    ``jax.distributed.initialize``).  Must run before any other jax call —
+    backend initialization freezes the process topology.
+    """
+    env = multihost_env()
+    if env is None:
+        return False
+    if initialize is None:
+        import jax
+
+        initialize = jax.distributed.initialize
+    logger.info(
+        "joining %d-host slice as worker %d (coordinator %s)",
+        env["num_processes"], env["process_id"], env["coordinator_address"],
+    )
+    initialize(
+        coordinator_address=env["coordinator_address"],
+        num_processes=env["num_processes"],
+        process_id=env["process_id"],
+    )
+    return True

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import Any
 
+from seldon_core_tpu import __version__
+
 OAS_VERSION = "3.0.3"
 
 
@@ -193,7 +195,7 @@ def gateway_spec() -> dict:
     return {
         "openapi": OAS_VERSION,
         "info": {"title": "seldon-core-tpu external API (gateway)",
-                 "version": "0.2.0"},
+                 "version": __version__},
         "paths": paths,
         "components": {
             "schemas": _schemas(),
@@ -228,7 +230,7 @@ def engine_spec() -> dict:
     }
     return {
         "openapi": OAS_VERSION,
-        "info": {"title": "seldon-core-tpu engine API", "version": "0.2.0"},
+        "info": {"title": "seldon-core-tpu engine API", "version": __version__},
         "paths": paths,
         "components": {"schemas": _schemas()},
     }
@@ -263,7 +265,7 @@ def component_spec() -> dict:
     return {
         "openapi": OAS_VERSION,
         "info": {"title": "seldon-core-tpu internal component API",
-                 "version": "0.2.0"},
+                 "version": __version__},
         "paths": paths,
         "components": {"schemas": _schemas()},
     }

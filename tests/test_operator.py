@@ -218,3 +218,89 @@ def test_local_deployment_mab_with_feedback():
         run(local.send_feedback(Feedback(response=resp, reward=1.0)))
     out = run(local.predict(SeldonMessage.from_ndarray(np.zeros((1, 2)))))
     assert out.meta.routing["eg"] == 1
+
+
+class TestMultihostRuntime:
+    """runtime/multihost.py: the runtime half of the operator's multi-host
+    StatefulSet contract (TPU_WORKER_ID / NUM_TPU_HOSTS /
+    TPU_COORDINATOR_ADDRESS)."""
+
+    def test_single_host_is_noop(self, monkeypatch):
+        from seldon_core_tpu.runtime.multihost import (
+            maybe_initialize_distributed,
+        )
+
+        monkeypatch.delenv("NUM_TPU_HOSTS", raising=False)
+        calls = []
+        assert maybe_initialize_distributed(initialize=calls.append) is False
+        assert not calls
+
+    def test_multihost_joins_with_operator_env(self, monkeypatch):
+        from seldon_core_tpu.runtime.multihost import (
+            maybe_initialize_distributed,
+        )
+
+        monkeypatch.setenv("NUM_TPU_HOSTS", "4")
+        monkeypatch.setenv("TPU_WORKER_ID", "2")
+        monkeypatch.setenv(
+            "TPU_COORDINATOR_ADDRESS",
+            "d-p-0.d-p-hosts.default.svc.cluster.local:8476",
+        )
+        seen = {}
+
+        def fake_init(**kw):
+            seen.update(kw)
+
+        assert maybe_initialize_distributed(initialize=fake_init) is True
+        assert seen == {
+            "coordinator_address":
+                "d-p-0.d-p-hosts.default.svc.cluster.local:8476",
+            "num_processes": 4,
+            "process_id": 2,
+        }
+
+    def test_half_configured_contract_fails_at_boot(self, monkeypatch):
+        from seldon_core_tpu.runtime.multihost import multihost_env
+
+        monkeypatch.setenv("NUM_TPU_HOSTS", "4")
+        monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+        monkeypatch.delenv("TPU_COORDINATOR_ADDRESS", raising=False)
+        with pytest.raises(RuntimeError, match="StatefulSet"):
+            multihost_env()
+
+    def test_compile_emits_coordinator_address(self):
+        """Manifest side of the contract: every multi-host pod knows worker
+        0's DNS name under ITS OWN StatefulSet's headless service."""
+        from seldon_core_tpu.operator.compile import compile_deployment
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+
+        dep = SeldonDeployment.from_dict({
+            "metadata": {"name": "d"},
+            "spec": {
+                "name": "d",
+                "annotations": {"seldon.io/tpu-chips": "16"},  # 2 hosts
+                "predictors": [{
+                    "name": "p",
+                    "replicas": 2,
+                    "graph": {"name": "m", "type": "MODEL",
+                              "parameters": [{
+                                  "name": "model_class",
+                                  "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+                                  "type": "STRING"}]},
+                }],
+            },
+        })
+        stss = [o for o in compile_deployment(dep)
+                if o["kind"] == "StatefulSet"]
+        assert len(stss) == 2  # one per slice replica
+        for sts in stss:
+            name = sts["metadata"]["name"]
+            env = {
+                e["name"]: e.get("value")
+                for c in sts["spec"]["template"]["spec"]["containers"]
+                for e in c.get("env", [])
+            }
+            assert env["NUM_TPU_HOSTS"] == "2"
+            assert env["TPU_COORDINATOR_ADDRESS"] == (
+                f"{name}-0.{name}-hosts.default.svc.cluster.local:8476"
+            )

@@ -250,3 +250,21 @@ class TestSegmentedFirehose:
         fh2.publish("c", {"i": 3}, {})
         offs = [r["offset"] for r in fh2.read("c")]
         assert offs == [0, 1, 2, 3]
+
+
+class TestReleaseTooling:
+    def test_versions_consistent(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "release", "release.py"),
+             "--check"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert out.returncode == 0, out.stderr
+
+    def test_openapi_version_follows_package(self):
+        import seldon_core_tpu
+        from seldon_core_tpu.serving import openapi
+
+        for spec in (openapi.gateway_spec(), openapi.engine_spec(),
+                     openapi.component_spec()):
+            assert spec["info"]["version"] == seldon_core_tpu.__version__
