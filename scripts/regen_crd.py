@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""Regenerate charts/seldon-core-tpu/templates/crd.yaml from
+operator/reconcile.py crd_manifest(), INCLUDING the helm conditional
+wrapper (regenerating without it would silently break crd.create=false)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import yaml  # noqa: E402
+
+from seldon_core_tpu.operator.reconcile import crd_manifest  # noqa: E402
+
+HEADER = """{{- if .Values.crd.create }}
+# GENERATED from operator/reconcile.py crd_manifest() — tests assert the
+# two stay identical; regenerate with:  python scripts/regen_crd.py
+# Reference: helm-charts/seldon-core-crd/ + the validation-schema expander
+# util/custom-resource-definitions/expand-validation.py.
+"""
+
+path = os.path.join(os.path.dirname(__file__), "..", "charts",
+                    "seldon-core-tpu", "templates", "crd.yaml")
+with open(path, "w") as f:
+    f.write(HEADER + yaml.safe_dump(crd_manifest(), sort_keys=False)
+            + "{{- end }}\n")
+print(f"regenerated {os.path.relpath(path)}")

@@ -1,0 +1,138 @@
+"""CRD structural validation schema for SeldonDeployment.
+
+Reference parity: ``util/custom-resource-definitions/expand-validation.py``
+expands a validation schema into the CRD so the apiserver rejects malformed
+resources before the operator sees them.  Here the schema is generated from
+code (one source with operator/spec.py's parser), recursive graph included
+— apiextensions v1 structural schemas can't recurse, so the graph nests a
+fixed depth (validated deeper than any reference example graph) and leaves
+deeper levels open via ``x-kubernetes-preserve-unknown-fields``.
+"""
+
+from __future__ import annotations
+
+from seldon_core_tpu.graph.spec import (
+    BUILTIN_IMPLEMENTATIONS,
+    PARAM_TYPES,
+    UNIT_TYPES,
+)
+
+GRAPH_DEPTH = 6  # deepest validated nesting of PredictiveUnit children
+
+# single source with the parser (graph/spec.py): adding a builtin there
+# automatically admits it here — the apiserver and the operator can never
+# disagree on the enums
+_TYPES = list(UNIT_TYPES)
+_IMPLS = list(BUILTIN_IMPLEMENTATIONS)
+_PARAM_TYPES = list(PARAM_TYPES)
+
+
+def _parameter_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": {"type": "string"},
+            "value": {"type": "string"},
+            "type": {"type": "string", "enum": _PARAM_TYPES},
+        },
+    }
+
+
+def _endpoint_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "service_host": {"type": "string"},
+            "service_port": {"type": "integer"},
+            "type": {"type": "string", "enum": ["REST", "GRPC", "LOCAL"]},
+        },
+    }
+
+
+def _unit_schema(depth: int) -> dict:
+    schema: dict = {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": {"type": "string"},
+            "type": {"type": "string", "enum": _TYPES},
+            "implementation": {"type": "string", "enum": _IMPLS},
+            "methods": {"type": "array", "items": {"type": "string"}},
+            "endpoint": _endpoint_schema(),
+            "parameters": {"type": "array", "items": _parameter_schema()},
+            # TPU placement hint (graph/spec.py slice_group) — must be
+            # listed or the structural schema makes the apiserver PRUNE it
+            "sliceGroup": {"type": "string"},
+        },
+    }
+    if depth > 0:
+        schema["properties"]["children"] = {
+            "type": "array",
+            "items": _unit_schema(depth - 1),
+        }
+    else:
+        # beyond the validated depth: accept anything (operator-side
+        # validate_deployment still checks the full tree)
+        schema["properties"]["children"] = {
+            "type": "array",
+            "items": {"type": "object",
+                      "x-kubernetes-preserve-unknown-fields": True},
+        }
+    return schema
+
+
+def validation_schema() -> dict:
+    """openAPIV3Schema for the CRD version entry."""
+    return {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["predictors"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "oauth_key": {"type": "string"},
+                    "oauth_secret": {"type": "string"},
+                    "annotations": {
+                        "type": "object",
+                        "additionalProperties": {"type": "string"},
+                    },
+                    "predictors": {
+                        "type": "array",
+                        "minItems": 1,
+                        "items": {
+                            "type": "object",
+                            "required": ["name", "graph"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "replicas": {"type": "integer", "minimum": 0},
+                                "traffic": {"type": "integer", "minimum": 0},
+                                "graph": _unit_schema(GRAPH_DEPTH),
+                                "annotations": {
+                                    "type": "object",
+                                    "additionalProperties": {"type": "string"},
+                                },
+                                "labels": {
+                                    "type": "object",
+                                    "additionalProperties": {"type": "string"},
+                                },
+                                "componentSpecs": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "x-kubernetes-preserve-unknown-fields":
+                                            True,
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }

@@ -33,6 +33,7 @@ import time
 from typing import Any, Iterable, Optional, Protocol
 
 from seldon_core_tpu.operator.compile import compile_deployment
+from seldon_core_tpu.operator.crd_schema import validation_schema
 from seldon_core_tpu.operator.spec import (
     API_VERSION,
     KIND,
@@ -96,12 +97,10 @@ def crd_manifest() -> dict:
                     # status is a subresource so controller status writes
                     # never clobber (or race) the user's spec
                     "subresources": {"status": {}},
-                    "schema": {
-                        "openAPIV3Schema": {
-                            "type": "object",
-                            "x-kubernetes-preserve-unknown-fields": True,
-                        }
-                    },
+                    # structural validation schema generated from code
+                    # (operator/crd_schema.py; reference parity:
+                    # util/custom-resource-definitions/expand-validation.py)
+                    "schema": {"openAPIV3Schema": validation_schema()},
                 }
             ],
         },

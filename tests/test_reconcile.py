@@ -8,6 +8,8 @@ DeploymentWatcher.java:60-146, CRDCreator.java:31-140)."""
 import copy
 import json
 
+import pytest
+
 from seldon_core_tpu.operator.reconcile import (
     FakeKubeApi,
     SeldonDeploymentController,
@@ -262,3 +264,97 @@ def test_stale_hash_triggers_update_but_fresh_does_not():
 def test_crd_manifest_round_trips_json():
     # the manifest is emitted to users (kubectl apply -f) — must be pure JSON
     json.loads(json.dumps(crd_manifest()))
+
+
+class TestCrdValidationSchema:
+    """Structural schema (operator/crd_schema.py): the apiserver-side
+    validation the reference expands via expand-validation.py."""
+
+    def _validate(self, instance):
+        """Minimal structural-schema checker (enough of OpenAPI v3 for the
+        shapes the schema uses: type/required/enum/minimum/minItems)."""
+        from seldon_core_tpu.operator.crd_schema import validation_schema
+
+        def walk(schema, val, path="$"):
+            t = schema.get("type")
+            if t == "object":
+                if not isinstance(val, dict):
+                    raise AssertionError(f"{path}: not an object")
+                if schema.get("x-kubernetes-preserve-unknown-fields"):
+                    return
+                for req in schema.get("required", []):
+                    if req not in val:
+                        raise AssertionError(f"{path}: missing {req}")
+                props = schema.get("properties", {})
+                addl = schema.get("additionalProperties")
+                for k, v in val.items():
+                    if k in props:
+                        walk(props[k], v, f"{path}.{k}")
+                    elif isinstance(addl, dict):
+                        walk(addl, v, f"{path}.{k}")
+            elif t == "array":
+                if not isinstance(val, list):
+                    raise AssertionError(f"{path}: not an array")
+                if "minItems" in schema and len(val) < schema["minItems"]:
+                    raise AssertionError(f"{path}: fewer than minItems")
+                for i, v in enumerate(val):
+                    walk(schema["items"], v, f"{path}[{i}]")
+            elif t == "string":
+                if not isinstance(val, str):
+                    raise AssertionError(f"{path}: not a string")
+                if "enum" in schema and val not in schema["enum"]:
+                    raise AssertionError(f"{path}: {val!r} not in enum")
+            elif t == "integer":
+                if not isinstance(val, int) or isinstance(val, bool):
+                    raise AssertionError(f"{path}: not an integer")
+                if "minimum" in schema and val < schema["minimum"]:
+                    raise AssertionError(f"{path}: below minimum")
+
+        walk(validation_schema(), instance)
+
+    def test_every_example_graph_validates(self):
+        import os
+
+        examples = os.path.join(os.path.dirname(__file__), "..", "examples",
+                                "graphs")
+        for name in sorted(os.listdir(examples)):
+            with open(os.path.join(examples, name)) as f:
+                self._validate(json.load(f))
+
+    def test_malformed_resources_rejected(self):
+        good = make_cr()
+        self._validate(good)
+
+        no_predictors = copy.deepcopy(good)
+        no_predictors["spec"]["predictors"] = []
+        with pytest.raises(AssertionError, match="minItems"):
+            self._validate(no_predictors)
+
+        bad_type = copy.deepcopy(good)
+        bad_type["spec"]["predictors"][0]["graph"]["type"] = "FROBNICATOR"
+        with pytest.raises(AssertionError, match="enum"):
+            self._validate(bad_type)
+
+        no_graph = copy.deepcopy(good)
+        del no_graph["spec"]["predictors"][0]["graph"]
+        with pytest.raises(AssertionError, match="missing graph"):
+            self._validate(no_graph)
+
+        neg_replicas = copy.deepcopy(good)
+        neg_replicas["spec"]["predictors"][0]["replicas"] = -1
+        with pytest.raises(AssertionError, match="minimum"):
+            self._validate(neg_replicas)
+
+    def test_deep_graphs_stay_open(self):
+        """Nesting beyond GRAPH_DEPTH is accepted (preserve-unknown-fields),
+        operator-side validate_deployment still checks the full tree."""
+        from seldon_core_tpu.operator.crd_schema import GRAPH_DEPTH
+
+        cr = make_cr()
+        node = cr["spec"]["predictors"][0]["graph"]
+        for i in range(GRAPH_DEPTH + 3):
+            child = {"name": f"n{i}", "type": "MODEL",
+                     "implementation": "SIMPLE_MODEL"}
+            node["children"] = [child]
+            node = child
+        self._validate(cr)
