@@ -173,7 +173,7 @@ def cast_params(params: dict, dtype=jnp.bfloat16) -> dict:
     return jax.tree.map(lambda x: x.astype(dtype), params)
 
 
-def quantize_ffn_params(params: dict) -> dict:
+def quantize_ffn_params(params: dict, mesh=None) -> dict:
     """Replace each block's dense-FFN w1/w2 (and lm_head) with per-channel
     int8 weights (ops/quant.py) for weight-streaming-bound serving.
 
@@ -189,24 +189,41 @@ def quantize_ffn_params(params: dict) -> dict:
     step forces XLA to materialize a copy of the slice before the pallas
     call, which re-adds the HBM traffic quantization removed (measured: the
     stacked layout erased the entire int8 win).  The layer loop indexes the
-    tuple statically instead."""
+    tuple statically instead.
+
+    With ``mesh``, quantized leaves are placed tensor-parallel (Megatron
+    pattern, per-channel scales shard WITH their channels so per-device
+    dequantization is exact): w1 columns + its scales over "tp", w2 rows
+    over "tp" (output scales replicated), lm_head columns + scales over
+    "tp".  ffn_block/_vocab_proj then run the int8 kernel per-device under
+    shard_map with a psum for the row-parallel w2."""
     from seldon_core_tpu.ops.quant import quantize_int8
 
-    def quant_unstacked(w):  # (L, K, N) stacked float -> per-layer tuples
+    def put(x, *spec):
+        if mesh is None:
+            return x
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    def quant_unstacked(w, vspec, sspec):
         qs = [quantize_int8(w[i]) for i in range(w.shape[0])]
         return {
-            "values": tuple(q.values for q in qs),
-            "scales": tuple(q.scales for q in qs),
+            "values": tuple(put(q.values, *vspec) for q in qs),
+            "scales": tuple(put(q.scales, *sspec) for q in qs),
         }
 
     out = dict(params)
     blocks = dict(params["blocks"])
-    for key in ("w1", "w2"):
-        if key in blocks:
-            blocks[key] = quant_unstacked(blocks[key])
+    if "w1" in blocks:
+        # column-parallel: out-channel dim (and its scales) over tp
+        blocks["w1"] = quant_unstacked(blocks["w1"], (None, "tp"), ("tp",))
+        # row-parallel: in-channel dim over tp; per-out-channel scales whole
+        blocks["w2"] = quant_unstacked(blocks["w2"], ("tp", None), (None,))
     out["blocks"] = blocks
     q = quantize_int8(params["lm_head"])
-    out["lm_head"] = {"values": q.values, "scales": q.scales}
+    out["lm_head"] = {
+        "values": put(q.values, None, "tp"),
+        "scales": put(q.scales, "tp"),
+    }
     return out
 
 
@@ -214,17 +231,18 @@ def _has_q8(blocks: dict) -> bool:
     return _is_q8(blocks.get("w1"))
 
 
-def _check_q8_single_chip(params: dict, mesh, pp: int = 1) -> None:
-    """Reject quantized params on any mesh/pipeline path up front: the int8
-    pallas_call cannot be partitioned by GSPMD, and the unstacked per-layer
-    tuples cannot ride lax.scan/pipeline stages — without this check the
-    failure surfaces as an obscure compile/pytree error deep inside XLA."""
-    if mesh is None and pp <= 1:
+def _check_q8_pipeline(params: dict, pp: int) -> None:
+    """Reject quantized params on the PIPELINE path up front: the unstacked
+    per-layer tuples cannot ride pipeline stages — without this check the
+    failure surfaces as an obscure pytree error deep inside XLA.  tp/dp
+    meshes ARE supported (shard-mapped per-device int8 kernels with a psum
+    for the row-parallel w2; quantize with quantize_ffn_params(mesh=...))."""
+    if pp <= 1:
         return
     if _has_q8(params.get("blocks", {})) or _is_q8(params.get("lm_head")):
         raise ValueError(
-            "int8-quantized params are a single-chip serving optimization "
-            "(mesh=None, pp=1); dequantize or shard before meshing"
+            "int8-quantized params cannot ride the pp pipeline (per-layer "
+            "unstacked tuples are not scannable); use pp=1"
         )
 
 
@@ -377,13 +395,25 @@ def ffn_block(p, x, cfg: TransformerConfig, mesh=None):
         y = c(y.reshape(B, L, D), "dp", _seq_axis(cfg), None)
         return x + y, aux
     if _is_q8(p["w1"]):
-        # int8 weight-quantized serving path (single-chip: the pallas call
-        # cannot be partitioned by GSPMD; shard-mapped int8 is future work)
+        # int8 weight-quantized serving path.  Under a mesh the kernel runs
+        # per-device inside shard_map (GSPMD cannot partition through
+        # pallas_call): w1 column-parallel, w2 row-parallel + psum — the
+        # Megatron pattern with int8 compute.
         if mesh is not None:
-            raise ValueError(
-                "int8-quantized FFN weights are a single-chip serving "
-                "optimization; dequantize or shard before meshing"
-            )
+            spec_h = P("dp", None, None)
+            attn_ctx = jax.sharding.get_abstract_mesh()
+            out = jax.shard_map(
+                partial(_q8_ffn_local, dtype=x.dtype),
+                mesh=None if not attn_ctx.empty else mesh,
+                in_specs=(spec_h, P(None, "tp"), P("tp"), P("tp", None),
+                          P(None)),
+                out_specs=spec_h,
+                axis_names={"dp", "tp"},
+                check_vma=False,
+            )(h, p["w1"]["values"], p["w1"]["scales"],
+              p["w2"]["values"], p["w2"]["scales"])
+            out = c(out, "dp", _seq_axis(cfg), None)
+            return x + out, jnp.zeros((), jnp.float32)
         B, L, D = h.shape
         h1 = _q8_matmul(h.reshape(B * L, D), p["w1"], x.dtype)
         h1 = jax.nn.gelu(h1)
@@ -412,7 +442,7 @@ def forward(
 ):
     """Logits [B, L, V] (+ summed MoE aux loss; aux is 0 when pp > 1 — the
     pipeline carries activations only)."""
-    _check_q8_single_chip(params, mesh, pp)
+    _check_q8_pipeline(params, pp)
     c = _constrainer(mesh)
     B, L = input_ids.shape
     x = params["embed"].astype(cfg.dtype)[input_ids]
@@ -465,13 +495,48 @@ def forward(
 
     x = rmsnorm(x, params["ln_f"])
     x = c(x, "dp", None, None)  # gather sequence for the vocab projection
-    logits = _vocab_proj(x, params["lm_head"], cfg)
+    logits = _vocab_proj(x, params["lm_head"], cfg, mesh)
     logits = c(logits, "dp", None, "tp")
     return logits.astype(jnp.float32), aux_total
 
 
-def _vocab_proj(x, lm_head, cfg: TransformerConfig):
+def _q8_ffn_local(h, w1v, w1s, w2v, w2s, dtype):
+    """Per-device int8 FFN shard: local w1 columns → gelu → local w2 rows →
+    psum over tp (row-parallel partial sums).  The dynamic per-row
+    activation quantization of the w2 input runs over the LOCAL hidden
+    shard — same int8 contract, scales just span fewer columns."""
+    from seldon_core_tpu.ops.quant import QuantizedLinear, int8_matmul
+
+    B, L, D = h.shape
+    h1 = int8_matmul(h.reshape(B * L, D), QuantizedLinear(w1v, w1s),
+                     out_dtype=dtype)
+    h1 = jax.nn.gelu(h1)
+    out = int8_matmul(h1, QuantizedLinear(w2v, w2s), out_dtype=jnp.float32)
+    out = jax.lax.psum(out, "tp")
+    return out.astype(dtype).reshape(B, L, D)
+
+
+def _q8_vocab_local(x, v, s, dtype):
+    from seldon_core_tpu.ops.quant import QuantizedLinear, int8_matmul
+
+    B, L, D = x.shape
+    return int8_matmul(x.reshape(B * L, D), QuantizedLinear(v, s),
+                       out_dtype=dtype).reshape(B, L, -1)
+
+
+def _vocab_proj(x, lm_head, cfg: TransformerConfig, mesh=None):
     if _is_q8(lm_head):
+        if mesh is not None:
+            # column-parallel over tp: each device projects its vocab shard
+            ctx = jax.sharding.get_abstract_mesh()
+            return jax.shard_map(
+                partial(_q8_vocab_local, dtype=cfg.dtype),
+                mesh=None if not ctx.empty else mesh,
+                in_specs=(P("dp", None, None), P(None, "tp"), P("tp")),
+                out_specs=P("dp", None, "tp"),
+                axis_names={"dp", "tp"},
+                check_vma=False,
+            )(x, lm_head["values"], lm_head["scales"])
         B, L, D = x.shape
         return _q8_matmul(x.reshape(B * L, D), lm_head, cfg.dtype).reshape(
             B, L, -1
@@ -542,7 +607,6 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
     """One incremental decode step.  token_ids [B]; returns (logits [B, V],
     cache).  Static shapes: attention reads the full cache with a position
     mask (XLA-friendly; no dynamic slices on the length axis)."""
-    _check_q8_single_chip(params, mesh)
     c = _constrainer(mesh)
     B = token_ids.shape[0]
     pos = cache["pos"]                       # [B]
@@ -582,7 +646,7 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
         x, _ = ffn_block(p, x, cfg, mesh)
 
     x = rmsnorm(x, params["ln_f"])
-    logits = _vocab_proj(x, params["lm_head"], cfg)
+    logits = _vocab_proj(x, params["lm_head"], cfg, mesh)
     cache = {
         "k": jnp.stack(new_k_layers),
         "v": jnp.stack(new_v_layers),

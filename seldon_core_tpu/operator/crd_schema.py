@@ -43,8 +43,14 @@ def _endpoint_schema() -> dict:
     return {
         "type": "object",
         "properties": {
+            # both naming styles the parser accepts (graph/spec.py:43-44
+            # takes the reference's protobuf-JSON camelCase too) — a
+            # structural schema PRUNES unlisted fields, so omitting the
+            # aliases would silently drop them at admission
             "service_host": {"type": "string"},
             "service_port": {"type": "integer"},
+            "serviceHost": {"type": "string"},
+            "servicePort": {"type": "integer"},
             "type": {"type": "string", "enum": ["REST", "GRPC", "LOCAL"]},
         },
     }

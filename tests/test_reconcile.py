@@ -292,6 +292,14 @@ class TestCrdValidationSchema:
                         walk(props[k], v, f"{path}.{k}")
                     elif isinstance(addl, dict):
                         walk(addl, v, f"{path}.{k}")
+                    elif path == "$" and k in ("apiVersion", "kind",
+                                               "metadata"):
+                        continue  # implicit in every structural schema
+                    else:
+                        # a real apiserver PRUNES unlisted fields — flag the
+                        # drift so a parser-accepted field the schema omits
+                        # (e.g. the serviceHost alias) cannot ship silently
+                        raise AssertionError(f"{path}.{k}: would be pruned")
             elif t == "array":
                 if not isinstance(val, list):
                     raise AssertionError(f"{path}: not an array")
@@ -358,3 +366,13 @@ class TestCrdValidationSchema:
             node["children"] = [child]
             node = child
         self._validate(cr)
+
+
+def test_endpoint_camelcase_aliases_not_pruned():
+    """graph/spec.py accepts protobuf-JSON camelCase serviceHost/servicePort;
+    the structural schema must list them or the apiserver prunes them."""
+    cr = make_cr()
+    cr["spec"]["predictors"][0]["graph"]["endpoint"] = {
+        "serviceHost": "my-model", "servicePort": 9000, "type": "REST",
+    }
+    TestCrdValidationSchema()._validate(cr)
