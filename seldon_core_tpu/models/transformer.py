@@ -293,6 +293,23 @@ def rope(x, positions, theta: float):
     ).astype(x.dtype)
 
 
+def _partial_manual(fn, mesh, in_specs, out_specs, axis_names):
+    """shard_map with the partial-manual adoption dance used by every
+    per-device kernel call site (ring, flash, q8 ffn/vocab): when already
+    inside another manual region (e.g. the pp pipeline) the context mesh is
+    adopted by passing mesh=None; check_vma off (kernels, not collectives,
+    except explicit psums)."""
+    ctx = jax.sharding.get_abstract_mesh()
+    return jax.shard_map(
+        fn,
+        mesh=None if not ctx.empty else mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        axis_names=axis_names,
+        check_vma=False,
+    )
+
+
 def _constrainer(mesh):
     if mesh is None:
         return lambda a, *s: a
@@ -334,15 +351,10 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
         # spec may not mention it (partial-manual shard_map contract).
         # When nested inside another manual region (the pp pipeline), the
         # context mesh already marks pp Manual — pass mesh=None to adopt it.
-        ctx = jax.sharding.get_abstract_mesh()
         spec = P(None, "tp", None, None)
-        attn = jax.shard_map(
+        attn = _partial_manual(
             partial(ring_attention, axis_name="tp", causal=True),
-            mesh=None if not ctx.empty else mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            axis_names={"tp"},
-            check_vma=False,
+            mesh, (spec, spec, spec), spec, {"tp"},
         )(q, k, v)
     else:
         q = c(q, "dp", None, "tp", None)
@@ -359,15 +371,10 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
                 # manual per-device kernel is exact.  Inside the pp pipeline
                 # the context mesh already marks pp Manual; pass mesh=None to
                 # adopt it (partial-manual shard_map, same as the ring path).
-                ctx = jax.sharding.get_abstract_mesh()
                 spec = P("dp", None, "tp", None)
-                attn = jax.shard_map(
+                attn = _partial_manual(
                     partial(flash_attention, causal=True),
-                    mesh=None if not ctx.empty else mesh,
-                    in_specs=(spec, spec, spec),
-                    out_specs=spec,
-                    axis_names={"dp", "tp"},
-                    check_vma=False,
+                    mesh, (spec, spec, spec), spec, {"dp", "tp"},
                 )(q, k, v)
         else:
             attn = dense_attention(q, k, v, causal=True)
@@ -401,15 +408,12 @@ def ffn_block(p, x, cfg: TransformerConfig, mesh=None):
         # Megatron pattern with int8 compute.
         if mesh is not None:
             spec_h = P("dp", None, None)
-            attn_ctx = jax.sharding.get_abstract_mesh()
-            out = jax.shard_map(
+            out = _partial_manual(
                 partial(_q8_ffn_local, dtype=x.dtype),
-                mesh=None if not attn_ctx.empty else mesh,
-                in_specs=(spec_h, P(None, "tp"), P("tp"), P("tp", None),
-                          P(None)),
-                out_specs=spec_h,
-                axis_names={"dp", "tp"},
-                check_vma=False,
+                mesh,
+                (spec_h, P(None, "tp"), P("tp"), P("tp", None), P(None)),
+                spec_h,
+                {"dp", "tp"},
             )(h, p["w1"]["values"], p["w1"]["scales"],
               p["w2"]["values"], p["w2"]["scales"])
             out = c(out, "dp", _seq_axis(cfg), None)
@@ -528,14 +532,12 @@ def _vocab_proj(x, lm_head, cfg: TransformerConfig, mesh=None):
     if _is_q8(lm_head):
         if mesh is not None:
             # column-parallel over tp: each device projects its vocab shard
-            ctx = jax.sharding.get_abstract_mesh()
-            return jax.shard_map(
+            return _partial_manual(
                 partial(_q8_vocab_local, dtype=cfg.dtype),
-                mesh=None if not ctx.empty else mesh,
-                in_specs=(P("dp", None, None), P(None, "tp"), P("tp")),
-                out_specs=P("dp", None, "tp"),
-                axis_names={"dp", "tp"},
-                check_vma=False,
+                mesh,
+                (P("dp", None, None), P(None, "tp"), P("tp")),
+                P("dp", None, "tp"),
+                {"dp", "tp"},
             )(x, lm_head["values"], lm_head["scales"])
         B, L, D = x.shape
         return _q8_matmul(x.reshape(B * L, D), lm_head, cfg.dtype).reshape(
