@@ -134,11 +134,27 @@ class Contract:
     def generate_batch(
         self, n: int, field_name: str = "features", rng: Optional[np.random.Generator] = None
     ) -> np.ndarray:
-        """(n, total_width) batch over all declared features."""
+        """(n, total_width) batch over all declared features — the
+        reference's flat layout (``generate_batch``).  A contract with
+        exactly ONE multi-dim shaped feature (e.g. an image ``shape:
+        [224, 224, 3]``) keeps its true shape as ``(n, *shape)`` instead;
+        the reference tester predates image servers and had no answer
+        here."""
         rng = rng or np.random.default_rng()
         defs = self.features if field_name == "features" else self.targets
         if not defs:
             raise ValueError(f"contract has no {field_name}")
+        multi = [f for f in defs if f.shape is not None and len(f.shape) > 1]
+        if multi:
+            if len(defs) > 1:
+                raise ValueError(
+                    f"feature {multi[0].name!r} has a multi-dim shape "
+                    f"{multi[0].shape}; it cannot be concatenated with other "
+                    "features into the flat (n, width) layout — declare it "
+                    "as the contract's only feature"
+                )
+            flat = defs[0].sample(rng, n)
+            return flat.reshape(n, *defs[0].shape)
         blocks = [f.sample(rng, n) for f in defs]
         if any(b.dtype == object for b in blocks):
             return np.concatenate([b.astype(object) for b in blocks], axis=1)
@@ -153,7 +169,12 @@ class Contract:
     ) -> dict:
         """SeldonMessage dict (reference ``gen_REST_request``)."""
         batch = self.generate_batch(n, rng=rng)
-        names = self.feature_names()
+        if batch.ndim > 2:
+            # single multi-dim feature (image): ONE name for the tensor —
+            # per-element names would be megabytes of meaningless strings
+            names = [self.features[0].name]
+        else:
+            names = self.feature_names()
         if tensor and batch.dtype != object:
             datadef = {
                 "names": names,
