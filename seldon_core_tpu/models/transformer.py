@@ -61,6 +61,10 @@ class TransformerConfig:
     # Note: "ring" attention cannot nest inside the pp pipeline's manual
     # region (Shardy limitation); use seq_shard+dense with pp, ring when pp=1.
     seq_shard: bool = True
+    # ring attention inner chunking: bound the materialized score tile to
+    # [B, H, Lq, ring_kv_chunk] per ring step (None = whole local block) —
+    # the long-context memory knob (parallel/ring_attention.py)
+    ring_kv_chunk: Optional[int] = None
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
     # Pallas flash-attention kernel (ops/attention.py) on the dense path:
     # O(L) memory, scores never hit HBM.  On sharded meshes the kernel is
@@ -353,7 +357,8 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
         # context mesh already marks pp Manual — pass mesh=None to adopt it.
         spec = P(None, "tp", None, None)
         attn = _partial_manual(
-            partial(ring_attention, axis_name="tp", causal=True),
+            partial(ring_attention, axis_name="tp", causal=True,
+                    kv_chunk=cfg.ring_kv_chunk),
             mesh, (spec, spec, spec), spec, {"tp"},
         )(q, k, v)
     else:

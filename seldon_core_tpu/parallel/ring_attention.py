@@ -43,6 +43,23 @@ def _block_attn(q, k, v, q_off, k_off, causal, scale):
     return s, None
 
 
+def _online_update(o, l, m, s, mask, vc):
+    """One online-softmax accumulation step shared by the whole-block and
+    chunked inner loops.  s: [B,H,Lq,Lk] scaled (masked) scores."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard: rows with no valid key yet keep m == NEG_INF; exp(0)=1 would
+    # poison them, so zero masked contributions explicitly
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr[..., None].transpose(0, 2, 1, 3) + jnp.einsum(
+        "bhlm,bmhd->blhd", p, vc
+    )
+    return o, l, m_new
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -50,11 +67,20 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    kv_chunk: Optional[int] = None,
 ) -> jax.Array:
     """Attention over a sequence sharded on ``axis_name``.
 
     Call inside ``shard_map``.  q/k/v: [B, L_local, H, D] (the local
     sequence shard).  Returns [B, L_local, H, D] in q.dtype.
+
+    ``kv_chunk`` bounds the materialized score tile: without it each ring
+    step builds the full [B, H, Lq, Lk] block (O(L_local^2) per device —
+    fine at moderate shards, the dominant allocation at long ones); with it
+    the K/V block held this ring step is processed in chunks of that many
+    keys via an inner ``lax.fori_loop`` carrying the same online-softmax
+    stats, so peak memory per step is [B, H, Lq, kv_chunk].  Must divide
+    the local shard length.  Exactness is independent of chunking (tested).
     """
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -62,6 +88,11 @@ def ring_attention(
     Lk = k.shape[1]
     if scale is None:
         scale = D ** -0.5
+    if kv_chunk is not None and (kv_chunk <= 0 or Lk % kv_chunk):
+        raise ValueError(
+            f"kv_chunk {kv_chunk} must be positive and divide the local "
+            f"length {Lk}"
+        )
     qf = q.astype(jnp.float32)
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
@@ -72,25 +103,32 @@ def ring_attention(
     def body(t, carry):
         o, l, m, kc, vc = carry
         src = (rank - t) % n  # origin rank of the kv block currently held
-        s, mask = _block_attn(
-            qf, kc.astype(jnp.float32), vc.astype(jnp.float32),
-            rank * Lq, src * Lk, causal, scale,
-        )
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # guard: rows with no valid key yet keep m == NEG_INF; exp(0)=1 would
-        # poison them, so zero masked contributions explicitly
-        p = jnp.exp(s - m_new[..., None])
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None].transpose(0, 2, 1, 3) + jnp.einsum(
-            "bhlm,bmhd->blhd", p, vc.astype(jnp.float32)
-        )
+        if kv_chunk is None or kv_chunk >= Lk:
+            kf, vf = kc.astype(jnp.float32), vc.astype(jnp.float32)
+            s, mask = _block_attn(qf, kf, vf, rank * Lq, src * Lk, causal,
+                                  scale)
+            o, l, m = _online_update(o, l, m, s, mask, vf)
+        else:
+            def chunk_body(ci, inner):
+                o, l, m = inner
+                off = ci * kv_chunk
+                # slice FIRST, upcast the slice: casting the whole block to
+                # f32 before the loop would keep two block-sized f32 copies
+                # live across every chunk, defeating the memory bound the
+                # knob exists for
+                kck = lax.dynamic_slice_in_dim(kc, off, kv_chunk,
+                                               axis=1).astype(jnp.float32)
+                vck = lax.dynamic_slice_in_dim(vc, off, kv_chunk,
+                                               axis=1).astype(jnp.float32)
+                s, mask = _block_attn(qf, kck, vck, rank * Lq,
+                                      src * Lk + off, causal, scale)
+                return _online_update(o, l, m, s, mask, vck)
+
+            o, l, m = lax.fori_loop(0, Lk // kv_chunk, chunk_body, (o, l, m))
         # rotate kv to the next rank (final rotation restores original owner)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (o, l, m_new, kc, vc)
+        return (o, l, m, kc, vc)
 
     o, l, m, _, _ = lax.fori_loop(0, n, body, (o0, l0, m0, k, v))
     l = jnp.maximum(l, 1e-30)
@@ -106,14 +144,17 @@ def ring_attention_sharded(
     axis: str = "tp",
     causal: bool = True,
     batch_axis: Optional[str] = "dp",
+    kv_chunk: Optional[int] = None,
 ):
     """shard_map wrapper: q/k/v are global [B, L, H, D]; L sharded on
-    ``axis`` (and optionally B on ``batch_axis`` if the mesh has it)."""
+    ``axis`` (and optionally B on ``batch_axis`` if the mesh has it).
+    ``kv_chunk`` bounds per-step score-tile memory (see ring_attention)."""
     from jax.sharding import PartitionSpec as P
 
     b = batch_axis if batch_axis and batch_axis in mesh.axis_names else None
     spec = P(b, axis, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
+    fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
+                           kv_chunk=kv_chunk)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)
