@@ -243,14 +243,14 @@ def bench_llm_decode(batch: int = 8, n_layers: int = 4, d_model: int = 4096,
         quantize_ffn_params,
     )
 
-    cfg = TransformerConfig(
-        vocab_size=32000, d_model=d_model, n_layers=n_layers,
-        n_heads=d_model // 128, d_ff=4 * d_model, max_seq=512,
-        dtype=jnp.bfloat16,
-    )
-    params = cast_params(init_params(jax.random.PRNGKey(0), cfg))
+    def make_cfg(n_kv_heads=None):
+        return TransformerConfig(
+            vocab_size=32000, d_model=d_model, n_layers=n_layers,
+            n_heads=d_model // 128, n_kv_heads=n_kv_heads,
+            d_ff=4 * d_model, max_seq=512, dtype=jnp.bfloat16,
+        )
 
-    def run(p) -> float:
+    def run(p, cfg) -> float:
         def decode_n(p, cache, tok, n):
             def body(i, carry):
                 cache, tok = carry
@@ -277,14 +277,24 @@ def bench_llm_decode(batch: int = 8, n_layers: int = 4, d_model: int = 4096,
         dt = max((timed(n_steps + 1) - timed(1)) / n_steps, 1e-6)
         return batch / dt  # tokens/s across the batch
 
-    bf16_tps = run(params)
-    int8_tps = run(quantize_ffn_params(params))
+    cfg = make_cfg()
+    params = cast_params(init_params(jax.random.PRNGKey(0), cfg))
+    bf16_tps = run(params, cfg)
+    int8_tps = run(quantize_ffn_params(params), cfg)
+    # GQA: kv heads = H/4 — 4x smaller cache + wk/wv, grouped attention
+    # straight off the compact cache
+    cfg_gqa = make_cfg(n_kv_heads=(d_model // 128) // 4)
+    gqa_tps = run(
+        cast_params(init_params(jax.random.PRNGKey(0), cfg_gqa)), cfg_gqa
+    )
     return {
         "batch": batch,
         "model": f"L{n_layers} d{d_model}",
         "bf16_tokens_per_s": round(bf16_tps),
         "int8_ffn_tokens_per_s": round(int8_tps),
         "int8_speedup": round(int8_tps / bf16_tps, 2),
+        "gqa4_tokens_per_s": round(gqa_tps),
+        "gqa4_speedup": round(gqa_tps / bf16_tps, 2),
     }
 
 

@@ -54,6 +54,12 @@ class TransformerConfig:
     capacity_factor: float = 2.0
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16   # activation dtype
+    # grouped-query attention: K/V heads (None = n_heads, plain MHA).
+    # The serving win is the KV cache and wk/wv weights shrinking by
+    # n_heads/n_kv_heads — at decode the cache is THE memory/bandwidth
+    # bottleneck.  Q heads are grouped onto shared K/V heads; scores are
+    # computed at full head count (K/V broadcast per group).
+    n_kv_heads: Optional[int] = None
     attention: str = "dense"    # "dense" (tp over heads) | "ring" (sp over seq)
     # Megatron-style sequence parallelism: residual stream + norms are
     # sequence-sharded over "tp"; XLA inserts all-gather before qkv/mlp
@@ -76,6 +82,15 @@ class TransformerConfig:
     @property
     def d_head(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        h = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if self.n_heads % h:
+            raise ValueError(
+                f"n_heads {self.n_heads} must be a multiple of n_kv_heads {h}"
+            )
+        return h
 
     def moe_cfg(self) -> MoEConfig:
         return MoEConfig(
@@ -100,12 +115,13 @@ def init_params(key, cfg: TransformerConfig) -> dict:
 
     def block_init(k):
         ks = jax.random.split(k, 8)
+        Hk = cfg.kv_heads  # GQA: K/V projections at the reduced head count
         p = {
             "ln1": jnp.ones((D,), jnp.float32),
             "ln2": jnp.ones((D,), jnp.float32),
             "wq": jax.random.normal(ks[0], (D, H, Dh), jnp.float32) * s,
-            "wk": jax.random.normal(ks[1], (D, H, Dh), jnp.float32) * s,
-            "wv": jax.random.normal(ks[2], (D, H, Dh), jnp.float32) * s,
+            "wk": jax.random.normal(ks[1], (D, Hk, Dh), jnp.float32) * s,
+            "wv": jax.random.normal(ks[2], (D, Hk, Dh), jnp.float32) * s,
             "wo": jax.random.normal(ks[3], (H, Dh, D), jnp.float32) * s,
         }
         if cfg.n_experts > 0:
@@ -155,6 +171,13 @@ def param_specs(cfg: TransformerConfig, pp: int = 1) -> dict:
 
 
 def shard_params(params: dict, mesh, cfg: TransformerConfig, pp: int = 1) -> dict:
+    tp = mesh.shape.get("tp", 1)
+    if cfg.kv_heads % tp:
+        raise ValueError(
+            f"n_kv_heads {cfg.kv_heads} must be divisible by tp {tp}: wk/wv "
+            "shard their head dim over 'tp' (KV-head replication across tp "
+            "is not implemented — lower tp or raise n_kv_heads)"
+        )
     specs = param_specs(cfg, pp)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
@@ -314,6 +337,15 @@ def _partial_manual(fn, mesh, in_specs, out_specs, axis_names):
     )
 
 
+def _expand_kv(kv, cfg: TransformerConfig):
+    """GQA: broadcast K/V heads to the full query-head count (group size
+    n_heads // kv_heads); identity for plain MHA."""
+    g = cfg.n_heads // cfg.kv_heads
+    if g == 1:
+        return kv
+    return jnp.repeat(kv, g, axis=2)
+
+
 def _constrainer(mesh):
     if mesh is None:
         return lambda a, *s: a
@@ -347,10 +379,16 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
     k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(x.dtype))
     v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(x.dtype))
     q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
+    kv_cache = (k, v)  # pre-expansion: the KV cache stores kv_heads only
+    k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
     if return_kv and cfg.attention == "ring":
         raise ValueError("return_kv is unsupported with ring attention "
                          "(sequence-sharded K/V has no whole-sequence cache)")
     if cfg.attention == "ring" and mesh is not None and mesh.shape.get("tp", 1) > 1:
+        # un-expand for the ring: rotating compact [B,L,Hk,D] blocks moves
+        # g-times fewer bytes per ppermute and holds g-times smaller blocks
+        # per device; ring_attention expands per step via n_rep
+        k, v = kv_cache
         # manual only over tp (sequence axis); dp stays GSPMD-managed, so the
         # spec may not mention it (partial-manual shard_map contract).
         # When nested inside another manual region (the pp pipeline), the
@@ -358,7 +396,8 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
         spec = P(None, "tp", None, None)
         attn = _partial_manual(
             partial(ring_attention, axis_name="tp", causal=True,
-                    kv_chunk=cfg.ring_kv_chunk),
+                    kv_chunk=cfg.ring_kv_chunk,
+                    n_rep=cfg.n_heads // cfg.kv_heads),
             mesh, (spec, spec, spec), spec, {"tp"},
         )(q, k, v)
     else:
@@ -387,7 +426,7 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
     # SP: reduce-scatter the row-parallel output back to sequence shards
     out = c(out, "dp", _seq_axis(cfg) if cfg.attention != "ring" else None, None)
     if return_kv:
-        return x + out, (k, v)
+        return x + out, kv_cache
     return x + out
 
 
@@ -601,8 +640,10 @@ def make_train_step(cfg: TransformerConfig, mesh=None, pp: int = 1,
 # ----------------------------------------------------------------------
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None):
+    """KV cache: (layers, B, T, kv_heads, d_head) — GQA shrinks it by
+    n_heads/kv_heads, the decode memory/bandwidth win."""
     max_len = max_len or cfg.max_seq
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.d_head)
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.d_head)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -642,12 +683,19 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
         )(cache["v"][i], v, pos)
         new_k_layers.append(kc)
         new_v_layers.append(vc)
-        s = jnp.einsum("blhk,bmhk->bhlm", q, kc,
+        # grouped attention DIRECTLY against the compact cache: expanding
+        # kc/vc to full heads would materialize a g-times K/V copy per step,
+        # negating the bandwidth win the compact cache exists for
+        g = cfg.n_heads // cfg.kv_heads
+        Bq, Lq = q.shape[0], q.shape[1]
+        qg = q.reshape(Bq, Lq, cfg.kv_heads, g, cfg.d_head)
+        s = jnp.einsum("blhgk,bmhk->bhglm", qg, kc,
                        preferred_element_type=jnp.float32) * (cfg.d_head ** -0.5)
-        valid = (jnp.arange(T)[None, :] <= pos[:, None])[:, None, None, :]
+        valid = (jnp.arange(T)[None, :] <= pos[:, None])[:, None, None, None, :]
         s = jnp.where(valid, s, -1e30)
         a = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bhlm,bmhk->blhk", a, vc.astype(a.dtype))
+        attn = jnp.einsum("bhglm,bmhk->blhgk", a, vc.astype(a.dtype))
+        attn = attn.reshape(Bq, Lq, cfg.n_heads, cfg.d_head)
         x = x + jnp.einsum("blhk,hkd->bld", attn.astype(x.dtype),
                            p["wo"].astype(x.dtype))
         x, _ = ffn_block(p, x, cfg, mesh)

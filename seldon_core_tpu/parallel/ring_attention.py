@@ -43,6 +43,14 @@ def _block_attn(q, k, v, q_off, k_off, causal, scale):
     return s, None
 
 
+def _rep(kv, n_rep: int):
+    """GQA broadcast to full heads (f32), transient per step/chunk."""
+    kv = kv.astype(jnp.float32)
+    if n_rep == 1:
+        return kv
+    return jnp.repeat(kv, n_rep, axis=2)
+
+
 def _online_update(o, l, m, s, mask, vc):
     """One online-softmax accumulation step shared by the whole-block and
     chunked inner loops.  s: [B,H,Lq,Lk] scaled (masked) scores."""
@@ -68,6 +76,7 @@ def ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     kv_chunk: Optional[int] = None,
+    n_rep: int = 1,
 ) -> jax.Array:
     """Attention over a sequence sharded on ``axis_name``.
 
@@ -81,11 +90,20 @@ def ring_attention(
     keys via an inner ``lax.fori_loop`` carrying the same online-softmax
     stats, so peak memory per step is [B, H, Lq, kv_chunk].  Must divide
     the local shard length.  Exactness is independent of chunking (tested).
+
+    ``n_rep`` (GQA): k/v carry ``H_q / n_rep`` heads; they rotate the ring
+    COMPACT (n_rep-times fewer bytes per ppermute, n_rep-times smaller
+    resident blocks) and are broadcast to the full head count only
+    transiently per step/chunk.
     """
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
+    if n_rep > 1 and k.shape[2] * n_rep != H:
+        raise ValueError(
+            f"n_rep {n_rep} * kv heads {k.shape[2]} != q heads {H}"
+        )
     if scale is None:
         scale = D ** -0.5
     if kv_chunk is not None and (kv_chunk <= 0 or Lk % kv_chunk):
@@ -104,7 +122,7 @@ def ring_attention(
         o, l, m, kc, vc = carry
         src = (rank - t) % n  # origin rank of the kv block currently held
         if kv_chunk is None or kv_chunk >= Lk:
-            kf, vf = kc.astype(jnp.float32), vc.astype(jnp.float32)
+            kf, vf = _rep(kc, n_rep), _rep(vc, n_rep)
             s, mask = _block_attn(qf, kf, vf, rank * Lq, src * Lk, causal,
                                   scale)
             o, l, m = _online_update(o, l, m, s, mask, vf)
@@ -116,10 +134,10 @@ def ring_attention(
                 # f32 before the loop would keep two block-sized f32 copies
                 # live across every chunk, defeating the memory bound the
                 # knob exists for
-                kck = lax.dynamic_slice_in_dim(kc, off, kv_chunk,
-                                               axis=1).astype(jnp.float32)
-                vck = lax.dynamic_slice_in_dim(vc, off, kv_chunk,
-                                               axis=1).astype(jnp.float32)
+                kck = _rep(lax.dynamic_slice_in_dim(kc, off, kv_chunk,
+                                                    axis=1), n_rep)
+                vck = _rep(lax.dynamic_slice_in_dim(vc, off, kv_chunk,
+                                                    axis=1), n_rep)
                 s, mask = _block_attn(qf, kck, vck, rank * Lq,
                                       src * Lk + off, causal, scale)
                 return _online_update(o, l, m, s, mask, vck)

@@ -258,3 +258,87 @@ def test_generate_greedy_deterministic():
     out2 = generate(params, prompt, 5, TINY)
     assert out1.shape == (2, 9)
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+class TestGQA:
+    """Grouped-query attention: KV cache and wk/wv shrink by
+    n_heads/n_kv_heads — the decode memory/bandwidth win."""
+
+    def _cfg(self, kvh):
+        return TransformerConfig(**{**TINY.__dict__, "n_kv_heads": kvh})
+
+    @pytest.mark.parametrize("kvh", [2, 1])
+    def test_decode_matches_forward(self, kvh):
+        cfg = self._cfg(kvh)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = tiny_batch(B=2, L=8)["input_ids"]
+        ref, _ = forward(params, ids, cfg)
+        cache = init_cache(cfg, 2, max_len=8)
+        assert cache["k"].shape[3] == kvh  # the cache win
+        logits = None
+        for t in range(8):
+            logits, cache = decode_step(params, cache, ids[:, t], cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, -1]), atol=1e-4
+        )
+
+    def test_prefill_cache_matches_decode(self):
+        from seldon_core_tpu.models.transformer import prefill
+
+        cfg = self._cfg(2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = tiny_batch(B=2, L=8)["input_ids"]
+        cache = init_cache(cfg, 2, max_len=8)
+        for t in range(8):
+            _, cache = decode_step(params, cache, ids[:, t], cfg)
+        _, cpf = prefill(params, ids, cfg, max_len=8)
+        np.testing.assert_allclose(
+            np.asarray(cpf["k"]), np.asarray(cache["k"]), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(cpf["v"]), np.asarray(cache["v"]), atol=1e-4
+        )
+
+    def test_sharded_forward_matches_unsharded(self):
+        mesh = make_mesh(n_devices=8, tp=2, pp=1)  # kv_heads=2 divides tp=2
+        cfg = self._cfg(2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = tiny_batch()["input_ids"]
+        ref, _ = forward(params, ids, cfg)
+        p_sh = shard_params(params, mesh, cfg)
+        out = jax.jit(lambda p, i: forward(p, i, cfg, mesh=mesh)[0])(p_sh, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_generate_deterministic(self):
+        cfg = self._cfg(1)  # MQA extreme
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = tiny_batch(B=2, L=4)["input_ids"][:, :4]
+        out1 = generate(params, prompt, 5, cfg)
+        out2 = generate(params, prompt, 5, cfg)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_invalid_grouping_rejected(self):
+        with pytest.raises(ValueError, match="multiple"):
+            TransformerConfig(n_heads=4, n_kv_heads=3).kv_heads
+
+    def test_gqa_ring_composition_matches_dense(self):
+        """GQA + ring attention: compact K/V blocks rotate the ring
+        (g-times fewer ppermute bytes) and expand only per step."""
+        mesh = make_mesh(n_devices=8, tp=2, pp=1)
+        cfg = TransformerConfig(**{**TINY.__dict__, "n_kv_heads": 2,
+                                   "attention": "ring"})
+        cfg_d = self._cfg(2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = tiny_batch()["input_ids"]
+        ref, _ = forward(params, ids, cfg_d)
+        p_sh = shard_params(params, mesh, cfg)
+        out = jax.jit(lambda p, i: forward(p, i, cfg, mesh=mesh)[0])(p_sh, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_kv_heads_below_tp_rejected_up_front(self):
+        mesh = make_mesh(n_devices=8, tp=4, pp=1)
+        cfg = self._cfg(1)  # MQA with tp=4: head dim unshardable
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            shard_params(params, mesh, cfg)
