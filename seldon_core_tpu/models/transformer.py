@@ -30,6 +30,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from seldon_core_tpu.parallel.moe import (
@@ -652,14 +653,28 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None
 
 
 def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
-    """One incremental decode step.  token_ids [B]; returns (logits [B, V],
-    cache).  Static shapes: attention reads the full cache with a position
-    mask (XLA-friendly; no dynamic slices on the length axis)."""
-    c = _constrainer(mesh)
-    B = token_ids.shape[0]
+    """Incremental decode.  token_ids [B] (one step → logits [B, V]) or
+    [B, K] (a K-token chunk in ONE device call → logits [B, K, V] — the
+    verification primitive for speculative decoding).  Static shapes:
+    attention reads the full cache with a position mask per query
+    (XLA-friendly; no dynamic slices on the length axis).  Advances
+    ``cache['pos']`` by K; REWINDING is just setting pos lower — rows past
+    pos are masked and later overwritten, which is what makes speculative
+    rejection free."""
+    single = token_ids.ndim == 1
+    if single:
+        token_ids = token_ids[:, None]
+    B, K = token_ids.shape
+    T_cache = cache["k"].shape[2]
+    if K > T_cache:
+        # pos + K beyond the cache would make dynamic_update_slice CLAMP
+        # the start row and silently overwrite earlier positions' K/V;
+        # the static check catches the cases knowable at trace time, the
+        # runtime contract (pos + K <= T) is documented above
+        raise ValueError(f"chunk of {K} tokens exceeds cache length {T_cache}")
     pos = cache["pos"]                       # [B]
-    x = params["embed"].astype(cfg.dtype)[token_ids][:, None, :]  # [B,1,D]
-    positions = pos[:, None]
+    x = params["embed"].astype(cfg.dtype)[token_ids]  # [B,K,D]
+    positions = pos[:, None] + jnp.arange(K)[None, :]
 
     new_k_layers, new_v_layers = [], []
     T = cache["k"].shape[2]
@@ -687,27 +702,29 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
         # kc/vc to full heads would materialize a g-times K/V copy per step,
         # negating the bandwidth win the compact cache exists for
         g = cfg.n_heads // cfg.kv_heads
-        Bq, Lq = q.shape[0], q.shape[1]
-        qg = q.reshape(Bq, Lq, cfg.kv_heads, g, cfg.d_head)
+        qg = q.reshape(B, K, cfg.kv_heads, g, cfg.d_head)
         s = jnp.einsum("blhgk,bmhk->bhglm", qg, kc,
                        preferred_element_type=jnp.float32) * (cfg.d_head ** -0.5)
-        valid = (jnp.arange(T)[None, :] <= pos[:, None])[:, None, None, None, :]
+        # per-query mask: query l (global position pos+l) sees keys <= pos+l
+        valid = (
+            jnp.arange(T)[None, None, :] <= positions[:, :, None]
+        )[:, None, None, :, :]  # (B,1,1,K,T)
         s = jnp.where(valid, s, -1e30)
         a = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bhglm,bmhk->blhgk", a, vc.astype(a.dtype))
-        attn = attn.reshape(Bq, Lq, cfg.n_heads, cfg.d_head)
+        attn = attn.reshape(B, K, cfg.n_heads, cfg.d_head)
         x = x + jnp.einsum("blhk,hkd->bld", attn.astype(x.dtype),
                            p["wo"].astype(x.dtype))
         x, _ = ffn_block(p, x, cfg, mesh)
 
     x = rmsnorm(x, params["ln_f"])
-    logits = _vocab_proj(x, params["lm_head"], cfg, mesh)
+    logits = _vocab_proj(x, params["lm_head"], cfg, mesh).astype(jnp.float32)
     cache = {
         "k": jnp.stack(new_k_layers),
         "v": jnp.stack(new_v_layers),
-        "pos": pos + 1,
+        "pos": pos + K,
     }
-    return logits[:, 0, :].astype(jnp.float32), cache
+    return (logits[:, 0, :] if single else logits), cache
 
 
 def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
@@ -771,6 +788,83 @@ def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
         "pos": jnp.full((B,), L, jnp.int32),
     }
     return logits, cache
+
+
+def speculative_generate(
+    params: dict,
+    draft_params: dict,
+    prompt_ids,
+    n_new: int,
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    k_draft: int = 4,
+):
+    """Greedy speculative decoding: a cheap draft model proposes ``k_draft``
+    tokens, the target verifies them in ONE K-token decode_step, and the
+    longest agreeing prefix is accepted plus the target's correction — so
+    each target device call yields 1..k_draft+1 tokens instead of 1.
+
+    Output is EXACTLY the target's own greedy decode (tested): verification
+    compares argmaxes, so acceptance never changes the distribution.
+    Rejection costs nothing: the pos-masked static cache "rewinds" by just
+    setting ``pos`` back — stale rows are masked and later overwritten.
+
+    Returns ``(ids [1, L0+n_new], stats)`` with stats = {"rounds",
+    "accept_rate"} (mean accepted drafts per round / k_draft).
+    """
+    B, L0 = prompt_ids.shape
+    if B != 1:
+        raise ValueError("speculative_generate is per-request (B=1); batch "
+                         "via the serving engine")
+    if n_new <= 0:
+        return prompt_ids, {"rounds": 0, "accept_rate": 0.0}
+    max_len = L0 + n_new + k_draft + 1
+    t_fill = jax.jit(partial(prefill, cfg=cfg, max_len=max_len,
+                             logit_pos=L0 - 1))
+    d_fill = jax.jit(partial(prefill, cfg=draft_cfg, max_len=max_len,
+                             logit_pos=L0 - 1))
+    d_step = jax.jit(partial(decode_step, cfg=draft_cfg))
+    t_verify = jax.jit(partial(decode_step, cfg=cfg))
+
+    t_logits, t_cache = t_fill(params, prompt_ids)
+    _, d_cache = d_fill(draft_params, prompt_ids)
+    out = [int(jnp.argmax(t_logits[0]))]
+    rounds, accepted_total = 0, 0
+    while len(out) < n_new:
+        cur = out[-1]
+        # draft proposes k tokens greedily from its own cache
+        d_tokens = []
+        tok = jnp.array([cur], jnp.int32)
+        for _ in range(k_draft):
+            dl, d_cache = d_step(draft_params, d_cache, tok)
+            tok = jnp.argmax(dl, -1).astype(jnp.int32)
+            d_tokens.append(int(tok[0]))
+        # target scores [cur, d_0..d_{k-1}] in one K-token call
+        vtokens = jnp.array([[cur] + d_tokens], jnp.int32)
+        vlogits, t_cache = t_verify(params, t_cache, vtokens)
+        tgt = np.argmax(np.asarray(vlogits[0]), axis=-1).tolist()
+        n_acc = 0
+        while n_acc < k_draft and d_tokens[n_acc] == tgt[n_acc]:
+            n_acc += 1
+        out.extend(d_tokens[:n_acc] + [tgt[n_acc]])
+        rounds += 1
+        accepted_total += n_acc
+        # rewind both caches to "everything before the newest token
+        # processed": stale rows past pos are masked, so this is free
+        new_pos = L0 + len(out) - 1
+        t_cache = {**t_cache,
+                   "pos": jnp.full_like(t_cache["pos"], new_pos)}
+        d_cache = {**d_cache,
+                   "pos": jnp.full_like(d_cache["pos"], new_pos)}
+    out = out[:n_new]
+    ids = jnp.concatenate(
+        [prompt_ids, jnp.asarray(out, jnp.int32)[None, :]], axis=1
+    )
+    stats = {
+        "rounds": rounds,
+        "accept_rate": (accepted_total / (rounds * k_draft)) if rounds else 0.0,
+    }
+    return ids, stats
 
 
 def generate(params, prompt_ids, n_new: int, cfg: TransformerConfig,
