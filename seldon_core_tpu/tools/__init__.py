@@ -6,10 +6,13 @@
   (reference ``util/api_tester/api-tester.py``).
 - :mod:`seldon_core_tpu.tools.loadtest` — async socket load harness over
   REST/gRPC/framed (reference ``util/loadtester`` locust scripts).
+- :mod:`seldon_core_tpu.tools.chaos` — fault injection for graph components
+  (no reference counterpart — SURVEY.md §5.3 notes its absence).
 
 CLI: ``python -m seldon_core_tpu.tools {contract-test,api-test,load}``.
 """
 
+from seldon_core_tpu.tools.chaos import ChaosError, ChaosPolicy, ChaosWrapper
 from seldon_core_tpu.tools.contract import Contract, FeatureDef, validate_response
 from seldon_core_tpu.tools.loadtest import (
     FramedDriver,
@@ -22,6 +25,9 @@ from seldon_core_tpu.tools.loadtest import (
 from seldon_core_tpu.tools.tester import TestReport, test_api, test_component
 
 __all__ = [
+    "ChaosError",
+    "ChaosPolicy",
+    "ChaosWrapper",
     "Contract",
     "FeatureDef",
     "validate_response",

@@ -1,0 +1,135 @@
+"""Fault injection for inference graphs.
+
+The reference has NO fault-injection tooling (SURVEY.md §5.3: probes and
+rolling updates only).  Serving graphs fail in production through slow or
+flaky components; this module wraps any graph node implementation with
+injected latency / errors / payload corruption so graph-level behavior
+(status propagation, batcher shedding, gateway retries, MAB reward flow)
+can be tested deterministically.
+
+Usage (tests or a staging deployment)::
+
+    from seldon_core_tpu.tools.chaos import ChaosWrapper, ChaosPolicy
+
+    flaky = ChaosWrapper(real_component, ChaosPolicy(
+        error_rate=0.2, latency_ms=50.0, seed=0))
+    engine = GraphEngine(spec, resolver=lambda u: flaky)
+
+Policies are deterministic under ``seed`` — a failing sequence reproduces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from seldon_core_tpu.runtime.component import SeldonComponentError
+from seldon_core_tpu.utils import maybe_await
+
+__all__ = ["ChaosPolicy", "ChaosWrapper", "ChaosError"]
+
+
+@dataclass
+class ChaosPolicy:
+    # probability a call raises ChaosError (surfaces as FAILURE status /
+    # HTTP 500 through the standard error path)
+    error_rate: float = 0.0
+    # fixed injected latency per call
+    latency_ms: float = 0.0
+    # extra uniform jitter on top of latency_ms
+    jitter_ms: float = 0.0
+    # probability a call hangs for hang_ms (timeout / deadline testing)
+    hang_rate: float = 0.0
+    hang_ms: float = 1000.0
+    # apply faults only to these methods (None = all)
+    methods: Optional[set] = None
+    seed: Optional[int] = None
+
+
+class ChaosError(SeldonComponentError):
+    """Injected failure: rides the standard component-error path, so the
+    graph engine wires it as a FAILURE status with this reason."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status_code=503, reason="CHAOS_INJECTED")
+
+
+class ChaosWrapper:
+    """Wraps a component implementation (sync or async methods) with a
+    :class:`ChaosPolicy`.  Exposes the same duck-type surface the engine
+    resolves (``has``/``predict``/``route``/``aggregate``/transforms/
+    ``send_feedback``) and counts injections for assertions."""
+
+    _METHODS = ("predict", "route", "aggregate", "transform_input",
+                "transform_output", "send_feedback")
+
+    def __init__(self, inner: Any, policy: ChaosPolicy):
+        self.inner = inner
+        self.policy = policy
+        self._rng = random.Random(policy.seed)
+        self.injected_errors = 0
+        self.injected_delays = 0
+        self.calls = 0
+        self.name = getattr(inner, "name", type(inner).__name__)
+
+    def has(self, method: str) -> bool:
+        inner_has = getattr(self.inner, "has", None)
+        if callable(inner_has):
+            return inner_has(method)
+        return callable(getattr(self.inner, method, None))
+
+    def _armed(self, method: str) -> bool:
+        m = self.policy.methods
+        return m is None or method in m
+
+    async def _call(self, method: str, *args):
+        self.calls += 1
+        pol = self.policy
+        if self._armed(method):
+            # ALL RNG draws happen synchronously BEFORE the first await:
+            # drawing after a sleep would order draws by coroutine wakeup,
+            # breaking the seeded-reproducibility contract under
+            # concurrency (the module's main use case)
+            hang = bool(pol.hang_rate and self._rng.random() < pol.hang_rate)
+            jitter = self._rng.random() if pol.jitter_ms else 0.0
+            fail = bool(pol.error_rate
+                        and self._rng.random() < pol.error_rate)
+            if hang:
+                self.injected_delays += 1
+                await asyncio.sleep(pol.hang_ms / 1000.0)
+            elif pol.latency_ms or pol.jitter_ms:
+                self.injected_delays += 1
+                await asyncio.sleep(
+                    (pol.latency_ms + jitter * pol.jitter_ms) / 1000.0
+                )
+            if fail:
+                self.injected_errors += 1
+                raise ChaosError(
+                    f"chaos: injected failure in {self.name}.{method} "
+                    f"(call #{self.calls})"
+                )
+        return await maybe_await(getattr(self.inner, method)(*args))
+
+    # -- duck-type surface ----------------------------------------------
+    async def predict(self, msg):
+        return await self._call("predict", msg)
+
+    async def route(self, msg):
+        return await self._call("route", msg)
+
+    async def aggregate(self, msgs):
+        return await self._call("aggregate", msgs)
+
+    async def transform_input(self, msg):
+        return await self._call("transform_input", msg)
+
+    async def transform_output(self, msg):
+        return await self._call("transform_output", msg)
+
+    async def send_feedback(self, fb):
+        return await self._call("send_feedback", fb)
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

@@ -1,0 +1,146 @@
+"""Fault injection (tools/chaos.py) — graph-level failure behavior under
+injected component faults.  The reference has no fault-injection tooling
+(SURVEY.md §5.3); these tests are the framework's failure contract."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.component import ComponentHandle
+from seldon_core_tpu.tools.chaos import ChaosError, ChaosPolicy, ChaosWrapper
+
+
+class Identity:
+    def predict(self, X, names):
+        return X
+
+
+def wrap(policy, user=None):
+    return ChaosWrapper(
+        ComponentHandle(user or Identity(), name="m"), policy
+    )
+
+
+def engine_with(wrapper):
+    return GraphEngine({"name": "m", "type": "MODEL"},
+                       resolver=lambda u: wrapper)
+
+
+def run_predict(eng, x=None):
+    msg = SeldonMessage.from_ndarray(
+        np.asarray(x if x is not None else [[1.0, 2.0]], np.float32)
+    )
+    return asyncio.run(eng.predict(msg))
+
+
+def test_injected_error_becomes_failure_status():
+    """A chaos failure must surface as a wire-level FAILURE with the chaos
+    reason — never a hung request or raw exception."""
+    w = wrap(ChaosPolicy(error_rate=1.0, seed=0))
+    out = run_predict(engine_with(w))
+    assert out.status is not None
+    assert out.status.status == "FAILURE"
+    assert out.status.code == 503
+    assert out.status.reason == "CHAOS_INJECTED"
+    assert w.injected_errors == 1
+
+
+def test_error_rate_is_deterministic_under_seed():
+    def outcomes(seed):
+        eng = engine_with(wrap(ChaosPolicy(error_rate=0.5, seed=seed)))
+        out = []
+        for _ in range(20):
+            res = run_predict(eng)
+            out.append(res.status.status if res.status else "SUCCESS")
+        return out
+
+    a, b = outcomes(42), outcomes(42)
+    assert a == b  # reproducible failure sequences
+    assert "FAILURE" in a and "SUCCESS" in a
+
+
+def test_latency_injection_delays_the_call():
+    w = wrap(ChaosPolicy(latency_ms=80.0, seed=0))
+    eng = engine_with(w)
+    t0 = time.perf_counter()
+    out = run_predict(eng)
+    dt = time.perf_counter() - t0
+    assert out.status is None or out.status.status == "SUCCESS"
+    assert dt >= 0.07
+    assert w.injected_delays == 1
+
+
+def test_methods_filter_scopes_faults():
+    """Faults armed only for send_feedback must leave predict untouched."""
+    class Learner(Identity):
+        def send_feedback(self, request, names, reward, truth, routing=None):
+            pass
+
+    w = wrap(ChaosPolicy(error_rate=1.0, methods={"send_feedback"}, seed=0),
+             user=Learner())
+    eng = engine_with(w)
+    out = run_predict(eng)
+    assert out.status is None or out.status.status == "SUCCESS"
+    from seldon_core_tpu.messages import Feedback
+
+    fb = Feedback(request=SeldonMessage.from_ndarray(
+        np.ones((1, 2), np.float32)), reward=1.0)
+    res = asyncio.run(eng.send_feedback(fb))
+    assert res.status is not None and res.status.reason == "CHAOS_INJECTED"
+
+
+def test_one_flaky_branch_fails_graph_with_status():
+    """Ensemble with one chaotic member: the combiner's gather propagates
+    the FAILURE status instead of hanging or averaging garbage."""
+    good = ComponentHandle(Identity(), name="good")
+    bad = ChaosWrapper(ComponentHandle(Identity(), name="bad"),
+                       ChaosPolicy(error_rate=1.0, seed=0))
+
+    def resolver(u):
+        return bad if u.name == "bad" else good
+
+    eng = GraphEngine(
+        {
+            "name": "ens", "type": "COMBINER",
+            "implementation": "AVERAGE_COMBINER",
+            "children": [
+                {"name": "good", "type": "MODEL"},
+                {"name": "bad", "type": "MODEL"},
+            ],
+        },
+        resolver=lambda u: resolver(u) if u.name in ("good", "bad") else None,
+    )
+    out = run_predict(eng)
+    assert out.status is not None
+    assert out.status.reason == "CHAOS_INJECTED"
+
+
+def test_fanout_latency_governed_by_slowest_branch():
+    """Ensemble fan-out runs members CONCURRENTLY: two chaos-delayed
+    members overlap (~1x the delay); serial execution would be ~2x and
+    FAIL the upper bound."""
+    slow_a = ChaosWrapper(ComponentHandle(Identity(), name="a"),
+                          ChaosPolicy(latency_ms=120.0, seed=0))
+    slow_b = ChaosWrapper(ComponentHandle(Identity(), name="b"),
+                          ChaosPolicy(latency_ms=120.0, seed=1))
+
+    eng = GraphEngine(
+        {
+            "name": "ens", "type": "COMBINER",
+            "implementation": "AVERAGE_COMBINER",
+            "children": [
+                {"name": "a", "type": "MODEL"},
+                {"name": "b", "type": "MODEL"},
+            ],
+        },
+        resolver=lambda u: slow_a if u.name == "a" else slow_b,
+    )
+    t0 = time.perf_counter()
+    out = run_predict(eng)
+    dt = time.perf_counter() - t0
+    assert out.status is None or out.status.status == "SUCCESS"
+    assert 0.1 <= dt < 0.22, dt  # overlapped; serial would be ~0.24+
