@@ -1,0 +1,80 @@
+"""Pure-numpy mean classifier — the "any toolkit" escape hatch demo.
+
+Behavioral parity with the reference example
+(``examples/models/mean_classifier/MeanClassifier.py``: logistic score of
+the row mean against a threshold, ``intValue`` constructor parameter,
+``class_names = ["proba"]``) and with the custom-endpoints variant
+(``examples/models/mean_classifier_with_custom_endpoints/MeanClassifier.py``:
+a ``custom_service()`` exposing a predict-call counter for scraping).
+
+No JAX anywhere: this component exercises the eager (non-compiled) path of
+``runtime/component.py`` end to end.  The custom service uses only the
+stdlib http.server so the example has zero extra dependencies.
+"""
+
+import math
+import threading
+
+import numpy as np
+
+
+class MeanClassifier:
+    def __init__(self, intValue: int = 0, threshold: float = 0.5,
+                 customPort: int = 0):
+        if not isinstance(intValue, int):
+            raise ValueError("intValue parameter must be an integer")
+        self.class_names = ["proba"]
+        self.threshold_ = float(threshold) + intValue
+        self.predict_calls = 0
+        self._lock = threading.Lock()
+        # requested (0 = ephemeral) then bound port of the side server
+        self.custom_port = int(customPort)
+        self._ready = threading.Event()
+
+    def predict(self, X, feature_names):
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim != 2:
+            raise ValueError(f"expected 2-D batch, got shape {X.shape}")
+        with self._lock:
+            self.predict_calls += 1
+        z = X.mean(axis=1) - self.threshold_
+        proba = 1.0 / (1.0 + np.exp(-z))
+        return proba[:, None]
+
+    def tags(self):
+        return {"toolkit": "numpy"}
+
+    def metrics(self):
+        return [
+            {"key": "mean_classifier_predict_calls", "type": "COUNTER",
+             "value": 1}
+        ]
+
+    def custom_service(self):
+        """Side server with a /prometheus_metrics endpoint (reference
+        custom-endpoints example).  Runs in the runtime's custom-service
+        thread; binds an ephemeral port and records it in
+        ``self.custom_port``."""
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path != "/prometheus_metrics":
+                    self.send_error(404)
+                    return
+                body = f"predict_call_count {outer.predict_calls}\n".encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep test output quiet
+                pass
+
+        srv = HTTPServer(("127.0.0.1", self.custom_port), Handler)
+        self.custom_port = srv.server_address[1]
+        self._ready.set()
+        srv.serve_forever()

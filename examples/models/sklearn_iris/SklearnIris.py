@@ -1,0 +1,38 @@
+"""scikit-learn iris classifier served through the duck-type contract.
+
+Reference parity: the reference wraps arbitrary sklearn models via its
+python wrapper (e.g. ``examples/models/sklearn_iris`` downstream; the
+wrapper contract is ``wrappers/python/model_microservice.py:32-43``).  Here
+the same user-class shape works unchanged: eager numpy path, no JAX.
+
+The model trains at construction from sklearn's bundled iris data (no
+network, <100 ms) so the example is self-contained — the reference instead
+ships a pre-pickled model, which is exactly the supply-chain pattern
+ADVICE.md r1 flagged; training in-process avoids trusting a binary blob.
+"""
+
+import numpy as np
+
+
+class SklearnIris:
+    def __init__(self, C: float = 1.0):
+        from sklearn.datasets import load_iris
+        from sklearn.linear_model import LogisticRegression
+
+        data = load_iris()
+        self._clf = LogisticRegression(C=float(C), max_iter=200)
+        self._clf.fit(data.data, data.target)
+        self.class_names = [str(n) for n in data.target_names]
+        self._train_acc = float(self._clf.score(data.data, data.target))
+
+    def predict(self, X, feature_names):
+        X = np.asarray(X, dtype=np.float64)
+        return self._clf.predict_proba(X)
+
+    def tags(self):
+        return {"toolkit": "sklearn"}
+
+    def metrics(self):
+        return [
+            {"key": "train_accuracy", "type": "GAUGE", "value": self._train_acc}
+        ]

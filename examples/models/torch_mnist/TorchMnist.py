@@ -1,0 +1,39 @@
+"""PyTorch (CPU) MNIST-shaped classifier through the duck-type contract.
+
+Reference parity: the reference's wrapper serves any-framework user code
+(keras/deep MNIST examples under ``examples/models/{keras_mnist,deep_mnist}``,
+contract ``wrappers/python/model_microservice.py:32-43``).  This proves the
+TPU-native runtime keeps that property: a torch model runs on the eager
+path beside JAX components in the same graph.
+
+Weights are seeded deterministically (no dataset download); the point is
+the serving contract, not MNIST accuracy.  ``torch.inference_mode`` keeps
+autograd state out of the serving hot path.
+"""
+
+import numpy as np
+
+
+class TorchMnist:
+    def __init__(self, hidden: int = 64, seed: int = 0):
+        import torch
+
+        self._torch = torch
+        torch.manual_seed(seed)
+        self._net = torch.nn.Sequential(
+            torch.nn.Linear(784, hidden),
+            torch.nn.ReLU(),
+            torch.nn.Linear(hidden, 10),
+        ).eval()
+        self.class_names = [f"digit_{i}" for i in range(10)]
+
+    def predict(self, X, feature_names):
+        torch = self._torch
+        X = np.asarray(X, dtype=np.float32).reshape(-1, 784)
+        with torch.inference_mode():
+            logits = self._net(torch.from_numpy(X))
+            proba = torch.softmax(logits, dim=-1)
+        return proba.numpy()
+
+    def tags(self):
+        return {"toolkit": "torch", "device": "cpu"}

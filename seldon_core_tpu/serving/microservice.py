@@ -77,6 +77,33 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def maybe_start_custom_service(user_object) -> Optional["threading.Thread"]:
+    """Run the user's ``custom_service()`` beside the main server.
+
+    Reference parity: ``wrappers/python/microservice.py:258-263`` runs a
+    second server process when the user class defines ``custom_service``
+    (example: ``examples/models/mean_classifier_with_custom_endpoints``).
+    Here it runs in a daemon *thread* instead of a process, so user state is
+    shared directly — the reference's ``multiprocessing.Value`` dance is not
+    needed (its processes cannot share plain attributes).
+    """
+    import threading
+
+    fn = getattr(user_object, "custom_service", None)
+    if not callable(fn):
+        return None
+
+    def run():
+        try:
+            fn()
+        except Exception:
+            logger.exception("custom_service crashed")
+
+    t = threading.Thread(target=run, name="custom-service", daemon=True)
+    t.start()
+    return t
+
+
 def main(argv: Optional[list] = None) -> None:
     args = build_parser().parse_args(argv)
     from seldon_core_tpu.operator.local import _honor_jax_platforms_env
@@ -120,6 +147,10 @@ def main(argv: Optional[list] = None) -> None:
             raise SystemExit(0)
 
         signal.signal(signal.SIGTERM, _on_term)
+
+    # after persistence restore — restore() replaces user state wholesale,
+    # which would clobber anything an already-running side server had set
+    maybe_start_custom_service(handle.user)
 
     async def serve():
         from seldon_core_tpu.serving.rest import build_app, start_server

@@ -1,0 +1,182 @@
+"""Any-toolkit model zoo: numpy / sklearn / torch components served through
+the standard contract, plus custom_service() side-server parity.
+
+Reference: the python wrapper serves arbitrary frameworks
+(``wrappers/python/model_microservice.py:32-43``; examples
+``examples/models/{mean_classifier,keras_mnist,deep_mnist}``) and runs a
+user ``custom_service()`` beside the main server
+(``microservice.py:258-263``).  These tests prove the TPU-native runtime
+keeps the eager escape hatch: none of these components touch JAX.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.component import ComponentHandle, load_component
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "examples", "models")
+
+
+def _load(subdir: str, cls: str, params=None) -> ComponentHandle:
+    path = os.path.join(ZOO, subdir)
+    sys.path.insert(0, path)
+    try:
+        return load_component(cls, parameters=params or {})
+    finally:
+        sys.path.remove(path)
+
+
+def _contract(subdir: str):
+    from seldon_core_tpu.tools.contract import Contract
+
+    with open(os.path.join(ZOO, subdir, "contract.json")) as f:
+        return Contract.from_dict(json.load(f))
+
+
+def _drive_rest(handle: ComponentHandle, contract, n: int = 3):
+    """Boot the real ComponentServer on a socket and drive it with
+    contract-generated traffic (util/api_tester methodology)."""
+    from seldon_core_tpu.serving.rest import build_app, start_server
+    from seldon_core_tpu.tools.tester import test_component
+
+    async def run():
+        runner = await start_server(
+            build_app(component=handle), host="127.0.0.1", port=0
+        )
+        port = runner.addresses[0][1]
+        try:
+            rep = await test_component(
+                contract, port=port, n_requests=n, seed=0
+            )
+            assert rep.ok, rep.failures
+            return rep
+        finally:
+            await runner.cleanup()
+
+    return asyncio.run(run())
+
+
+class TestMeanClassifier:
+    def test_predict_math(self):
+        h = _load("mean_classifier", "MeanClassifier", {"intValue": 0})
+        out = h.predict(
+            SeldonMessage.from_ndarray(np.array([[0.5, 0.5, 0.5]], np.float32))
+        )
+        # mean 0.5 - threshold 0.5 = 0 → sigmoid = 0.5
+        np.testing.assert_allclose(np.asarray(out.host_data()), [[0.5]],
+                                   atol=1e-6)
+        assert out.names == ["proba"]
+        assert out.meta.tags["toolkit"] == "numpy"
+
+    def test_int_value_parameter_validated(self):
+        with pytest.raises(ValueError):
+            _load("mean_classifier", "MeanClassifier",
+                  {"intValue": "not-an-int"})
+
+    def test_rest_contract(self):
+        h = _load("mean_classifier", "MeanClassifier", {"intValue": 1})
+        _drive_rest(h, _contract("mean_classifier"))
+
+    def test_custom_service_side_server(self):
+        from seldon_core_tpu.serving.microservice import (
+            maybe_start_custom_service,
+        )
+
+        h = _load("mean_classifier", "MeanClassifier")
+        t = maybe_start_custom_service(h.user)
+        assert t is not None and t.daemon
+        assert h.user._ready.wait(5.0)
+        h.predict(SeldonMessage.from_ndarray(np.ones((2, 3), np.float32)))
+        url = f"http://127.0.0.1:{h.user.custom_port}/prometheus_metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert body == "predict_call_count 1\n"
+
+    def test_custom_service_absent_is_noop(self):
+        from seldon_core_tpu.serving.microservice import (
+            maybe_start_custom_service,
+        )
+
+        assert maybe_start_custom_service(object()) is None
+
+
+class TestSklearnIris:
+    def test_probabilities(self):
+        h = _load("sklearn_iris", "SklearnIris")
+        out = h.predict(
+            SeldonMessage.from_ndarray(
+                np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)
+            )
+        )
+        probs = np.asarray(out.host_data())
+        assert probs.shape == (1, 3)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-6)
+        # canonical setosa example row must classify as setosa
+        assert out.names[int(probs.argmax())] == "setosa"
+        gauges = [m for m in out.meta.metrics if m.key == "train_accuracy"]
+        assert gauges and gauges[0].value > 0.9
+
+    def test_rest_contract(self):
+        h = _load("sklearn_iris", "SklearnIris")
+        _drive_rest(h, _contract("sklearn_iris"))
+
+
+class TestTorchMnist:
+    def test_softmax_output(self):
+        h = _load("torch_mnist", "TorchMnist", {"hidden": 32, "seed": 0})
+        out = h.predict(
+            SeldonMessage.from_ndarray(np.zeros((2, 784), np.float32))
+        )
+        probs = np.asarray(out.host_data())
+        assert probs.shape == (2, 10)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-5)
+        assert out.names[0] == "digit_0"
+        assert out.meta.tags["toolkit"] == "torch"
+
+    def test_accepts_flat_and_image_shapes(self):
+        h = _load("torch_mnist", "TorchMnist", {"hidden": 32})
+        img = SeldonMessage.from_ndarray(np.zeros((1, 28, 28), np.float32))
+        flat = SeldonMessage.from_ndarray(np.zeros((1, 784), np.float32))
+        a = np.asarray(h.predict(img).host_data())
+        b = np.asarray(h.predict(flat).host_data())
+        np.testing.assert_allclose(a, b)
+
+    def test_rest_contract(self):
+        h = _load("torch_mnist", "TorchMnist", {"hidden": 32})
+        _drive_rest(h, _contract("torch_mnist"))
+
+
+def test_zoo_components_in_one_graph():
+    """Heterogeneous graph: torch transformer-input → sklearn model, all
+    eager, composed by the same engine that runs JAX models."""
+    from seldon_core_tpu.graph.engine import GraphEngine
+
+    class Scale:
+        def transform_input(self, X, names):
+            return np.asarray(X) * 1.0
+
+    impls = {
+        "scaler": ComponentHandle(Scale(), service_type="TRANSFORMER"),
+        "clf": _load("sklearn_iris", "SklearnIris"),
+    }
+    spec = {
+        "name": "scaler",
+        "type": "TRANSFORMER",
+        "children": [{"name": "clf", "type": "MODEL"}],
+    }
+    eng = GraphEngine(spec, resolver=lambda u: impls[u.name])
+    out = asyncio.run(
+        eng.predict(
+            SeldonMessage.from_ndarray(
+                np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)
+            )
+        )
+    )
+    probs = np.asarray(out.host_data())
+    assert probs.shape == (1, 3)
