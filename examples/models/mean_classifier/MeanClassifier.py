@@ -1,9 +1,10 @@
 """Pure-numpy mean classifier — the "any toolkit" escape hatch demo.
 
-Behavioral parity with the reference example
-(``examples/models/mean_classifier/MeanClassifier.py``: logistic score of
-the row mean against a threshold, ``intValue`` constructor parameter,
-``class_names = ["proba"]``) and with the custom-endpoints variant
+Behavioral parity with the UPSTREAM reference example (in the Seldon Core
+reference checkout: ``examples/models/mean_classifier/MeanClassifier.py`` —
+logistic score of the row mean against a threshold, ``intValue``
+constructor parameter, ``class_names = ["proba"]``) and with its
+custom-endpoints variant
 (``examples/models/mean_classifier_with_custom_endpoints/MeanClassifier.py``:
 a ``custom_service()`` exposing a predict-call counter for scraping).
 

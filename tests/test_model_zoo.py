@@ -23,6 +23,19 @@ from seldon_core_tpu.runtime.component import ComponentHandle, load_component
 
 ZOO = os.path.join(os.path.dirname(__file__), "..", "examples", "models")
 
+# optional-toolkit deps: sklearn/torch are not declared in pyproject — skip
+# (not fail) their example tests where absent (pattern: test_native.py)
+def _has(mod: str) -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec(mod) is not None
+
+
+requires_sklearn = pytest.mark.skipif(not _has("sklearn"),
+                                      reason="sklearn not installed")
+requires_torch = pytest.mark.skipif(not _has("torch"),
+                                    reason="torch not installed")
+
 
 def _load(subdir: str, cls: str, params=None) -> ComponentHandle:
     path = os.path.join(ZOO, subdir)
@@ -106,6 +119,7 @@ class TestMeanClassifier:
         assert maybe_start_custom_service(object()) is None
 
 
+@requires_sklearn
 class TestSklearnIris:
     def test_probabilities(self):
         h = _load("sklearn_iris", "SklearnIris")
@@ -127,6 +141,7 @@ class TestSklearnIris:
         _drive_rest(h, _contract("sklearn_iris"))
 
 
+@requires_torch
 class TestTorchMnist:
     def test_softmax_output(self):
         h = _load("torch_mnist", "TorchMnist", {"hidden": 32, "seed": 0})
@@ -152,6 +167,7 @@ class TestTorchMnist:
         _drive_rest(h, _contract("torch_mnist"))
 
 
+@requires_sklearn
 def test_zoo_components_in_one_graph():
     """Heterogeneous graph: torch transformer-input → sklearn model, all
     eager, composed by the same engine that runs JAX models."""
