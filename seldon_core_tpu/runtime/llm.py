@@ -18,6 +18,11 @@ No reference counterpart (Seldon Core predates LLM serving; SURVEY.md §5.7
   (models/transformer.py prefill docstring).
 - **Async surface**: ``generate()`` is awaitable and the tick loop runs as
   an asyncio task only while slots are active — idle engines cost nothing.
+- **On-device sampling**: temperature / top-k / top-p are applied INSIDE
+  the compiled tick (vectorized across slots, per-slot parameters as traced
+  arrays), so the only device→host traffic per tick is the sampled token
+  ids — not the (slots, vocab) logits.  Per-request stop tokens terminate
+  a slot early and release it to waiting admissions.
 """
 
 from __future__ import annotations
@@ -48,13 +53,49 @@ def _bucket(n: int) -> int:
     return b
 
 
+def sample_tokens(logits, temps, top_k, top_p, keys):
+    """Vectorized per-slot sampling, pure/jittable.
+
+    - ``logits``: (S, V) float
+    - ``temps``: (S,) float; <= 0 selects greedy argmax for that slot
+    - ``top_k``: (S,) int32; 0 disables the top-k filter
+    - ``top_p``: (S,) float; >= 1 disables the nucleus filter
+    - ``keys``: (S, 2) uint32 per-slot PRNG keys
+
+    Returns ``(tokens (S,) int32, new_keys (S, 2) uint32)``.  Filters
+    compose the standard way: temperature first, then top-k, then top-p
+    over the temperature-scaled distribution; sampling happens in sorted
+    space and indices map back through the sort order.
+    """
+    V = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(-logits, axis=-1)  # descending
+    sorted_logits = jnp.take_along_axis(logits / temp, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    pos = jnp.arange(V)[None, :]
+    keep_k = pos < jnp.where(top_k > 0, top_k, V)[:, None]
+    # nucleus: minimal prefix whose mass reaches p (position 0 always kept
+    # because its exclusive cumsum is 0)
+    keep_p = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+    filtered = jnp.where(keep_k & keep_p, sorted_logits, -jnp.inf)
+
+    split = jax.vmap(jax.random.split)(keys)  # (S, 2, 2)
+    new_keys, use = split[:, 0], split[:, 1]
+    idx = jax.vmap(jax.random.categorical)(use, filtered)
+    sampled = jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0]
+    toks = jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
+    return toks, new_keys
+
+
 @dataclass
 class _Slot:
     future: asyncio.Future
     remaining: int
     tokens: list
-    temperature: float
-    key: Any
+    stop: frozenset
 
 
 class LLMEngine:
@@ -76,14 +117,27 @@ class LLMEngine:
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq
         self.cache = init_cache(cfg, max_slots, max_len=self.max_len)
-        self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._slots: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
         self._slot_waiters: list[asyncio.Future] = []  # FIFO admission
         self._tick_task: Optional[asyncio.Task] = None
-        self._step = jax.jit(partial(decode_step, cfg=cfg))
+        # host mirrors of per-slot state, passed as traced args each tick
+        # (tiny transfers; admission mutates them with zero device dispatch)
+        self._tokens = np.zeros((max_slots,), np.int32)
+        self._temps = np.zeros((max_slots,), np.float32)
+        self._topk = np.zeros((max_slots,), np.int32)
+        self._topp = np.ones((max_slots,), np.float32)
+        self._keys = np.zeros((max_slots, 2), np.uint32)
+        self._step = jax.jit(self._step_impl)
+        self._sample1 = jax.jit(sample_tokens)
         self._insert = jax.jit(self._insert_impl, static_argnames=("true_len",))
         self._prefills: dict[int, Any] = {}  # bucket -> jitted prefill
+
+    def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys):
+        """One decode tick + on-device sampling: logits never leave HBM."""
+        logits, cache = decode_step(params, cache, tok, cfg=self.cfg)
+        toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
+        return toks, keys, cache
 
     # -- device programs -------------------------------------------------
     def _prefill_for(self, bucket: int):
@@ -119,7 +173,14 @@ class LLMEngine:
         n_new: int,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_tokens=(),
     ):
+        """Generate up to ``n_new`` tokens.  ``stop_tokens``: iterable of
+        token ids; generation ends early when one is sampled (the stop token
+        IS included in the output, HF convention).  ``top_k=0`` / ``top_p>=1``
+        disable those filters; ``temperature=0`` is greedy."""
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None, :]
@@ -134,29 +195,48 @@ class LLMEngine:
         if n_new <= 0:
             return prompt_ids
         slot = await self._acquire_slot()
+        try:
+            # bucketed prefill (right-padding is exact under causal
+            # attention); logit_pos: only the last true position is
+            # vocab-projected
+            bucket = _bucket(L0)
+            padded = jnp.pad(prompt_ids, ((0, 0), (0, bucket - L0)))
+            logits, small = self._prefill_for(bucket)(
+                self.params, padded, logit_pos=L0 - 1
+            )
+            self.cache = self._insert(self.cache, small, slot, true_len=L0)
 
-        # bucketed prefill (right-padding is exact under causal attention);
-        # logit_pos: only the last true position is vocab-projected
-        bucket = _bucket(L0)
-        padded = jnp.pad(prompt_ids, ((0, 0), (0, bucket - L0)))
-        logits, small = self._prefill_for(bucket)(
-            self.params, padded, logit_pos=L0 - 1
-        )
-        first_logits = logits[0]
-        self.cache = self._insert(self.cache, small, slot, true_len=L0)
-
-        key = jax.random.PRNGKey(seed) if temperature > 0.0 else None
-        st = _Slot(
-            future=asyncio.get_running_loop().create_future(),
-            remaining=n_new,
-            tokens=[],
-            temperature=temperature,
-            key=key,
-        )
+            self._temps[slot] = float(temperature)
+            self._topk[slot] = int(top_k)
+            self._topp[slot] = float(top_p)
+            key = jax.random.PRNGKey(seed)
+            st = _Slot(
+                future=asyncio.get_running_loop().create_future(),
+                remaining=n_new,
+                tokens=[],
+                stop=frozenset(int(t) for t in stop_tokens),
+            )
+            # first generated token comes straight from the prefill logits,
+            # sampled with the same on-device policy as decode ticks
+            tok1, key1 = self._sample1(
+                logits,
+                self._temps[slot : slot + 1],
+                self._topk[slot : slot + 1],
+                self._topp[slot : slot + 1],
+                jnp.asarray(key, jnp.uint32)[None, :],
+            )
+            self._keys[slot] = np.asarray(key1[0])
+            first_tok = int(tok1[0])  # materializes: deferred device errors
+            # surface here, inside the recovery scope
+        except BaseException:
+            # a failed admission (e.g. a new bucket's prefill fails to
+            # compile) must not leak the slot — after max_slots leaks every
+            # generate() would hang in _acquire_slot forever
+            self._release_slot(slot)
+            raise
         self._slots[slot] = st
-        # first generated token comes straight from the prefill logits
-        self._emit(slot, st, first_logits)
-        if st.remaining > 0:
+        self._emit(slot, st, first_tok)
+        if slot in self._slots:  # not already finished by stop/n_new=1
             self._ensure_ticking()
         out_new = await st.future
         return jnp.concatenate(
@@ -181,16 +261,11 @@ class LLMEngine:
                 w.set_result(None)
                 break
 
-    def _emit(self, slot: int, st: _Slot, logits) -> None:
-        if st.temperature > 0.0:
-            st.key, sub = jax.random.split(st.key)
-            tok = int(jax.random.categorical(sub, logits / st.temperature))
-        else:
-            tok = int(jnp.argmax(logits))
+    def _emit(self, slot: int, st: _Slot, tok: int) -> None:
         st.tokens.append(tok)
         st.remaining -= 1
-        self._tokens = self._tokens.at[slot].set(tok)
-        if st.remaining <= 0:
+        self._tokens[slot] = tok
+        if st.remaining <= 0 or tok in st.stop:
             del self._slots[slot]
             self._release_slot(slot)
             if not st.future.done():
@@ -206,15 +281,28 @@ class LLMEngine:
         loop = asyncio.get_running_loop()
         try:
             while self._slots:
-                logits, self.cache = self._step(
-                    self.params, self.cache, self._tokens
+                # snapshot BEFORE dispatch: a request admitted to a freed
+                # slot while this tick is in flight must not receive a token
+                # sampled from the slot's previous occupant's logits row
+                active = frozenset(self._slots)
+                toks, keys, self.cache = self._step(
+                    self.params, self.cache,
+                    self._tokens, self._temps, self._topk, self._topp,
+                    self._keys,
                 )
                 # one transfer per tick for all slots, OFF the event loop —
                 # a blocking fetch here would stall every other handler
-                # (health probes, new arrivals) for the device round trip
-                host = await loop.run_in_executor(None, np.asarray, logits)
+                # (health probes, new arrivals) for the device round trip.
+                # Only the sampled token ids + keys cross the device
+                # boundary; the (slots, vocab) logits stay in HBM.
+                host_toks, host_keys = await loop.run_in_executor(
+                    None, lambda: (np.asarray(toks), np.asarray(keys))
+                )
                 for slot, st in list(self._slots.items()):
-                    self._emit(slot, st, jnp.asarray(host[slot]))
+                    if slot not in active:
+                        continue  # admitted mid-tick; first real tick is next
+                    self._keys[slot] = host_keys[slot]
+                    self._emit(slot, st, int(host_toks[slot]))
                 await asyncio.sleep(0)  # let arrivals join between ticks
         except BaseException as e:
             # a dying tick loop must not strand in-flight requests on
@@ -234,7 +322,8 @@ class LLMComponent:
     component surface, so an LLM deploys exactly like any other model
     (REST/gRPC/framed, graph composition, metrics).
 
-    Request: jsonData {"prompt_ids": [...], "n_new": N, "temperature": T}
+    Request: jsonData {"prompt_ids": [...], "n_new": N, "temperature": T,
+    "top_k": K, "top_p": P, "stop": [ids...], "seed": S}
     or a token-id tensor (n_new via the ``n_new`` component parameter).
     Response: jsonData {"ids": [...], "text_len": L}.
     """
@@ -254,12 +343,18 @@ class LLMComponent:
             spec = msg.json_data
             ids = spec["prompt_ids"]
             n_new = int(spec.get("n_new", self.default_n_new))
-            temp = float(spec.get("temperature", 0.0))
+            kw = dict(
+                temperature=float(spec.get("temperature", 0.0)),
+                top_k=int(spec.get("top_k", 0)),
+                top_p=float(spec.get("top_p", 1.0)),
+                stop_tokens=spec.get("stop", ()),
+                seed=int(spec.get("seed", 0)),
+            )
         else:
             ids = np.asarray(msg.host_data(), np.int32).reshape(-1)
-            n_new, temp = self.default_n_new, 0.0
+            n_new, kw = self.default_n_new, {}
         out = await self.engine.generate(
-            jnp.asarray(ids, jnp.int32), n_new, temperature=temp
+            jnp.asarray(ids, jnp.int32), n_new, **kw
         )
         ids_out = np.asarray(out[0]).tolist()
         return SeldonMessage(
