@@ -325,7 +325,8 @@ class LLMComponent:
     Request: jsonData {"prompt_ids": [...], "n_new": N, "temperature": T,
     "top_k": K, "top_p": P, "stop": [ids...], "seed": S}
     or a token-id tensor (n_new via the ``n_new`` component parameter).
-    Response: jsonData {"ids": [...], "text_len": L}.
+    Response: jsonData {"ids": [...], "prompt_len": L0} — ids is prompt +
+    generated tokens; prompt_len marks where generation starts.
     """
 
     def __init__(self, engine: LLMEngine, n_new: int = 16):

