@@ -160,6 +160,27 @@ class ComponentHandle:
             self._compiled = jax.jit(names_free)
             self._params = _NO_PARAMS
 
+        # Message-level passthrough: a component declaring
+        # ``accepts_messages = True`` implements the NodeImpl surface itself
+        # (methods take/return SeldonMessage, possibly async — e.g.
+        # runtime.llm.LLMComponent).  The handle forwards instead of
+        # adapting, so such components deploy through the standard
+        # load_component / microservice-CLI path unchanged.
+        if getattr(user_object, "accepts_messages", False):
+            for m in ("predict", "route", "aggregate", "transform_input",
+                      "transform_output", "send_feedback", "score",
+                      "stream"):
+                fn = getattr(user_object, m, None)
+                if callable(fn):
+                    setattr(self, m, fn)
+            user_has = getattr(user_object, "has", None)
+            if callable(user_has):
+                self.has = user_has  # type: ignore[method-assign]
+        elif callable(getattr(user_object, "stream", None)):
+            # non-passthrough components may still expose a message-level
+            # stream() (served as the SSE route); forward it as-is
+            self.stream = user_object.stream
+
     # ---- capability flags (engine consults these like the reference's
     # `methods` list, seldon_deployment.proto:95) -----------------------
     def has(self, method: str) -> bool:

@@ -90,9 +90,12 @@ def sample_tokens(logits, temps, top_k, top_p, keys):
     return toks, new_keys
 
 
+_DONE = object()  # end-of-stream sentinel on a slot's token queue
+
+
 @dataclass
 class _Slot:
-    future: asyncio.Future
+    queue: asyncio.Queue  # generated token ids; _DONE / exception terminate
     remaining: int
     tokens: list
     stop: frozenset
@@ -177,23 +180,57 @@ class LLMEngine:
         top_p: float = 1.0,
         stop_tokens=(),
     ):
-        """Generate up to ``n_new`` tokens.  ``stop_tokens``: iterable of
-        token ids; generation ends early when one is sampled (the stop token
-        IS included in the output, HF convention).  ``top_k=0`` / ``top_p>=1``
-        disable those filters; ``temperature=0`` is greedy."""
+        """Generate up to ``n_new`` tokens; returns ``[1, L0 + n_generated]``
+        (prompt + new tokens).  Built on :meth:`stream`; see it for sampling
+        and stop-token semantics."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None, :]
+        if n_new <= 0:
+            return prompt_ids
+        out_new = [
+            t
+            async for t in self.stream(
+                prompt_ids, n_new, temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p, stop_tokens=stop_tokens,
+            )
+        ]
+        return jnp.concatenate(
+            [prompt_ids, jnp.asarray(out_new, jnp.int32)[None, :]], axis=1
+        )
+
+    async def stream(
+        self,
+        prompt_ids,
+        n_new: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_tokens=(),
+    ):
+        """Async generator yielding generated token ids AS THEY ARE SAMPLED
+        — the continuous-batching analog of server-sent-token streaming.
+
+        ``stop_tokens``: iterable of token ids; generation ends early when
+        one is sampled (the stop token IS yielded, HF convention).
+        ``top_k=0`` / ``top_p>=1`` disable those filters; ``temperature=0``
+        is greedy.  Abandoning the generator early (``aclose``/``break``)
+        cancels the request and releases its slot immediately.
+        """
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None, :]
         B, L0 = prompt_ids.shape
         if B != 1:
-            raise ValueError("generate() takes one request; batching is the "
+            raise ValueError("stream() takes one request; batching is the "
                              "engine's job (submit concurrently)")
         if L0 + n_new > self.max_len:
             raise ValueError(
                 f"prompt {L0} + n_new {n_new} exceeds max_len {self.max_len}"
             )
         if n_new <= 0:
-            return prompt_ids
+            return
         slot = await self._acquire_slot()
         try:
             # bucketed prefill (right-padding is exact under causal
@@ -211,7 +248,7 @@ class LLMEngine:
             self._topp[slot] = float(top_p)
             key = jax.random.PRNGKey(seed)
             st = _Slot(
-                future=asyncio.get_running_loop().create_future(),
+                queue=asyncio.Queue(),
                 remaining=n_new,
                 tokens=[],
                 stop=frozenset(int(t) for t in stop_tokens),
@@ -238,10 +275,19 @@ class LLMEngine:
         self._emit(slot, st, first_tok)
         if slot in self._slots:  # not already finished by stop/n_new=1
             self._ensure_ticking()
-        out_new = await st.future
-        return jnp.concatenate(
-            [prompt_ids, jnp.asarray(out_new, jnp.int32)[None, :]], axis=1
-        )
+        try:
+            while True:
+                item = await st.queue.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # consumer walked away mid-stream (break / aclose / cancel):
+            # free the slot so the ticker stops decoding a ghost request
+            if self._slots.get(slot) is st:
+                self._finish(slot, st)
 
     # -- internals -------------------------------------------------------
     async def _acquire_slot(self) -> int:
@@ -265,11 +311,16 @@ class LLMEngine:
         st.tokens.append(tok)
         st.remaining -= 1
         self._tokens[slot] = tok
+        st.queue.put_nowait(tok)
         if st.remaining <= 0 or tok in st.stop:
-            del self._slots[slot]
-            self._release_slot(slot)
-            if not st.future.done():
-                st.future.set_result(st.tokens)
+            self._finish(slot, st)
+
+    def _finish(self, slot: int, st: _Slot, exc=None) -> None:
+        """Retire a slot: remove from the active set, release to waiters,
+        terminate the consumer's queue (with ``exc`` on failure)."""
+        self._slots.pop(slot, None)
+        self._release_slot(slot)
+        st.queue.put_nowait(_DONE if exc is None else exc)
 
     def _ensure_ticking(self) -> None:
         if self._tick_task is None or self._tick_task.done():
@@ -281,10 +332,13 @@ class LLMEngine:
         loop = asyncio.get_running_loop()
         try:
             while self._slots:
-                # snapshot BEFORE dispatch: a request admitted to a freed
-                # slot while this tick is in flight must not receive a token
-                # sampled from the slot's previous occupant's logits row
-                active = frozenset(self._slots)
+                # snapshot BEFORE dispatch, by _Slot IDENTITY: a request
+                # admitted to a freed slot while this tick is in flight
+                # (slot freed by completion OR mid-tick stream abandonment)
+                # must not receive a token sampled from the previous
+                # occupant's logits row — index membership alone cannot
+                # distinguish re-occupancy
+                active = dict(self._slots)
                 toks, keys, self.cache = self._step(
                     self.params, self.cache,
                     self._tokens, self._temps, self._topk, self._topp,
@@ -298,20 +352,17 @@ class LLMEngine:
                 host_toks, host_keys = await loop.run_in_executor(
                     None, lambda: (np.asarray(toks), np.asarray(keys))
                 )
-                for slot, st in list(self._slots.items()):
-                    if slot not in active:
-                        continue  # admitted mid-tick; first real tick is next
+                for slot, st in active.items():
+                    if self._slots.get(slot) is not st:
+                        continue  # freed (and possibly re-occupied) mid-tick
                     self._keys[slot] = host_keys[slot]
                     self._emit(slot, st, int(host_toks[slot]))
                 await asyncio.sleep(0)  # let arrivals join between ticks
         except BaseException as e:
             # a dying tick loop must not strand in-flight requests on
-            # futures nobody will ever resolve
+            # queues nobody will ever terminate
             for slot, st in list(self._slots.items()):
-                del self._slots[slot]
-                self._release_slot(slot)
-                if not st.future.done():
-                    st.future.set_exception(e)
+                self._finish(slot, st, exc=e)
             raise
         finally:
             self._tick_task = None
@@ -329,17 +380,17 @@ class LLMComponent:
     generated tokens; prompt_len marks where generation starts.
     """
 
+    accepts_messages = True  # NodeImpl surface; ComponentHandle forwards
+
     def __init__(self, engine: LLMEngine, n_new: int = 16):
         self.engine = engine
         self.default_n_new = n_new
         self.name = "llm"
 
     def has(self, method: str) -> bool:
-        return method == "predict"
+        return method in ("predict", "stream")
 
-    async def predict(self, msg):
-        from seldon_core_tpu.messages import SeldonMessage
-
+    def _parse(self, msg):
         if msg.json_data is not None:
             spec = msg.json_data
             ids = spec["prompt_ids"]
@@ -354,6 +405,28 @@ class LLMComponent:
         else:
             ids = np.asarray(msg.host_data(), np.int32).reshape(-1)
             n_new, kw = self.default_n_new, {}
+        return ids, n_new, kw
+
+    async def stream(self, msg):
+        """Async generator of SSE-able events: one ``{"token": t, "i": i}``
+        per generated token, then ``{"done": true, "ids": [...],
+        "prompt_len": L0}``."""
+        ids, n_new, kw = self._parse(msg)
+        ids = [int(t) for t in np.asarray(ids, np.int32).reshape(-1)]
+        out = list(ids)
+        i = 0
+        async for tok in self.engine.stream(
+            jnp.asarray(ids, jnp.int32), n_new, **kw
+        ):
+            out.append(int(tok))
+            yield {"token": int(tok), "i": i}
+            i += 1
+        yield {"done": True, "ids": out, "prompt_len": len(ids)}
+
+    async def predict(self, msg):
+        from seldon_core_tpu.messages import SeldonMessage
+
+        ids, n_new, kw = self._parse(msg)
         out = await self.engine.generate(
             jnp.asarray(ids, jnp.int32), n_new, **kw
         )

@@ -236,7 +236,7 @@ def engine_spec() -> dict:
     }
 
 
-def component_spec() -> dict:
+def component_spec(stream: bool = False) -> dict:
     """Internal microservice API (reference wrapper.oas3.json +
     docs/reference/internal-api.md)."""
     paths = {
@@ -262,6 +262,21 @@ def component_spec() -> dict:
                              "tags": ["ops"],
                              "responses": {"200": {"description": "OK"}}}},
     }
+    if stream:
+        # only components exposing an async stream(msg) register the route
+        # (rest.py ComponentServer.register) — advertise it only for them
+        paths["/stream"] = {
+            "post": {
+                "summary": "server-sent-events token streaming "
+                           "(e.g. runtime.llm.LLMComponent)",
+                "tags": ["component"],
+                "requestBody": _msg_op("", tags=[])["requestBody"],
+                "responses": {"200": {
+                    "description": "text/event-stream of JSON events; "
+                                   "final event has done=true",
+                    "content": {"text/event-stream": {}},
+                }},
+            }}
     return {
         "openapi": OAS_VERSION,
         "info": {"title": "seldon-core-tpu internal component API",

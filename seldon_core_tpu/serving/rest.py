@@ -202,7 +202,7 @@ class EngineServer:
         app.router.add_get("/seldon.json", _openapi_handler("engine"))
 
 
-def _openapi_handler(which: str):
+def _openapi_handler(which: str, **spec_kw):
     """GET /seldon.json — the surface's OAS3 spec (reference wrappers serve
     their spec at /seldon.json, openapi/README.md)."""
 
@@ -211,7 +211,7 @@ def _openapi_handler(which: str):
 
         spec = {"engine": openapi.engine_spec,
                 "component": openapi.component_spec,
-                "gateway": openapi.gateway_spec}[which]()
+                "gateway": openapi.gateway_spec}[which](**spec_kw)
         return web.json_response(spec)
 
     return handler
@@ -290,6 +290,63 @@ class ComponentServer:
             ret if isinstance(ret, SeldonMessage) else SeldonMessage(status=Status())
         )
 
+    async def stream(self, request: web.Request) -> web.StreamResponse:
+        """Server-sent-events token streaming for components exposing an
+        async-generator ``stream(msg)`` (e.g. runtime.llm.LLMComponent).
+        Each event is one JSON object; the final event carries
+        ``{"done": true, ...}``.  Errors mid-stream emit an ``error`` event
+        and end the stream (headers are already on the wire, so a status
+        rewrite is impossible — SSE convention)."""
+        msg = _parse_msg(await _payload_json(request))
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        t0 = time.perf_counter()
+        agen = self.handle.stream(msg)
+        try:
+            async for event in agen:
+                await resp.write(
+                    b"data: " + json.dumps(event).encode() + b"\n\n"
+                )
+            self.metrics.observe_request(
+                self.handle.name, time.perf_counter() - t0
+            )
+        except (ConnectionError, OSError):
+            # client went away mid-stream; the finally below closes the
+            # generator DETERMINISTICALLY (its own finally releases the
+            # engine slot) — not a component failure, count as cancelled
+            logger.debug("stream client disconnected (%s)", self.handle.name)
+            self.metrics.observe_request(
+                self.handle.name, time.perf_counter() - t0, 499
+            )
+            return resp
+        except Exception as e:
+            logger.exception("component %s stream failed", self.handle.name)
+            self.metrics.observe_request(
+                self.handle.name, time.perf_counter() - t0, 500
+            )
+            err = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                await resp.write(
+                    b"data: " + json.dumps(err).encode() + b"\n\n"
+                )
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            # explicit aclose: an abandoned async generator would otherwise
+            # only finalize at GC time, leaving the ghost request decoding
+            # and its slot blocked for an unbounded interval
+            await agen.aclose()
+        try:
+            await resp.write_eof()
+        except (ConnectionError, OSError):
+            pass
+        return resp
+
     async def health(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")
 
@@ -303,6 +360,8 @@ class ComponentServer:
         app.router.add_post("/route", self.route)
         app.router.add_post("/aggregate", self.aggregate)
         app.router.add_post("/send-feedback", self.send_feedback)
+        if callable(getattr(self.handle, "stream", None)):
+            app.router.add_post("/stream", self.stream)
         app.router.add_get("/health/status", self.health)
         # an EngineServer registered first may already own /metrics (and its
         # engine-flavored /seldon.json)
@@ -312,7 +371,13 @@ class ComponentServer:
         if "/metrics" not in existing:
             app.router.add_get("/metrics", self.prometheus)
         if "/seldon.json" not in existing:
-            app.router.add_get("/seldon.json", _openapi_handler("component"))
+            app.router.add_get(
+                "/seldon.json",
+                _openapi_handler(
+                    "component",
+                    stream=callable(getattr(self.handle, "stream", None)),
+                ),
+            )
 
 
 def build_app(

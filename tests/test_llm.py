@@ -269,6 +269,193 @@ class TestSamplingAndStop:
         asyncio.run(run())
 
 
+class TestStreaming:
+    """Token streaming: stream() yields as tokens are sampled; generate()
+    is built on it; abandoning a stream releases the slot."""
+
+    def test_stream_matches_generate(self):
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+            g = await eng.generate(prompt(4), 6)
+            toks = [t async for t in eng.stream(prompt(4), 6)]
+            assert toks == np.asarray(g[0, 4:]).tolist()
+
+        asyncio.run(run())
+
+    def test_stream_is_incremental(self):
+        """The first token must be available while the request is still
+        generating (slot active), not only at completion."""
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+            agen = eng.stream(prompt(4), 6)
+            first = await agen.__anext__()
+            assert isinstance(first, int)
+            assert len(eng._slots) == 1  # still mid-generation
+            rest = [t async for t in agen]
+            assert len(rest) == 5
+            assert not eng._slots
+
+        asyncio.run(run())
+
+    def test_abandoned_stream_releases_slot(self):
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=1, max_len=32)
+            agen = eng.stream(prompt(4), 8)
+            await agen.__anext__()
+            await agen.__anext__()
+            await agen.aclose()  # walk away after 2 tokens
+            assert eng._free == [0] and not eng._slots
+            # the slot is immediately reusable
+            out = await asyncio.wait_for(eng.generate(prompt(4), 3),
+                                         timeout=30)
+            assert out.shape == (1, 7)
+
+        asyncio.run(run())
+
+    def test_stream_stop_tokens(self):
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+            g = np.asarray((await eng.generate(prompt(4), 8))[0]).tolist()
+            stop = g[6]
+            toks = [
+                t async for t in eng.stream(prompt(4), 8, stop_tokens=[stop])
+            ]
+            assert toks == g[4 : g.index(stop, 4) + 1]
+
+        asyncio.run(run())
+
+    def test_component_sse_route(self):
+        """Full SSE path: LLMComponent.stream through the REST server; the
+        client must see per-token events then the done event."""
+        import json as _json
+
+        import aiohttp
+
+        from seldon_core_tpu.serving.rest import build_app, start_server
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+            comp = LLMComponent(eng, n_new=4)
+            runner = await start_server(
+                build_app(component=comp), "127.0.0.1", 0
+            )
+            port = runner.addresses[0][1]
+            p = np.asarray(prompt(4)[0]).tolist()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    body = {"json": _json.dumps(
+                        {"jsonData": {"prompt_ids": p, "n_new": 4}}
+                    )}
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/stream", data=body
+                    ) as r:
+                        assert r.status == 200
+                        assert r.headers["Content-Type"] == "text/event-stream"
+                        events = []
+                        async for line in r.content:
+                            line = line.strip()
+                            if line.startswith(b"data: "):
+                                events.append(_json.loads(line[6:]))
+            finally:
+                await runner.cleanup()
+            assert len(events) == 5  # 4 token events + done
+            assert [e["i"] for e in events[:-1]] == [0, 1, 2, 3]
+            toks = [e["token"] for e in events[:-1]]
+            done = events[-1]
+            assert done["done"] and done["prompt_len"] == 4
+            assert done["ids"] == p + toks
+            ref = await eng.generate(jnp.asarray(p), 4)
+            assert done["ids"] == np.asarray(ref[0]).tolist()
+
+        asyncio.run(run())
+
+
+class TestWrappedDeployment:
+    """Production path: LLMComponent wrapped by ComponentHandle (the
+    load_component/CLI route) must forward message-level methods including
+    stream — previously the wrapper adapted (X, names)-style calls only and
+    /stream 404'd."""
+
+    def _wrapped_app(self):
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.rest import build_app
+
+        eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+        comp = LLMComponent(eng, n_new=4)
+        handle = ComponentHandle(comp, name="llm")
+        return build_app(component=handle), handle
+
+    def test_handle_forwards_message_methods(self):
+        _, handle = self._wrapped_app()
+        assert handle.has("predict") and handle.has("stream")
+        p = np.asarray(prompt(4)[0]).tolist()
+        from seldon_core_tpu.messages import SeldonMessage
+
+        out = asyncio.run(
+            handle.predict(
+                SeldonMessage(json_data={"prompt_ids": p, "n_new": 3})
+            )
+        )
+        assert len(out.json_data["ids"]) == 7
+
+    def test_stream_route_registered_and_spec_advertises_it(self):
+        import json as _json
+
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        app, _ = self._wrapped_app()
+
+        async def run():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                spec = await (await client.get("/seldon.json")).json()
+                assert "/stream" in spec["paths"]
+                p = np.asarray(prompt(4)[0]).tolist()
+                body = {"json": _json.dumps(
+                    {"jsonData": {"prompt_ids": p, "n_new": 3}})}
+                async with client.post("/stream", data=body) as r:
+                    assert r.status == 200
+                    n = 0
+                    async for line in r.content:
+                        if line.startswith(b"data: "):
+                            n += 1
+                assert n == 4  # 3 tokens + done
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_plain_component_spec_omits_stream(self):
+        from seldon_core_tpu.serving import openapi
+
+        assert "/stream" not in openapi.component_spec()["paths"]
+        assert "/stream" in openapi.component_spec(stream=True)["paths"]
+
+
+def test_slot_reoccupancy_during_inflight_tick_is_isolated():
+    """Identity regression: B admitted into A's slot while a tick is in
+    flight (A abandoned mid-tick) must produce exactly its solo output —
+    never a token from A's sampling state."""
+
+    async def run():
+        eng = LLMEngine(PARAMS, TINY, max_slots=1, max_len=32)
+        solo = np.asarray((await eng.generate(prompt(4, seed=9), 5))[0])
+
+        for _ in range(5):  # several interleavings
+            agen = eng.stream(prompt(4, seed=1), 8, temperature=1.5, seed=42)
+            await agen.__anext__()
+            b = asyncio.create_task(eng.generate(prompt(4, seed=9), 5))
+            await asyncio.sleep(0)  # let B reach _acquire_slot
+            await agen.aclose()  # frees the slot, possibly mid-tick
+            out = np.asarray((await asyncio.wait_for(b, timeout=30))[0])
+            np.testing.assert_array_equal(out, solo)
+
+    asyncio.run(run())
+
+
 class TestLLMComponent:
     def test_serves_through_graph_engine(self):
         from seldon_core_tpu.graph.engine import GraphEngine
