@@ -135,12 +135,83 @@ class LLMEngine:
         self._sample1 = jax.jit(sample_tokens)
         self._insert = jax.jit(self._insert_impl, static_argnames=("true_len",))
         self._prefills: dict[int, Any] = {}  # bucket -> jitted prefill
+        # prefix cache: token-tuple -> {"k","v" (layers,1,cap,H,Dh),
+        # "len", "logits"}; see register_prefix
+        self._prefixes: dict[tuple, dict] = {}
+        self._extends: dict[tuple, Any] = {}  # (cap0, Bs) -> jitted extend
 
     def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys):
         """One decode tick + on-device sampling: logits never leave HBM."""
         logits, cache = decode_step(params, cache, tok, cfg=self.cfg)
         toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
         return toks, keys, cache
+
+    # -- prefix caching --------------------------------------------------
+    def register_prefix(self, prefix_ids) -> None:
+        """Cache the KV state of a shared prompt prefix (e.g. a system
+        prompt) ON DEVICE.  Subsequent requests whose prompt starts with a
+        registered prefix skip its prefill entirely: the cached K/V is
+        copied into the slot and only the suffix runs through the model
+        (one K-token ``decode_step`` chunk — the speculative-decoding
+        verification primitive reused).  Exact: causal attention makes the
+        prefix state independent of what follows."""
+        ids = tuple(int(t) for t in np.asarray(prefix_ids).reshape(-1))
+        if not ids:
+            raise ValueError("empty prefix")
+        if len(ids) >= self.max_len:
+            raise ValueError(f"prefix {len(ids)} >= max_len {self.max_len}")
+        bucket = _bucket(len(ids))
+        padded = jnp.asarray(ids + (0,) * (bucket - len(ids)), jnp.int32)[None]
+        logits, small = self._prefill_for(bucket)(
+            self.params, padded, logit_pos=len(ids) - 1
+        )
+        self._prefixes[ids] = {
+            "k": small["k"], "v": small["v"],
+            "len": len(ids), "logits": logits,
+        }
+
+    def clear_prefixes(self) -> None:
+        """Drop all cached prefixes (frees their HBM)."""
+        self._prefixes.clear()
+
+    def _match_prefix(self, ids: tuple):
+        """Longest registered prefix that ``ids`` starts with, or None."""
+        best = None
+        for p, entry in self._prefixes.items():
+            if len(p) <= len(ids) and ids[: len(p)] == p:
+                if best is None or len(p) > best[1]["len"]:
+                    best = (p, entry)
+        return best[1] if best is not None else None
+
+    def _extend_for(self, cap0: int, b_suffix: int):
+        """Jitted: prefix KV (cap0 rows) + padded suffix chunk → last-true
+        logits position's chunk logits + extended 1-row cache.  Padded
+        suffix positions sit AFTER the true ones, so causality keeps every
+        true position exact; the insert clips the garbage rows."""
+        fn = self._extends.get((cap0, b_suffix))
+        if fn is None:
+
+            def extend(params, k, v, suffix, true_prefix_len, last_pos):
+                need = cap0 + b_suffix  # worst case capacity
+                pad = ((0, 0), (0, 0), (0, need - cap0), (0, 0), (0, 0))
+                cache = {
+                    "k": jnp.pad(k, pad),
+                    "v": jnp.pad(v, pad),
+                    "pos": jnp.full((1,), true_prefix_len, jnp.int32),
+                }
+                chunk_logits, cache = decode_step(
+                    params, cache, suffix, cfg=self.cfg
+                )
+                # last TRUE suffix position's logits, selected in-program —
+                # an eager slice outside jit would cost one extra dispatch
+                # (~100 ms over the device tunnel) per admission
+                logits = jax.lax.dynamic_slice_in_dim(
+                    chunk_logits, last_pos, 1, axis=1
+                )[:, 0]
+                return logits, cache
+
+            fn = self._extends[(cap0, b_suffix)] = jax.jit(extend)
+        return fn
 
     # -- device programs -------------------------------------------------
     def _prefill_for(self, bucket: int):
@@ -183,20 +254,22 @@ class LLMEngine:
         """Generate up to ``n_new`` tokens; returns ``[1, L0 + n_generated]``
         (prompt + new tokens).  Built on :meth:`stream`; see it for sampling
         and stop-token semantics."""
-        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-        if prompt_ids.ndim == 1:
-            prompt_ids = prompt_ids[None, :]
+        prompt_arr = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt_arr.ndim == 1:
+            prompt_arr = prompt_arr[None, :]
         if n_new <= 0:
-            return prompt_ids
+            return prompt_arr
         out_new = [
             t
+            # the ORIGINAL prompt goes to stream(): converting first would
+            # force the host-side prefix match into a device round trip
             async for t in self.stream(
                 prompt_ids, n_new, temperature=temperature, seed=seed,
                 top_k=top_k, top_p=top_p, stop_tokens=stop_tokens,
             )
         ]
         return jnp.concatenate(
-            [prompt_ids, jnp.asarray(out_new, jnp.int32)[None, :]], axis=1
+            [prompt_arr, jnp.asarray(out_new, jnp.int32)[None, :]], axis=1
         )
 
     async def stream(
@@ -218,6 +291,16 @@ class LLMEngine:
         is greedy.  Abandoning the generator early (``aclose``/``break``)
         cancels the request and releases its slot immediately.
         """
+        # prefix matching reads token values: capture the HOST input before
+        # any device conversion — np.asarray on a device-resident prompt
+        # would cost a device→host round trip per admission.  Computed
+        # unconditionally (cheap) so a prefix registered while this request
+        # waits for a slot still finds valid host ids.
+        host_ids = (
+            None
+            if isinstance(prompt_ids, jax.Array)
+            else np.asarray(prompt_ids, np.int32).reshape(-1)
+        )
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None, :]
@@ -233,14 +316,39 @@ class LLMEngine:
             return
         slot = await self._acquire_slot()
         try:
-            # bucketed prefill (right-padding is exact under causal
-            # attention); logit_pos: only the last true position is
-            # vocab-projected
-            bucket = _bucket(L0)
-            padded = jnp.pad(prompt_ids, ((0, 0), (0, bucket - L0)))
-            logits, small = self._prefill_for(bucket)(
-                self.params, padded, logit_pos=L0 - 1
+            # prefix set is re-checked AFTER slot acquisition: a prefix may
+            # have been registered while this request waited in the queue
+            if self._prefixes and host_ids is None:
+                host_ids = np.asarray(prompt_ids[0])  # device-resident caller
+            pref = (
+                self._match_prefix(tuple(int(t) for t in host_ids))
+                if self._prefixes
+                else None
             )
+            if pref is not None and pref["len"] == L0:
+                # whole prompt is a registered prefix: zero model work
+                logits = pref["logits"]
+                small = {"k": pref["k"], "v": pref["v"]}
+            elif pref is not None:
+                # prefix KV from cache; only the suffix runs (one K-token
+                # decode chunk, padded to a bucket — padded positions come
+                # after the true ones so causality keeps them exact)
+                Lp, Ls = pref["len"], L0 - pref["len"]
+                bs = _bucket(Ls)
+                suffix = np.zeros((1, bs), np.int32)
+                suffix[0, :Ls] = host_ids[Lp:]
+                logits, small = self._extend_for(
+                    pref["k"].shape[2], bs
+                )(self.params, pref["k"], pref["v"], suffix, Lp, Ls - 1)
+            else:
+                # bucketed prefill (right-padding is exact under causal
+                # attention); logit_pos: only the last true position is
+                # vocab-projected
+                bucket = _bucket(L0)
+                padded = jnp.pad(prompt_ids, ((0, 0), (0, bucket - L0)))
+                logits, small = self._prefill_for(bucket)(
+                    self.params, padded, logit_pos=L0 - 1
+                )
             self.cache = self._insert(self.cache, small, slot, true_len=L0)
 
             self._temps[slot] = float(temperature)
@@ -415,8 +523,9 @@ class LLMComponent:
         ids = [int(t) for t in np.asarray(ids, np.int32).reshape(-1)]
         out = list(ids)
         i = 0
+        # host array in: keeps the engine's prefix match host-side
         async for tok in self.engine.stream(
-            jnp.asarray(ids, jnp.int32), n_new, **kw
+            np.asarray(ids, np.int32), n_new, **kw
         ):
             out.append(int(tok))
             yield {"token": int(tok), "i": i}
@@ -428,7 +537,7 @@ class LLMComponent:
 
         ids, n_new, kw = self._parse(msg)
         out = await self.engine.generate(
-            jnp.asarray(ids, jnp.int32), n_new, **kw
+            np.asarray(ids, np.int32).reshape(-1), n_new, **kw
         )
         ids_out = np.asarray(out[0]).tolist()
         return SeldonMessage(

@@ -324,6 +324,15 @@ class ComponentServer:
                 self.handle.name, time.perf_counter() - t0, 499
             )
             return resp
+        except asyncio.CancelledError:
+            # the dominant disconnect timing: aiohttp cancels the handler
+            # while it awaits the next token — same 499 accounting, but the
+            # cancellation must propagate
+            logger.debug("stream cancelled (%s)", self.handle.name)
+            self.metrics.observe_request(
+                self.handle.name, time.perf_counter() - t0, 499
+            )
+            raise
         except Exception as e:
             logger.exception("component %s stream failed", self.handle.name)
             self.metrics.observe_request(

@@ -371,6 +371,92 @@ class TestStreaming:
         asyncio.run(run())
 
 
+class TestPrefixCache:
+    """Prefix caching: registered shared prefixes (system prompts) skip
+    prefill; the suffix extends the cached KV via one K-token decode chunk.
+    The contract is EXACTNESS — identical outputs with and without."""
+
+    def _prompt_with_prefix(self, prefix_len=12, total=16, seed=3):
+        p = prompt(total, seed=seed)
+        return p, np.asarray(p[0, :prefix_len])
+
+    def test_greedy_exactness_with_suffix(self):
+        async def run():
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            p, prefix = self._prompt_with_prefix()
+            want = np.asarray((await base.generate(p, 6))[0])
+
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            eng.register_prefix(prefix)
+            got = np.asarray((await eng.generate(p, 6))[0])
+            np.testing.assert_array_equal(got, want)
+            # the full-prompt prefill was never compiled: only the prefix
+            # bucket (from registration) exists
+            assert set(eng._prefills) == {_bucket(12)}
+            assert (eng._prefixes and eng._extends), "prefix path not taken"
+
+        asyncio.run(run())
+
+    def test_exact_match_runs_zero_model_work(self):
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            p, prefix = self._prompt_with_prefix(prefix_len=12, total=12)
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            want = np.asarray((await base.generate(p, 5))[0])
+            eng.register_prefix(prefix)
+            got = np.asarray((await eng.generate(p, 5))[0])
+            np.testing.assert_array_equal(got, want)
+            assert not eng._extends  # no suffix chunk needed either
+
+        asyncio.run(run())
+
+    def test_sampling_exactness(self):
+        async def run():
+            kw = dict(temperature=1.0, top_k=8, seed=11)
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            p, prefix = self._prompt_with_prefix()
+            want = np.asarray((await base.generate(p, 6, **kw))[0])
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            eng.register_prefix(prefix)
+            got = np.asarray((await eng.generate(p, 6, **kw))[0])
+            np.testing.assert_array_equal(got, want)
+
+        asyncio.run(run())
+
+    def test_longest_prefix_wins(self):
+        eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+        p, _ = self._prompt_with_prefix()
+        ids = tuple(int(t) for t in np.asarray(p[0]))
+        eng.register_prefix(list(ids[:4]))
+        eng.register_prefix(list(ids[:10]))
+        assert eng._match_prefix(ids)["len"] == 10
+        assert eng._match_prefix(ids[:3]) is None  # shorter than any prefix
+
+    def test_non_matching_prompt_uses_normal_prefill(self):
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            eng.register_prefix(np.asarray(prompt(8, seed=5)[0]))
+            other = prompt(8, seed=6)
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            want = np.asarray((await base.generate(other, 4))[0])
+            got = np.asarray((await eng.generate(other, 4))[0])
+            np.testing.assert_array_equal(got, want)
+            assert not eng._extends
+
+        asyncio.run(run())
+
+    def test_validation_and_clear(self):
+        eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=16)
+        with pytest.raises(ValueError, match="empty"):
+            eng.register_prefix([])
+        with pytest.raises(ValueError, match="max_len"):
+            eng.register_prefix(list(range(16)))
+        eng.register_prefix([1, 2, 3])
+        assert eng._prefixes
+        eng.clear_prefixes()
+        assert not eng._prefixes
+
+
 class TestWrappedDeployment:
     """Production path: LLMComponent wrapped by ComponentHandle (the
     load_component/CLI route) must forward message-level methods including
