@@ -319,7 +319,12 @@ class LLMEngine:
             # prefix set is re-checked AFTER slot acquisition: a prefix may
             # have been registered while this request waited in the queue
             if self._prefixes and host_ids is None:
-                host_ids = np.asarray(prompt_ids[0])  # device-resident caller
+                # device-resident caller: fetch OFF the event loop — a
+                # blocking device→host round trip here would stall every
+                # other handler (same reasoning as the tick-loop fetch)
+                host_ids = await asyncio.get_running_loop().run_in_executor(
+                    None, np.asarray, prompt_ids[0]
+                )
             pref = (
                 self._match_prefix(tuple(int(t) for t in host_ids))
                 if self._prefixes

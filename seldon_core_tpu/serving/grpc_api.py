@@ -72,6 +72,14 @@ SERVICE_METHODS: dict[str, dict[str, tuple[Any, Any]]] = {
     },
 }
 
+# server-streaming methods (unary request → response stream), kept separate
+# so _Stub's unary-unary construction stays untouched: prediction.proto
+# Model.Stream — events are jsonData SeldonMessages (SSE-route twin)
+STREAMING_METHODS: dict[str, dict[str, tuple]] = {
+    "Model": {"Stream": (pb.SeldonMessage, pb.SeldonMessage)},
+    "Generic": {"Stream": (pb.SeldonMessage, pb.SeldonMessage)},
+}
+
 # gRPC channel/server options for big tensor payloads; the reference exposes
 # this as the grpc-max-message-size annotation (docs/grpc_max_message_size.md).
 DEFAULT_MAX_MESSAGE_SIZE = 100 * 1024 * 1024
@@ -166,6 +174,39 @@ def _unary_handler(rpc: Any, method: str, req_cls, resp_cls):
     )
 
 
+def _stream_handler(handle: Any, req_cls, resp_cls):
+    """Server-streaming handler over a component's async ``stream(msg)``.
+    Cancellation (client hangup) closes the async generator, which runs the
+    component's cleanup (e.g. LLM slot release) deterministically."""
+
+    async def handler(request_pb, context):
+        msg = message_from_proto(request_pb)
+        agen = handle.stream(msg)
+        try:
+            async for event in agen:
+                yield message_to_proto(SeldonMessage(json_data=event))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.exception("gRPC stream failed")
+            code = getattr(e, "status_code", 500)
+            yield message_to_proto(
+                SeldonMessage(
+                    status=Status.failure(
+                        code, f"{type(e).__name__}: {e}", "INTERNAL"
+                    )
+                )
+            )
+        finally:
+            await agen.aclose()
+
+    return grpc.unary_stream_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
 def component_service_handlers(handle: Any, service_type: str = "") -> list:
     """Generic handlers for a component: registers the role-specific service
     (from ``service_type``) plus ``Generic``, exposing only the methods the
@@ -200,6 +241,7 @@ def component_service_handlers(handle: Any, service_type: str = "") -> list:
     role = role_by_type.get(service_type.upper())
     if role:
         services.add(role)
+    can_stream = callable(getattr(handle, "stream", None))
     out = []
     for svc in sorted(services):
         methods = {
@@ -207,6 +249,9 @@ def component_service_handlers(handle: Any, service_type: str = "") -> list:
             for m, (req, resp) in SERVICE_METHODS[svc].items()
             if supported(m)
         }
+        if can_stream:
+            for m, (req, resp) in STREAMING_METHODS.get(svc, {}).items():
+                methods[m] = _stream_handler(handle, req, resp)
         if methods:
             out.append(
                 grpc.method_handlers_generic_handler(f"{_PKG}.{svc}", methods)
@@ -297,12 +342,21 @@ class GrpcServer:
 
 
 class _Stub:
-    """Hand-rolled stub: unary-unary callables per method path."""
+    """Hand-rolled stub: unary-unary (+ unary-stream) callables per method
+    path."""
 
     def __init__(self, channel: grpc.aio.Channel, service: str):
         self._calls = {}
         for method, (req_cls, resp_cls) in SERVICE_METHODS[service].items():
             self._calls[method] = channel.unary_unary(
+                f"/{_PKG}.{service}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+        for method, (req_cls, resp_cls) in STREAMING_METHODS.get(
+            service, {}
+        ).items():
+            self._calls[method] = channel.unary_stream(
                 f"/{_PKG}.{service}/{method}",
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString,
@@ -342,6 +396,7 @@ class GrpcComponentClient:
             "transform_input",
             "transform_output",
             "send_feedback",
+            "stream",
         }
         self.timeout = timeout_s
         # DeviceTensorRef on the request payload: zero-copy HBM handoff when
@@ -404,6 +459,27 @@ class GrpcComponentClient:
         # so feedback reaches routers/combiners too.
         resp = await self._unary("Generic", "SendFeedback", feedback_to_proto(fb))
         return message_from_proto(resp)
+
+    async def stream(self, msg: SeldonMessage):
+        """Async iterator of event dicts from the server-streaming
+        ``Stream`` RPC (gRPC twin of the REST /stream SSE route).
+        Cancelling/closing the iterator cancels the RPC, which cancels the
+        server-side generator (slot release on LLM components).
+
+        Routed through ``Generic`` — registered for every component role
+        (same reasoning as ``send_feedback``), so non-MODEL streaming
+        components are reachable too."""
+        stub = self._stubs.get("Generic")
+        if stub is None:
+            stub = self._stubs["Generic"] = _Stub(self._channel, "Generic")
+        call = stub.Stream(self._encode(msg))
+        try:
+            async for resp in call:
+                out = message_from_proto(resp)
+                self._ok(out)  # FAILURE event → raise
+                yield out.json_data
+        finally:
+            call.cancel()
 
     @staticmethod
     def _ok(msg: SeldonMessage) -> SeldonMessage:

@@ -391,3 +391,96 @@ class TestDeviceRefsLoopback:
             await client.close()
         finally:
             await server.stop()
+
+
+class TestGrpcStreaming:
+    """Server-streaming Model.Stream: gRPC twin of the REST /stream SSE
+    route — per-token jsonData events from components exposing an async
+    stream(msg) (runtime.llm.LLMComponent)."""
+
+    def _llm_handle(self, max_slots=2):
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+        )
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.runtime.llm import LLMComponent, LLMEngine
+
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                                n_heads=4, d_ff=64, max_seq=64,
+                                dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = LLMEngine(params, cfg, max_slots=max_slots, max_len=32)
+        return ComponentHandle(LLMComponent(eng, n_new=4), name="llm"), eng
+
+    async def test_stream_events_match_predict(self):
+        from seldon_core_tpu.serving.grpc_api import GrpcComponentClient
+
+        handle, _ = self._llm_handle()
+        server, port = await _component_server(handle)
+        try:
+            client = GrpcComponentClient(f"127.0.0.1:{port}")
+            req = SeldonMessage(json_data={"prompt_ids": [5, 9, 2, 7],
+                                           "n_new": 4})
+            events = [e async for e in client.stream(req)]
+            assert len(events) == 5
+            toks = [e["token"] for e in events[:-1]]
+            done = events[-1]
+            assert done["done"] and done["prompt_len"] == 4
+            assert done["ids"] == [5, 9, 2, 7] + toks
+            ref = await client.predict(req)
+            assert ref.json_data["ids"] == done["ids"]
+            await client.close()
+        finally:
+            await server.stop()
+
+    async def test_client_cancel_releases_slot(self):
+        import asyncio as aio
+
+        from seldon_core_tpu.serving.grpc_api import GrpcComponentClient
+
+        handle, eng = self._llm_handle(max_slots=1)
+        server, port = await _component_server(handle)
+        try:
+            client = GrpcComponentClient(f"127.0.0.1:{port}")
+            req = SeldonMessage(json_data={"prompt_ids": [5, 9, 2, 7],
+                                           "n_new": 8})
+            agen = client.stream(req)
+            await agen.__anext__()
+            await agen.__anext__()
+            await agen.aclose()  # cancels the RPC mid-stream
+            for _ in range(100):
+                if eng._free == [0] and not eng._slots:
+                    break
+                await aio.sleep(0.05)
+            assert eng._free == [0] and not eng._slots
+            # the single slot is serviceable again end-to-end
+            events = [e async for e in client.stream(req)]
+            assert events[-1]["done"]
+            await client.close()
+        finally:
+            await server.stop()
+
+    async def test_stream_unsupported_component(self):
+        import grpc as grpc_mod
+
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.grpc_api import GrpcComponentClient
+
+        handle = ComponentHandle(EchoModel(), name="echo",
+                                 service_type="MODEL")
+        server, port = await _component_server(handle)
+        try:
+            client = GrpcComponentClient(f"127.0.0.1:{port}")
+            with pytest.raises(grpc_mod.aio.AioRpcError) as ei:
+                async for _ in client.stream(
+                    SeldonMessage(json_data={"prompt_ids": [1]})
+                ):
+                    pass
+            assert ei.value.code() == grpc_mod.StatusCode.UNIMPLEMENTED
+            await client.close()
+        finally:
+            await server.stop()
