@@ -106,6 +106,16 @@ class LLMEngine:
 
     ``await engine.generate(prompt_ids, n_new)`` → generated ids
     ``[1, L0 + n_new]``.  Greedy by default; per-request temperature.
+
+    With ``draft_params``/``draft_cfg``, ticks run GREEDY SPECULATIVE
+    decoding across all slots at once: the draft proposes ``k_draft``
+    tokens per slot inside one compiled program (``lax.scan``), the target
+    verifies them in one K-token chunk, and each slot accepts its longest
+    agreeing prefix + the target's correction — 1..k_draft+1 tokens per
+    target call, per slot, with per-slot position rewind (free under the
+    pos-masked static cache).  Output is EXACTLY the target's own greedy
+    decode.  Ticks with any sampled (temperature>0) slot active fall back
+    to the normal one-token tick, so sampling semantics are unchanged.
     """
 
     def __init__(
@@ -114,12 +124,32 @@ class LLMEngine:
         cfg: TransformerConfig,
         max_slots: int = 8,
         max_len: Optional[int] = None,
+        draft_params: Optional[dict] = None,
+        draft_cfg: Optional[TransformerConfig] = None,
+        k_draft: int = 4,
     ):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq
-        self.cache = init_cache(cfg, max_slots, max_len=self.max_len)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.k_draft = k_draft
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("draft_params and draft_cfg go together")
+        # speculative verification transiently writes up to k_draft+1 rows
+        # past a slot's true position before the rewind — headroom keeps
+        # dynamic_update_slice from clamping (which would silently corrupt
+        # earlier rows)
+        cache_len = self.max_len + (k_draft + 1 if draft_params is not None
+                                    else 0)
+        self.cache = init_cache(cfg, max_slots, max_len=cache_len)
+        if draft_params is not None:
+            self.draft_cache = init_cache(draft_cfg, max_slots,
+                                          max_len=cache_len)
+            self._spec = jax.jit(self._spec_impl)
+            self._step_sync = jax.jit(self._step_sync_impl)
+            self._draft_prefills: dict[int, Any] = {}
         self._slots: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
         self._slot_waiters: list[asyncio.Future] = []  # FIFO admission
@@ -131,6 +161,10 @@ class LLMEngine:
         self._topk = np.zeros((max_slots,), np.int32)
         self._topp = np.ones((max_slots,), np.float32)
         self._keys = np.zeros((max_slots, 2), np.uint32)
+        # per-slot processed-token count (speculative mode only: positions
+        # are host-owned there because accept/reject rewinds them per slot)
+        self._pos = np.zeros((max_slots,), np.int32)
+        self.spec_stats = {"rounds": 0, "drafted": 0, "accepted": 0}
         self._step = jax.jit(self._step_impl)
         self._sample1 = jax.jit(sample_tokens)
         self._insert = jax.jit(self._insert_impl, static_argnames=("true_len",))
@@ -140,11 +174,56 @@ class LLMEngine:
         self._prefixes: dict[tuple, dict] = {}
         self._extends: dict[tuple, Any] = {}  # (cap0, Bs) -> jitted extend
 
-    def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys):
-        """One decode tick + on-device sampling: logits never leave HBM."""
+    def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys,
+                   pos=None):
+        """One decode tick + on-device sampling: logits never leave HBM.
+        ``pos`` (speculative mode): host-owned per-slot positions override
+        the device-side ones, which go stale after a speculative rewind."""
+        if pos is not None:
+            cache = {**cache, "pos": pos}
         logits, cache = decode_step(params, cache, tok, cfg=self.cfg)
         toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
         return toks, keys, cache
+
+    def _step_sync_impl(self, params, draft_params, t_cache, d_cache, tok,
+                        temps, top_k, top_p, keys, pos):
+        """Plain tick in speculative mode: the draft model steps ALONGSIDE
+        the target on the same token, so greedy slots' draft KV stays in
+        sync through fallback interludes (sampled slot active) — otherwise
+        resumed speculation would draft against zero K/V rows and accept
+        nothing, making it slower than plain decoding."""
+        t_cache = {**t_cache, "pos": pos}
+        d_cache = {**d_cache, "pos": pos}
+        logits, t_cache = decode_step(params, t_cache, tok, cfg=self.cfg)
+        _, d_cache = decode_step(draft_params, d_cache, tok,
+                                 cfg=self.draft_cfg)
+        toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
+        return toks, keys, t_cache, d_cache
+
+    def _spec_impl(self, params, draft_params, t_cache, d_cache, tok, pos):
+        """One speculative tick, fully on device: draft k tokens per slot
+        (scan), verify in one (k+1)-token target chunk, return greedy draft
+        + target tokens for host-side acceptance."""
+        from jax import lax
+
+        t_cache = {**t_cache, "pos": pos}
+        d_cache = {**d_cache, "pos": pos}
+
+        def body(carry, _):
+            d_cache, t = carry
+            dl, d_cache = decode_step(draft_params, d_cache, t,
+                                      cfg=self.draft_cfg)
+            t = jnp.argmax(dl, -1).astype(jnp.int32)
+            return (d_cache, t), t
+
+        (d_cache, _), drafts = lax.scan(
+            body, (d_cache, tok), None, length=self.k_draft
+        )
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [S, k]
+        vtokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        vlogits, t_cache = decode_step(params, t_cache, vtokens, cfg=self.cfg)
+        tgt = jnp.argmax(vlogits, -1).astype(jnp.int32)  # [S, k+1]
+        return drafts, tgt, t_cache, d_cache
 
     # -- prefix caching --------------------------------------------------
     def register_prefix(self, prefix_ids) -> None:
@@ -219,6 +298,14 @@ class LLMEngine:
         if fn is None:
             fn = self._prefills[bucket] = jax.jit(
                 partial(prefill, cfg=self.cfg, max_len=bucket)
+            )
+        return fn
+
+    def _draft_prefill_for(self, bucket: int):
+        fn = self._draft_prefills.get(bucket)
+        if fn is None:
+            fn = self._draft_prefills[bucket] = jax.jit(
+                partial(prefill, cfg=self.draft_cfg, max_len=bucket)
             )
         return fn
 
@@ -355,6 +442,21 @@ class LLMEngine:
                     self.params, padded, logit_pos=L0 - 1
                 )
             self.cache = self._insert(self.cache, small, slot, true_len=L0)
+            self._pos[slot] = L0
+            if self.draft_params is not None and temperature <= 0.0:
+                # the draft model needs its own KV for the whole prompt
+                # (prefix cache entries are target-model state only; the
+                # draft prefill is cheap by construction).  Sampled
+                # requests skip it: speculation never runs while a sampled
+                # slot is active, so its draft KV would be dead work.
+                db = _bucket(L0)
+                dpad = jnp.pad(prompt_ids, ((0, 0), (0, db - L0)))
+                _, d_small = self._draft_prefill_for(db)(
+                    self.draft_params, dpad, logit_pos=L0 - 1
+                )
+                self.draft_cache = self._insert(
+                    self.draft_cache, d_small, slot, true_len=L0
+                )
 
             self._temps[slot] = float(temperature)
             self._topk[slot] = int(top_k)
@@ -441,35 +543,85 @@ class LLMEngine:
                 self._tick_loop()
             )
 
+    async def _plain_tick(self, loop) -> None:
+        # snapshot BEFORE dispatch, by _Slot IDENTITY: a request admitted
+        # to a freed slot while this tick is in flight (slot freed by
+        # completion OR mid-tick stream abandonment) must not receive a
+        # token sampled from the previous occupant's logits row — index
+        # membership alone cannot distinguish re-occupancy
+        active = dict(self._slots)
+        if self.draft_params is not None:
+            # speculative mode: host mirror owns positions (device pos goes
+            # stale after rewinds) and the draft cache steps alongside
+            toks, keys, self.cache, self.draft_cache = self._step_sync(
+                self.params, self.draft_params, self.cache,
+                self.draft_cache, self._tokens, self._temps, self._topk,
+                self._topp, self._keys, self._pos,
+            )
+        else:
+            toks, keys, self.cache = self._step(
+                self.params, self.cache,
+                self._tokens, self._temps, self._topk, self._topp,
+                self._keys,
+            )
+        # one transfer per tick for all slots, OFF the event loop — a
+        # blocking fetch here would stall every other handler (health
+        # probes, new arrivals) for the device round trip.  Only the
+        # sampled token ids + keys cross the device boundary; the
+        # (slots, vocab) logits stay in HBM.
+        host_toks, host_keys = await loop.run_in_executor(
+            None, lambda: (np.asarray(toks), np.asarray(keys))
+        )
+        for slot, st in active.items():
+            if self._slots.get(slot) is not st:
+                continue  # freed (and possibly re-occupied) mid-tick
+            self._keys[slot] = host_keys[slot]
+            self._pos[slot] += 1
+            self._emit(slot, st, int(host_toks[slot]))
+
+    async def _spec_tick(self, loop) -> None:
+        """Speculative tick (all active slots greedy): accept each slot's
+        longest draft/target agreeing prefix + the target correction."""
+        active = dict(self._slots)
+        drafts, tgt, self.cache, self.draft_cache = self._spec(
+            self.params, self.draft_params, self.cache, self.draft_cache,
+            self._tokens, self._pos,
+        )
+        host_d, host_t = await loop.run_in_executor(
+            None, lambda: (np.asarray(drafts), np.asarray(tgt))
+        )
+        k = self.k_draft
+        self.spec_stats["rounds"] += 1
+        for slot, st in active.items():
+            if self._slots.get(slot) is not st:
+                continue
+            d, t = host_d[slot], host_t[slot]
+            n_acc = 0
+            while n_acc < k and d[n_acc] == t[n_acc]:
+                n_acc += 1
+            self.spec_stats["drafted"] += k
+            self.spec_stats["accepted"] += n_acc
+            pos0 = int(self._pos[slot])
+            for tokv in [int(x) for x in d[:n_acc]] + [int(t[n_acc])]:
+                self._emit(slot, st, tokv)
+                if self._slots.get(slot) is not st:
+                    break  # finished mid-chunk (stop/n_new); extra tokens
+                    # discarded, slot freed — pos reset at next admission
+            else:
+                # survived the whole chunk: processed = cur + accepted
+                # drafts; rejected rows are masked by the rewound pos
+                self._pos[slot] = pos0 + 1 + n_acc
+
     async def _tick_loop(self) -> None:
         loop = asyncio.get_running_loop()
         try:
             while self._slots:
-                # snapshot BEFORE dispatch, by _Slot IDENTITY: a request
-                # admitted to a freed slot while this tick is in flight
-                # (slot freed by completion OR mid-tick stream abandonment)
-                # must not receive a token sampled from the previous
-                # occupant's logits row — index membership alone cannot
-                # distinguish re-occupancy
-                active = dict(self._slots)
-                toks, keys, self.cache = self._step(
-                    self.params, self.cache,
-                    self._tokens, self._temps, self._topk, self._topp,
-                    self._keys,
-                )
-                # one transfer per tick for all slots, OFF the event loop —
-                # a blocking fetch here would stall every other handler
-                # (health probes, new arrivals) for the device round trip.
-                # Only the sampled token ids + keys cross the device
-                # boundary; the (slots, vocab) logits stay in HBM.
-                host_toks, host_keys = await loop.run_in_executor(
-                    None, lambda: (np.asarray(toks), np.asarray(keys))
-                )
-                for slot, st in active.items():
-                    if self._slots.get(slot) is not st:
-                        continue  # freed (and possibly re-occupied) mid-tick
-                    self._keys[slot] = host_keys[slot]
-                    self._emit(slot, st, int(host_toks[slot]))
+                if self.draft_params is not None and all(
+                    self._temps[s] <= 0.0 for s in self._slots
+                ):
+                    await self._spec_tick(loop)
+                else:
+                    await self._plain_tick(loop)
                 await asyncio.sleep(0)  # let arrivals join between ticks
         except BaseException as e:
             # a dying tick loop must not strand in-flight requests on

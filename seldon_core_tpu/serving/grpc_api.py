@@ -460,11 +460,17 @@ class GrpcComponentClient:
         resp = await self._unary("Generic", "SendFeedback", feedback_to_proto(fb))
         return message_from_proto(resp)
 
-    async def stream(self, msg: SeldonMessage):
+    async def stream(self, msg: SeldonMessage,
+                     timeout_s: Optional[float] = None):
         """Async iterator of event dicts from the server-streaming
         ``Stream`` RPC (gRPC twin of the REST /stream SSE route).
         Cancelling/closing the iterator cancels the RPC, which cancels the
         server-side generator (slot release on LLM components).
+
+        ``timeout_s`` is a WHOLE-STREAM deadline; the default (None) is
+        deadline-free by design — unlike the unary methods' ``timeout_s``,
+        a generation's duration is workload-defined, so callers that want
+        a bound pass one explicitly.
 
         Routed through ``Generic`` — registered for every component role
         (same reasoning as ``send_feedback``), so non-MODEL streaming
@@ -472,7 +478,7 @@ class GrpcComponentClient:
         stub = self._stubs.get("Generic")
         if stub is None:
             stub = self._stubs["Generic"] = _Stub(self._channel, "Generic")
-        call = stub.Stream(self._encode(msg))
+        call = stub.Stream(self._encode(msg), timeout=timeout_s)
         try:
             async for resp in call:
                 out = message_from_proto(resp)

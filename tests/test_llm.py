@@ -371,6 +371,134 @@ class TestStreaming:
         asyncio.run(run())
 
 
+class TestSpeculativeEngine:
+    """Speculative decoding inside the continuous-batching engine: greedy
+    ticks draft k tokens per slot and verify in one target chunk.  The
+    contract is exactness — identical outputs to a plain engine."""
+
+    DRAFT = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq=64, dtype=jnp.float32,
+    )
+
+    def _spec_engine(self, draft_seed=7, **kw):
+        dparams = init_params(jax.random.PRNGKey(draft_seed), self.DRAFT)
+        return LLMEngine(PARAMS, TINY, max_slots=2, max_len=48,
+                         draft_params=dparams, draft_cfg=self.DRAFT, **kw)
+
+    def test_greedy_equivalence_partial_acceptance(self):
+        async def run():
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            want = np.asarray((await base.generate(prompt(5), 10))[0])
+            eng = self._spec_engine()  # random draft: partial acceptance
+            got = np.asarray((await eng.generate(prompt(5), 10))[0])
+            np.testing.assert_array_equal(got, want)
+            assert eng.spec_stats["rounds"] > 0
+
+        asyncio.run(run())
+
+    def test_perfect_draft_accepts_everything(self):
+        async def run():
+            # draft == target: every draft token verifies; rounds ~ n/(k+1)
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48,
+                            draft_params=PARAMS, draft_cfg=TINY, k_draft=4)
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            want = np.asarray((await base.generate(prompt(5), 10))[0])
+            got = np.asarray((await eng.generate(prompt(5), 10))[0])
+            np.testing.assert_array_equal(got, want)
+            s = eng.spec_stats
+            assert s["accepted"] == s["drafted"]  # perfect acceptance
+            assert s["rounds"] <= 3  # 10 tokens at 5/round (vs 9 plain)
+
+        asyncio.run(run())
+
+    def test_concurrent_mixed_lengths_match_plain(self):
+        async def run():
+            base = LLMEngine(PARAMS, TINY, max_slots=3, max_len=48)
+            dparams = init_params(jax.random.PRNGKey(7), self.DRAFT)
+            eng = LLMEngine(PARAMS, TINY, max_slots=3, max_len=48,
+                            draft_params=dparams, draft_cfg=self.DRAFT)
+            reqs = [(prompt(4, seed=1), 8), (prompt(9, seed=2), 5),
+                    (prompt(6, seed=3), 7), (prompt(5, seed=4), 6)]
+            want = [
+                np.asarray((await base.generate(p, n))[0]) for p, n in reqs
+            ]
+            outs = await asyncio.gather(
+                *(eng.generate(p, n) for p, n in reqs)
+            )
+            for o, w in zip(outs, want):
+                np.testing.assert_array_equal(np.asarray(o[0]), w)
+
+        asyncio.run(run())
+
+    def test_stop_token_mid_chunk(self):
+        async def run():
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            g = np.asarray((await base.generate(prompt(5), 10))[0]).tolist()
+            stop = g[8]  # mid-generation token -> lands inside a chunk
+            want = g[: g.index(stop, 5) + 1]
+            eng = self._spec_engine()
+            got = np.asarray(
+                (await eng.generate(prompt(5), 10, stop_tokens=[stop]))[0]
+            ).tolist()
+            assert got == want
+
+        asyncio.run(run())
+
+    def test_sampled_request_falls_back_and_matches(self):
+        async def run():
+            kw = dict(temperature=1.0, top_k=8, seed=13)
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            want = np.asarray((await base.generate(prompt(5), 8, **kw))[0])
+            eng = self._spec_engine()
+            got = np.asarray((await eng.generate(prompt(5), 8, **kw))[0])
+            np.testing.assert_array_equal(got, want)
+            assert eng.spec_stats["rounds"] == 0  # never speculated
+
+        asyncio.run(run())
+
+    def test_draft_cache_stays_synced_through_fallback(self):
+        """A sampled slot forces plain ticks; during those, the draft cache
+        must advance with the target (draft steps alongside), or resumed
+        speculation drafts against zero K/V.  With draft == target the
+        invariant is sharp: acceptance stays PERFECT after the interlude."""
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48,
+                            draft_params=PARAMS, draft_cfg=TINY, k_draft=3)
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            pg, ps = prompt(5, seed=1), prompt(4, seed=2)
+            want_g = np.asarray((await base.generate(pg, 14))[0])
+            want_s = np.asarray(
+                (await base.generate(ps, 4, temperature=1.0, seed=9))[0]
+            )
+            g, s = await asyncio.gather(
+                eng.generate(pg, 14),
+                eng.generate(ps, 4, temperature=1.0, seed=9),
+            )
+            np.testing.assert_array_equal(np.asarray(g[0]), want_g)
+            np.testing.assert_array_equal(np.asarray(s[0]), want_s)
+            st = eng.spec_stats
+            assert st["rounds"] > 0
+            # perfect draft: every drafted token must verify, INCLUDING the
+            # rounds after the sampled slot's fallback interlude
+            assert st["accepted"] == st["drafted"], st
+
+        asyncio.run(run())
+
+    def test_prefix_cache_composes_with_speculation(self):
+        async def run():
+            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            p = prompt(16, seed=3)
+            want = np.asarray((await base.generate(p, 8))[0])
+            eng = self._spec_engine()
+            eng.register_prefix(np.asarray(p[0, :12]))
+            got = np.asarray((await eng.generate(p, 8))[0])
+            np.testing.assert_array_equal(got, want)
+
+        asyncio.run(run())
+
+
 class TestPrefixCache:
     """Prefix caching: registered shared prefixes (system prompts) skip
     prefill; the suffix extends the cached KV via one K-token decode chunk.
