@@ -255,8 +255,42 @@ def quantize_ffn_params(params: dict, mesh=None) -> dict:
     return out
 
 
+def quantize_attn_params(params: dict) -> dict:
+    """Per-channel int8 attention projections (wq/wk/wv/wo) — completes
+    the weight-quantization story beyond quantize_ffn_params (attention is
+    the remaining ~1/3 of block weight traffic at d4096, less under GQA).
+
+    Single-chip serving only (the pallas kernel cannot be partitioned by
+    GSPMD); composes with ``quantize_ffn_params`` for a fully int8-weight
+    decode path.  Quantized layout is flattened for the 2-D kernel:
+    wq/wk/wv ``(D, heads*d_head)``, wo ``(H*Dh, D)`` — unstacked per layer
+    like the FFN (stacked int8 slicing re-adds the HBM traffic
+    quantization removes; see quantize_ffn_params)."""
+    from seldon_core_tpu.ops.quant import quantize_int8
+
+    blocks = dict(params["blocks"])
+    n_layers = blocks["wq"].shape[0]
+
+    def quant(w, flat_in):
+        qs = [quantize_int8(w[i].reshape(flat_in, -1))
+              for i in range(n_layers)]
+        return {
+            "values": tuple(q.values for q in qs),
+            "scales": tuple(q.scales for q in qs),
+        }
+
+    D = blocks["wq"].shape[1]
+    for name in ("wq", "wk", "wv"):
+        blocks[name] = quant(blocks[name], D)
+    H, Dh = blocks["wo"].shape[1], blocks["wo"].shape[2]
+    blocks["wo"] = quant(blocks["wo"], H * Dh)
+    return {**params, "blocks": blocks}
+
+
 def _has_q8(blocks: dict) -> bool:
-    return _is_q8(blocks.get("w1"))
+    # any quantized leaf (FFN or attention) forces the unstacked per-layer
+    # loop instead of lax.scan over stacked blocks
+    return any(_is_q8(v) for v in blocks.values())
 
 
 def _check_q8_pipeline(params: dict, pp: int) -> None:
@@ -295,6 +329,36 @@ def _q8_matmul(x2, w, out_dtype):
     return int8_matmul(
         x2, QuantizedLinear(w["values"], w["scales"]), out_dtype=out_dtype
     )
+
+
+def _attn_proj(h, w, heads: int, d_head: int, dtype):
+    """QKV projection ``(B, L, D) x (D, heads, d_head)`` with int8
+    dispatch: quantized weights are stored flattened ``(D, heads*d_head)``
+    for the 2-D pallas kernel."""
+    if _is_q8(w):
+        B, L, D = h.shape
+        y = _q8_matmul(h.reshape(B * L, D), w, dtype)
+        return y.reshape(B, L, heads, d_head)
+    return jnp.einsum("bld,dhk->blhk", h, w.astype(dtype))
+
+
+def _attn_out(attn, wo, dtype):
+    """Output projection ``(B, L, H, Dh) x (H, Dh, D)`` with int8 dispatch
+    (quantized layout ``(H*Dh, D)``)."""
+    if _is_q8(wo):
+        B, L, H, Dh = attn.shape
+        y = _q8_matmul(attn.reshape(B * L, H * Dh).astype(dtype), wo, dtype)
+        return y.reshape(B, L, -1)
+    return jnp.einsum("blhk,hkd->bld", attn.astype(dtype), wo.astype(dtype))
+
+
+def _check_q8_attn_single_chip(p, mesh) -> None:
+    if mesh is not None and _is_q8(p.get("wq")):
+        raise ValueError(
+            "int8 attention projections are single-chip serving only "
+            "(the pallas kernel cannot be partitioned by GSPMD; FFN/lm_head "
+            "tp-sharding goes through quantize_ffn_params(mesh=...))"
+        )
 
 
 # ----------------------------------------------------------------------
@@ -372,13 +436,14 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
     sequence is sharded; the cache layout assumes whole sequences).
     """
     c = _constrainer(mesh)
+    _check_q8_attn_single_chip(p, mesh)
     h = rmsnorm(x, p["ln1"])
     if cfg.attention != "ring":
         # SP: norm ran on sequence shards; gather sequence for the matmuls
         h = c(h, "dp", None, None)
-    q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(x.dtype))
-    k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(x.dtype))
-    v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(x.dtype))
+    q = _attn_proj(h, p["wq"], cfg.n_heads, cfg.d_head, x.dtype)
+    k = _attn_proj(h, p["wk"], cfg.kv_heads, cfg.d_head, x.dtype)
+    v = _attn_proj(h, p["wv"], cfg.kv_heads, cfg.d_head, x.dtype)
     q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
     kv_cache = (k, v)  # pre-expansion: the KV cache stores kv_heads only
     k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
@@ -423,7 +488,7 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
                 )(q, k, v)
         else:
             attn = dense_attention(q, k, v, causal=True)
-    out = jnp.einsum("blhk,hkd->bld", attn, p["wo"].astype(x.dtype))
+    out = _attn_out(attn, p["wo"], x.dtype)
     # SP: reduce-scatter the row-parallel output back to sequence shards
     out = c(out, "dp", _seq_axis(cfg) if cfg.attention != "ring" else None, None)
     if return_kv:
@@ -680,10 +745,11 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
     T = cache["k"].shape[2]
     for i in range(cfg.n_layers):
         p = _layer_params(params["blocks"], i)
+        _check_q8_attn_single_chip(p, mesh)
         h = rmsnorm(x, p["ln1"])
-        q = jnp.einsum("bld,dhk->blhk", h, p["wq"].astype(x.dtype))
-        k = jnp.einsum("bld,dhk->blhk", h, p["wk"].astype(x.dtype))
-        v = jnp.einsum("bld,dhk->blhk", h, p["wv"].astype(x.dtype))
+        q = _attn_proj(h, p["wq"], cfg.n_heads, cfg.d_head, x.dtype)
+        k = _attn_proj(h, p["wk"], cfg.kv_heads, cfg.d_head, x.dtype)
+        v = _attn_proj(h, p["wv"], cfg.kv_heads, cfg.d_head, x.dtype)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         kc = jax.vmap(
@@ -713,8 +779,7 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
         a = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bhglm,bmhk->blhgk", a, vc.astype(a.dtype))
         attn = attn.reshape(B, K, cfg.n_heads, cfg.d_head)
-        x = x + jnp.einsum("blhk,hkd->bld", attn.astype(x.dtype),
-                           p["wo"].astype(x.dtype))
+        x = x + _attn_out(attn, p["wo"], x.dtype)
         x, _ = ffn_block(p, x, cfg, mesh)
 
     x = rmsnorm(x, params["ln_f"])

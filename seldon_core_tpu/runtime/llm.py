@@ -216,10 +216,15 @@ class LLMEngine:
             t = jnp.argmax(dl, -1).astype(jnp.int32)
             return (d_cache, t), t
 
+        # k_draft + 1 steps: the extra step processes d_{k-1} so its draft
+        # KV row is WRITTEN — on full acceptance the rewound position counts
+        # that row as valid, and a never-written row there would leave a
+        # permanent zero the draft attends over forever after, decaying
+        # acceptance round by round.  Its proposed token is discarded.
         (d_cache, _), drafts = lax.scan(
-            body, (d_cache, tok), None, length=self.k_draft
+            body, (d_cache, tok), None, length=self.k_draft + 1
         )
-        drafts = jnp.moveaxis(drafts, 0, 1)  # [S, k]
+        drafts = jnp.moveaxis(drafts, 0, 1)[:, : self.k_draft]  # [S, k]
         vtokens = jnp.concatenate([tok[:, None], drafts], axis=1)
         vlogits, t_cache = decode_step(params, t_cache, vtokens, cfg=self.cfg)
         tgt = jnp.argmax(vlogits, -1).astype(jnp.int32)  # [S, k+1]
@@ -293,19 +298,13 @@ class LLMEngine:
         return fn
 
     # -- device programs -------------------------------------------------
-    def _prefill_for(self, bucket: int):
-        fn = self._prefills.get(bucket)
+    def _prefill_for(self, bucket: int, draft: bool = False):
+        memo = self._draft_prefills if draft else self._prefills
+        fn = memo.get(bucket)
         if fn is None:
-            fn = self._prefills[bucket] = jax.jit(
-                partial(prefill, cfg=self.cfg, max_len=bucket)
-            )
-        return fn
-
-    def _draft_prefill_for(self, bucket: int):
-        fn = self._draft_prefills.get(bucket)
-        if fn is None:
-            fn = self._draft_prefills[bucket] = jax.jit(
-                partial(prefill, cfg=self.draft_cfg, max_len=bucket)
+            fn = memo[bucket] = jax.jit(
+                partial(prefill, cfg=self.draft_cfg if draft else self.cfg,
+                        max_len=bucket)
             )
         return fn
 
@@ -436,9 +435,10 @@ class LLMEngine:
                 # bucketed prefill (right-padding is exact under causal
                 # attention); logit_pos: only the last true position is
                 # vocab-projected
-                bucket = _bucket(L0)
-                padded = jnp.pad(prompt_ids, ((0, 0), (0, bucket - L0)))
-                logits, small = self._prefill_for(bucket)(
+                padded = jnp.pad(
+                    prompt_ids, ((0, 0), (0, _bucket(L0) - L0))
+                )
+                logits, small = self._prefill_for(_bucket(L0))(
                     self.params, padded, logit_pos=L0 - 1
                 )
             self.cache = self._insert(self.cache, small, slot, true_len=L0)
@@ -449,10 +449,12 @@ class LLMEngine:
                 # draft prefill is cheap by construction).  Sampled
                 # requests skip it: speculation never runs while a sampled
                 # slot is active, so its draft KV would be dead work.
-                db = _bucket(L0)
-                dpad = jnp.pad(prompt_ids, ((0, 0), (0, db - L0)))
-                _, d_small = self._draft_prefill_for(db)(
-                    self.draft_params, dpad, logit_pos=L0 - 1
+                if pref is not None:  # prefix path didn't build the pad
+                    padded = jnp.pad(
+                        prompt_ids, ((0, 0), (0, _bucket(L0) - L0))
+                    )
+                _, d_small = self._prefill_for(_bucket(L0), draft=True)(
+                    self.draft_params, padded, logit_pos=L0 - 1
                 )
                 self.draft_cache = self._insert(
                     self.draft_cache, d_small, slot, true_len=L0

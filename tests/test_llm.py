@@ -457,6 +457,26 @@ class TestSpeculativeEngine:
 
         asyncio.run(run())
 
+    def test_no_draft_kv_holes_after_full_acceptance(self):
+        """On full acceptance the rewound position counts row pos+k as
+        valid — the draft scan must have WRITTEN it (k+1 steps).  A hole
+        there is attended over forever after, silently decaying acceptance
+        with real models; white-box check: every draft KV row below the
+        final position is non-zero."""
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=1, max_len=48,
+                            draft_params=PARAMS, draft_cfg=TINY, k_draft=4)
+            await eng.generate(prompt(5), 12)
+            assert eng.spec_stats["accepted"] == eng.spec_stats["drafted"]
+            k = np.asarray(eng.draft_cache["k"])  # (layers, 1, T, H, Dh)
+            upto = int(eng._pos[0])
+            assert upto >= 5 + 10  # prompt + most of the generation
+            norms = np.abs(k[:, 0, :upto]).sum(axis=(0, 2, 3))
+            assert (norms > 0).all(), np.where(norms == 0)[0]
+
+        asyncio.run(run())
+
     def test_draft_cache_stays_synced_through_fallback(self):
         """A sampled slot forces plain ticks; during those, the draft cache
         must advance with the target (draft steps alongside), or resumed
