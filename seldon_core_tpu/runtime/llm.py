@@ -678,27 +678,72 @@ class LLMComponent:
         """Async generator of SSE-able events: one ``{"token": t, "i": i}``
         per generated token, then ``{"done": true, "ids": [...],
         "prompt_len": L0}``."""
+        import time
+
         ids, n_new, kw = self._parse(msg)
         ids = [int(t) for t in np.asarray(ids, np.int32).reshape(-1)]
         out = list(ids)
         i = 0
+        t0 = time.perf_counter()
+        ttft_ms = None
         # host array in: keeps the engine's prefix match host-side
         async for tok in self.engine.stream(
             np.asarray(ids, np.int32), n_new, **kw
         ):
+            if ttft_ms is None:
+                ttft_ms = (time.perf_counter() - t0) * 1000.0
             out.append(int(tok))
             yield {"token": int(tok), "i": i}
             i += 1
-        yield {"done": True, "ids": out, "prompt_len": len(ids)}
+        dt = time.perf_counter() - t0
+        yield {
+            "done": True, "ids": out, "prompt_len": len(ids),
+            "n_generated": i,
+            "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
+            "duration_ms": round(dt * 1000.0, 3),
+            # reserved key: streaming servers merge these into their
+            # Prometheus registry (streams have no response meta channel)
+            "metrics": [m.to_dict() for m in self._request_metrics(i, dt)],
+        }
 
     async def predict(self, msg):
-        from seldon_core_tpu.messages import SeldonMessage
+        import time
+
+        from seldon_core_tpu.messages import Meta, SeldonMessage
 
         ids, n_new, kw = self._parse(msg)
-        out = await self.engine.generate(
-            np.asarray(ids, np.int32).reshape(-1), n_new, **kw
-        )
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        t0 = time.perf_counter()
+        out = await self.engine.generate(ids, n_new, **kw)
+        dt = time.perf_counter() - t0
         ids_out = np.asarray(out[0]).tolist()
+        n_gen = len(ids_out) - len(ids)
         return SeldonMessage(
-            json_data={"ids": ids_out, "prompt_len": len(ids)}
+            json_data={"ids": ids_out, "prompt_len": len(ids)},
+            meta=Meta(metrics=self._request_metrics(n_gen, dt)),
         )
+
+    def _request_metrics(self, n_gen: int, seconds: float):
+        """Per-request serving metrics, flowing through the standard custom
+        COUNTER/GAUGE/TIMER passthrough (reference docs/custom_metrics.md
+        semantics) into the engine's Prometheus registry."""
+        from seldon_core_tpu.messages import Metric, MetricType
+
+        out = [
+            Metric("seldon_llm_tokens_generated_total", MetricType.COUNTER,
+                   float(n_gen)),
+            Metric("seldon_llm_generate_duration_ms", MetricType.TIMER,
+                   seconds * 1000.0),
+        ]
+        if n_gen > 0 and seconds > 0:
+            out.append(
+                Metric("seldon_llm_tokens_per_second", MetricType.GAUGE,
+                       n_gen / seconds)
+            )
+        st = self.engine.spec_stats
+        if self.engine.draft_params is not None and st["drafted"]:
+            out.append(
+                Metric("seldon_llm_spec_accept_rate", MetricType.GAUGE,
+                       st["accepted"] / st["drafted"])
+            )
+        return out

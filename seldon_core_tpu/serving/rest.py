@@ -309,6 +309,16 @@ class ComponentServer:
         agen = self.handle.stream(msg)
         try:
             async for event in agen:
+                # streams have no response meta: the reserved "metrics" key
+                # on an event is the custom-metric passthrough equivalent
+                if isinstance(event, dict) and event.get("metrics"):
+                    from seldon_core_tpu.runtime.component import (
+                        validate_metrics,
+                    )
+
+                    self.metrics.merge_custom(
+                        self.handle.name, validate_metrics(event["metrics"])
+                    )
                 await resp.write(
                     b"data: " + json.dumps(event).encode() + b"\n\n"
                 )

@@ -371,6 +371,99 @@ class TestStreaming:
         asyncio.run(run())
 
 
+class TestServingMetrics:
+    """Per-request LLM metrics flow through the custom COUNTER/GAUGE/TIMER
+    passthrough into the component server's Prometheus registry, and the
+    stream done-event carries client-visible latency stats."""
+
+    def test_predict_metrics_reach_prometheus_scrape(self):
+        import json as _json
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.serving.rest import build_app
+
+        eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+        comp = LLMComponent(eng, n_new=5)
+        app = build_app(component=comp)
+
+        async def run():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                p = np.asarray(prompt(4)[0]).tolist()
+                body = {"json": _json.dumps(
+                    {"jsonData": {"prompt_ids": p, "n_new": 5}})}
+                r = await client.post("/predict", data=body)
+                assert r.status == 200
+                meta = (await r.json())["meta"]
+                keys = {m["key"] for m in meta["metrics"]}
+                assert "seldon_llm_tokens_generated_total" in keys
+                assert "seldon_llm_generate_duration_ms" in keys
+                scrape = await (await client.get("/metrics")).text()
+                assert "seldon_llm_tokens_generated_total" in scrape
+                assert "seldon_llm_tokens_per_second" in scrape
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_stream_metrics_merge_into_scrape(self):
+        """Streaming must not undercount: the done-event metrics merge into
+        the REST server's registry like predict's meta metrics do."""
+        import json as _json
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.serving.rest import build_app
+
+        eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+        app = build_app(component=LLMComponent(eng, n_new=4))
+
+        async def run():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                p = np.asarray(prompt(4)[0]).tolist()
+                body = {"json": _json.dumps(
+                    {"jsonData": {"prompt_ids": p, "n_new": 4}})}
+                async with client.post("/stream", data=body) as r:
+                    async for _ in r.content:
+                        pass
+                scrape = await (await client.get("/metrics")).text()
+                line = [l for l in scrape.splitlines()
+                        if l.startswith("seldon_llm_tokens_generated_total{")]
+                assert line and float(line[0].split()[-1]) == 4.0, line
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_stream_done_event_latency_stats(self):
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+            comp = LLMComponent(eng, n_new=4)
+            from seldon_core_tpu.messages import SeldonMessage
+
+            msg = SeldonMessage(json_data={
+                "prompt_ids": np.asarray(prompt(4)[0]).tolist(), "n_new": 4,
+            })
+            events = [e async for e in comp.stream(msg)]
+            done = events[-1]
+            assert done["n_generated"] == 4
+            assert 0 < done["ttft_ms"] <= done["duration_ms"]
+
+        asyncio.run(run())
+
+    def test_catalog_covers_llm_metrics(self):
+        from seldon_core_tpu.utils import analytics
+
+        names = {m.name for m in analytics.CATALOG}
+        assert {"seldon_llm_tokens_generated_total",
+                "seldon_llm_generate_duration_ms",
+                "seldon_llm_spec_accept_rate"} <= names
+
+
 class TestSpeculativeEngine:
     """Speculative decoding inside the continuous-batching engine: greedy
     ticks draft k tokens per slot and verify in one target chunk.  The
