@@ -1,0 +1,72 @@
+"""Deployable demo LLM: the continuous-batching engine behind the
+standard graph/model_class boot path.
+
+The reference boots user classes from CRD parameters
+(``wrappers/python/microservice.py:209-216``); this class makes the LLM
+stack deployable the same way — an example graph names it via the
+``model_class`` parameter and sizes it with plain JSON parameters (see
+``examples/graphs/llm.json``).  Weights are seeded (no checkpoint
+download in examples); real deployments construct ``LLMEngine`` from an
+orbax checkpoint instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    quantize_attn_params,
+    quantize_ffn_params,
+)
+from seldon_core_tpu.runtime.llm import LLMComponent, LLMEngine
+
+
+class DemoLLM(LLMComponent):
+    """Seeded transformer served with continuous batching.
+
+    Parameters (CRD ``parameters[]``): model shape (``d_model``,
+    ``n_layers``, ``n_heads``, ``n_kv_heads``, ``d_ff``, ``vocab_size``,
+    ``max_seq``), serving knobs (``max_slots``, ``n_new``), ``int8``
+    ("none" | "ffn" | "full") weight quantization, and ``seed``.
+    """
+
+    def __init__(
+        self,
+        d_model: int = 64,
+        n_layers: int = 2,
+        n_heads: int = 4,
+        n_kv_heads: int = 0,
+        d_ff: int = 128,
+        vocab_size: int = 256,
+        max_seq: int = 128,
+        max_slots: int = 4,
+        n_new: int = 16,
+        int8: str = "none",
+        seed: int = 0,
+        dtype: str = "float32",
+    ):
+        cfg = TransformerConfig(
+            vocab_size=vocab_size,
+            d_model=d_model,
+            n_layers=n_layers,
+            n_heads=n_heads,
+            n_kv_heads=n_kv_heads or None,
+            d_ff=d_ff,
+            max_seq=max_seq,
+            dtype=jnp.dtype(dtype),
+        )
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        if int8 in ("ffn", "full"):
+            params = quantize_ffn_params(params)
+        if int8 == "full":
+            params = quantize_attn_params(params)
+        super().__init__(
+            LLMEngine(params, cfg, max_slots=max_slots), n_new=n_new
+        )
+        self.name = "llm"
+
+    def tags(self):
+        return {"model": "demo-llm", "engine": "continuous-batching"}

@@ -701,8 +701,11 @@ class LLMComponent:
             "n_generated": i,
             "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
             "duration_ms": round(dt * 1000.0, 3),
-            # reserved key: streaming servers merge these into their
-            # Prometheus registry (streams have no response meta channel)
+            # reserved key: the REST/SSE server merges these into its
+            # Prometheus registry (streams have no response meta channel);
+            # gRPC streaming forwards them to the CLIENT in this event —
+            # the gRPC component server wires no registry (same as its
+            # unary custom-metric scope)
             "metrics": [m.to_dict() for m in self._request_metrics(i, dt)],
         }
 
@@ -718,9 +721,14 @@ class LLMComponent:
         dt = time.perf_counter() - t0
         ids_out = np.asarray(out[0]).tolist()
         n_gen = len(ids_out) - len(ids)
+        meta = Meta(metrics=self._request_metrics(n_gen, dt))
+        # passthrough components own their response meta, so tags() must be
+        # applied here (ComponentHandle only collects it on the adapted path)
+        tags_fn = getattr(self, "tags", None)
+        if callable(tags_fn):
+            meta.tags.update(tags_fn() or {})
         return SeldonMessage(
-            json_data={"ids": ids_out, "prompt_len": len(ids)},
-            meta=Meta(metrics=self._request_metrics(n_gen, dt)),
+            json_data={"ids": ids_out, "prompt_len": len(ids)}, meta=meta
         )
 
     def _request_metrics(self, n_gen: int, seconds: float):
@@ -732,7 +740,7 @@ class LLMComponent:
         out = [
             Metric("seldon_llm_tokens_generated_total", MetricType.COUNTER,
                    float(n_gen)),
-            Metric("seldon_llm_generate_duration_ms", MetricType.TIMER,
+            Metric("seldon_llm_generate_duration_seconds", MetricType.TIMER,
                    seconds * 1000.0),
         ]
         if n_gen > 0 and seconds > 0:

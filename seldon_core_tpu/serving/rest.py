@@ -310,15 +310,24 @@ class ComponentServer:
         try:
             async for event in agen:
                 # streams have no response meta: the reserved "metrics" key
-                # on an event is the custom-metric passthrough equivalent
+                # on an event is the custom-metric passthrough equivalent.
+                # Tolerant: a malformed value on a user component's event
+                # must not abort a healthy stream mid-generation.
                 if isinstance(event, dict) and event.get("metrics"):
                     from seldon_core_tpu.runtime.component import (
                         validate_metrics,
                     )
 
-                    self.metrics.merge_custom(
-                        self.handle.name, validate_metrics(event["metrics"])
-                    )
+                    try:
+                        self.metrics.merge_custom(
+                            self.handle.name,
+                            validate_metrics(event["metrics"]),
+                        )
+                    except Exception:
+                        logger.warning(
+                            "ignoring malformed stream-event metrics from %s",
+                            self.handle.name,
+                        )
                 await resp.write(
                     b"data: " + json.dumps(event).encode() + b"\n\n"
                 )

@@ -105,12 +105,19 @@ class FeatureDef:
 class Contract:
     features: List[FeatureDef] = field(default_factory=list)
     targets: List[FeatureDef] = field(default_factory=list)
+    # "tensor" (default): responses must carry tensor data matching the
+    # targets.  "json": the component legitimately answers with
+    # jsonData/strData/binData (e.g. LLM token output) — declared
+    # explicitly so a tensor deployment that wrongly returns jsonData
+    # still FAILS validation.
+    response_type: str = "tensor"
 
     @classmethod
     def from_dict(cls, d: dict) -> "Contract":
         return cls(
             features=[FeatureDef.from_dict(f) for f in _expand(d.get("features", []))],
             targets=[FeatureDef.from_dict(f) for f in _expand(d.get("targets", []))],
+            response_type=d.get("response_type", "tensor"),
         )
 
     @classmethod
@@ -239,6 +246,12 @@ def validate_response(contract: Contract, response: dict) -> List[str]:
     problems: List[str] = []
     data = response.get("data")
     if data is None:
+        # only contracts that DECLARE a json response accept non-tensor
+        # payloads — a tensor deployment wrongly returning jsonData fails
+        if contract.response_type == "json" and any(
+            k in response for k in ("jsonData", "strData", "binData")
+        ):
+            return problems
         st = response.get("status") or {}
         problems.append(
             f"no data in response (status={st.get('status')}: {st.get('info')})"

@@ -60,6 +60,27 @@ def test_mnist_example_boots_with_batching():
     assert np.asarray(out.host_data()).shape == (1, 10)
 
 
+def test_llm_example_boots_and_generates():
+    """The LLM serving stack through the standard deployment path:
+    model_class boot, message-level passthrough, fully int8-quantized
+    weights, continuous-batching engine behind a plain MODEL node."""
+    local = boot("llm.json")
+    ids = np.array([[5, 9, 2, 7, 1]], np.int32)
+    out = predict(local, SeldonMessage.from_ndarray(ids))
+    body = out.json_data
+    assert body["prompt_len"] == 5
+    assert len(body["ids"]) == 5 + 8  # n_new=8 from parameters
+    assert body["ids"][:5] == [5, 9, 2, 7, 1]
+    # jsonData request form with per-request sampling
+    out2 = predict(
+        local,
+        SeldonMessage(json_data={"prompt_ids": [5, 9, 2, 7, 1], "n_new": 3,
+                                 "temperature": 1.0, "seed": 4}),
+    )
+    assert len(out2.json_data["ids"]) == 8
+    assert out.meta.tags.get("model") == "demo-llm"
+
+
 def test_resnet50_example_boots():
     local = boot("resnet50-v5e8.json")
     x = np.zeros((1, 224, 224, 3), np.float32)
@@ -293,3 +314,6 @@ class TestContractDrivenSocketPath:
 
     def test_ensemble(self):
         self._drive("ensemble.json", "ensemble.json", n=2)
+
+    def test_llm(self):
+        self._drive("llm.json", "llm.json", n=2)

@@ -399,7 +399,7 @@ class TestServingMetrics:
                 meta = (await r.json())["meta"]
                 keys = {m["key"] for m in meta["metrics"]}
                 assert "seldon_llm_tokens_generated_total" in keys
-                assert "seldon_llm_generate_duration_ms" in keys
+                assert "seldon_llm_generate_duration_seconds" in keys
                 scrape = await (await client.get("/metrics")).text()
                 assert "seldon_llm_tokens_generated_total" in scrape
                 assert "seldon_llm_tokens_per_second" in scrape
@@ -460,7 +460,7 @@ class TestServingMetrics:
 
         names = {m.name for m in analytics.CATALOG}
         assert {"seldon_llm_tokens_generated_total",
-                "seldon_llm_generate_duration_ms",
+                "seldon_llm_generate_duration_seconds",
                 "seldon_llm_spec_accept_rate"} <= names
 
 
