@@ -45,6 +45,7 @@ class DemoLLM(LLMComponent):
         max_slots: int = 4,
         n_new: int = 16,
         int8: str = "none",
+        chunk_prefill: int = 0,
         seed: int = 0,
         dtype: str = "float32",
     ):
@@ -64,7 +65,9 @@ class DemoLLM(LLMComponent):
         if int8 == "full":
             params = quantize_attn_params(params)
         super().__init__(
-            LLMEngine(params, cfg, max_slots=max_slots), n_new=n_new
+            LLMEngine(params, cfg, max_slots=max_slots,
+                      chunk_prefill=chunk_prefill),
+            n_new=n_new,
         )
         self.name = "llm"
 

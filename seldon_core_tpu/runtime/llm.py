@@ -127,6 +127,7 @@ class LLMEngine:
         draft_params: Optional[dict] = None,
         draft_cfg: Optional[TransformerConfig] = None,
         k_draft: int = 4,
+        chunk_prefill: int = 0,
     ):
         self.params = params
         self.cfg = cfg
@@ -135,6 +136,12 @@ class LLMEngine:
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.k_draft = k_draft
+        # Sarathi-style chunked prefill: admissions longer than this many
+        # tokens extend their cache chunk-by-chunk (each chunk one K-token
+        # decode program) with an event-loop yield between chunks, so
+        # in-flight decode ticks interleave with the prefill instead of
+        # stalling behind one monolithic device program.  0 = off.
+        self.chunk_prefill = int(chunk_prefill)
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("draft_params and draft_cfg go together")
         # speculative verification transiently writes up to k_draft+1 rows
@@ -297,6 +304,47 @@ class LLMEngine:
             fn = self._extends[(cap0, b_suffix)] = jax.jit(extend)
         return fn
 
+    async def _chunked_prefill(self, prompt_ids, L0: int):
+        """Prefill a long prompt in ``chunk_prefill``-token pieces, yielding
+        the event loop between chunks so in-flight decode ticks interleave
+        instead of stalling behind one monolithic prefill program
+        (continuous-batching prefill/decode interference control).
+
+        Exact: chunk i extends the accumulated 1-row KV cache with one
+        K-token decode program — identical math to the prefix-cache suffix
+        extension, applied repeatedly.  Returns ``(last-position logits,
+        cache)`` like the monolithic prefill."""
+        C = self.chunk_prefill
+        first = min(C, L0)
+        b0 = _bucket(first)
+        padded = jnp.pad(prompt_ids[:, :first], ((0, 0), (0, b0 - first)))
+        logits, small = self._prefill_for(b0)(
+            self.params, padded, logit_pos=first - 1
+        )
+        if first == L0:
+            return logits, small
+        return await self._extend_chunks(small, first, prompt_ids, L0)
+
+    async def _extend_chunks(self, small, done: int, prompt_ids, L0: int):
+        """Extend an accumulated 1-row KV cache (``done`` tokens processed)
+        to the full prompt in chunk_prefill-token pieces, yielding the
+        event loop before each chunk; also the long-suffix path after a
+        prefix-cache hit."""
+        C = self.chunk_prefill
+        logits = None
+        while done < L0:
+            await asyncio.sleep(0)  # decode ticks dispatch between chunks
+            n = min(C, L0 - done)
+            bs = _bucket(n)
+            chunk = jnp.pad(
+                prompt_ids[:, done : done + n], ((0, 0), (0, bs - n))
+            )
+            logits, small = self._extend_for(small["k"].shape[2], bs)(
+                self.params, small["k"], small["v"], chunk, done, n - 1
+            )
+            done += n
+        return logits, small
+
     # -- device programs -------------------------------------------------
     def _prefill_for(self, bucket: int, draft: bool = False):
         memo = self._draft_prefills if draft else self._prefills
@@ -416,11 +464,14 @@ class LLMEngine:
                 if self._prefixes
                 else None
             )
+            chunking = self.chunk_prefill and L0 > self.chunk_prefill
             if pref is not None and pref["len"] == L0:
                 # whole prompt is a registered prefix: zero model work
                 logits = pref["logits"]
                 small = {"k": pref["k"], "v": pref["v"]}
-            elif pref is not None:
+            elif pref is not None and not (
+                chunking and L0 - pref["len"] > self.chunk_prefill
+            ):
                 # prefix KV from cache; only the suffix runs (one K-token
                 # decode chunk, padded to a bucket — padded positions come
                 # after the true ones so causality keeps them exact)
@@ -431,6 +482,16 @@ class LLMEngine:
                 logits, small = self._extend_for(
                     pref["k"].shape[2], bs
                 )(self.params, pref["k"], pref["v"], suffix, Lp, Ls - 1)
+            elif pref is not None:
+                # long suffix after a prefix hit: chunk it too — a prefix
+                # registration (an optimization) must not reintroduce the
+                # monolithic-prefill decode stall for everyone else
+                logits, small = await self._extend_chunks(
+                    {"k": pref["k"], "v": pref["v"]}, pref["len"],
+                    prompt_ids, L0,
+                )
+            elif chunking:
+                logits, small = await self._chunked_prefill(prompt_ids, L0)
             else:
                 # bucketed prefill (right-padding is exact under causal
                 # attention); logit_pos: only the last true position is
@@ -449,12 +510,11 @@ class LLMEngine:
                 # draft prefill is cheap by construction).  Sampled
                 # requests skip it: speculation never runs while a sampled
                 # slot is active, so its draft KV would be dead work.
-                if pref is not None:  # prefix path didn't build the pad
-                    padded = jnp.pad(
-                        prompt_ids, ((0, 0), (0, _bucket(L0) - L0))
-                    )
+                dpad = jnp.pad(
+                    prompt_ids, ((0, 0), (0, _bucket(L0) - L0))
+                )
                 _, d_small = self._prefill_for(_bucket(L0), draft=True)(
-                    self.draft_params, padded, logit_pos=L0 - 1
+                    self.draft_params, dpad, logit_pos=L0 - 1
                 )
                 self.draft_cache = self._insert(
                     self.draft_cache, d_small, slot, true_len=L0
