@@ -141,8 +141,12 @@ def _chained_ms(fn, x, n: int = 32, overhead_probe: bool = True) -> float:
         assert r == r
         return time.perf_counter() - t0
 
-    base = timed(1) if overhead_probe else 0.0
-    total = timed(n + (1 if overhead_probe else 0))
+    # min-of-2 on BOTH probes: tunnel hiccups only ever ADD time, and an
+    # inflated n=1 probe makes the subtraction claim impossibly fast chip
+    # time (a >100% MFU was observed from a single inflated base probe)
+    base = min(timed(1), timed(1)) if overhead_probe else 0.0
+    n_total = n + (1 if overhead_probe else 0)
+    total = min(timed(n_total), timed(n_total))
     # clamp: when per-iter chip time << dispatch jitter (~tens of ms over
     # the tunnel) the subtraction can go negative — report a floor instead
     # of a nonsense negative
@@ -166,10 +170,27 @@ def bench_resnet50(batches=(64, 256)) -> dict:
         )
         ms = _chained_ms(lambda c: m.module.apply(m.params, c), x, n=16)
         img_s = batch / ms * 1000.0
-        out["sweep"][str(batch)] = {
+        # physical sanity: >95% MFU on a conv net means the measurement was
+        # jitter-corrupted — re-measure (bounded retries, conservative max)
+        # and flag the point if the invariant still doesn't hold
+        suspect = False
+        for _ in range(3):
+            if img_s * RESNET50_GFLOPS / 1e3 / V5E_PEAK_TFLOPS <= 0.95:
+                break
+            ms = max(
+                ms,
+                _chained_ms(lambda c: m.module.apply(m.params, c), x, n=16),
+            )
+            img_s = batch / ms * 1000.0
+        else:
+            suspect = True
+        point = {
             "ms_per_batch": round(ms, 2),
             "img_per_s": round(img_s),
         }
+        if suspect:
+            point["measurement_suspect"] = True
+        out["sweep"][str(batch)] = point
         if img_s > best[0]:
             best = (img_s, batch)
     out["img_per_s"] = round(best[0])
