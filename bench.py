@@ -171,19 +171,20 @@ def bench_resnet50(batches=(64, 256)) -> dict:
         ms = _chained_ms(lambda c: m.module.apply(m.params, c), x, n=16)
         img_s = batch / ms * 1000.0
         # physical sanity: >95% MFU on a conv net means the measurement was
-        # jitter-corrupted — re-measure (bounded retries, conservative max)
-        # and flag the point if the invariant still doesn't hold
-        suspect = False
+        # jitter-corrupted — re-measure (bounded, conservative max), and
+        # flag the point if the invariant STILL doesn't hold afterwards
+        def mfu(v):
+            return v * RESNET50_GFLOPS / 1e3 / V5E_PEAK_TFLOPS
+
         for _ in range(3):
-            if img_s * RESNET50_GFLOPS / 1e3 / V5E_PEAK_TFLOPS <= 0.95:
+            if mfu(img_s) <= 0.95:
                 break
             ms = max(
                 ms,
                 _chained_ms(lambda c: m.module.apply(m.params, c), x, n=16),
             )
             img_s = batch / ms * 1000.0
-        else:
-            suspect = True
+        suspect = mfu(img_s) > 0.95
         point = {
             "ms_per_batch": round(ms, 2),
             "img_per_s": round(img_s),
@@ -191,8 +192,13 @@ def bench_resnet50(batches=(64, 256)) -> dict:
         if suspect:
             point["measurement_suspect"] = True
         out["sweep"][str(batch)] = point
-        if img_s > best[0]:
+        # a still-suspect point must never set the headline numbers
+        if img_s > best[0] and not suspect:
             best = (img_s, batch)
+    if best[1] is None:  # every point suspect: report, but say so
+        b = max(out["sweep"], key=lambda k: out["sweep"][k]["img_per_s"])
+        best = (out["sweep"][b]["img_per_s"], int(b))
+        out["measurement_suspect"] = True
     out["img_per_s"] = round(best[0])
     out["batch"] = best[1]
     out["mfu_pct"] = round(

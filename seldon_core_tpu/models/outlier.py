@@ -1,0 +1,91 @@
+"""Online Mahalanobis outlier detector — first-class OUTLIER_DETECTOR.
+
+Reference behavior target: ``examples/transformers/outlier_mahalanobis/
+OutlierMahalanobis.py`` (streaming mean/covariance, per-row outlier score
+tagged into ``meta.tags["outlierScore"]`` by the wrapper,
+``wrappers/python/outlier_detector_microservice.py:16-89``).  Redesigned:
+Welford/outer-product running moments with shrinkage regularization
+instead of the reference's rolling-PCA subspace — simpler, numerically
+robust at small n, and exactly invertible.
+
+A learning component: state (count/mean/second moment) evolves with
+traffic and round-trips through the persistence protocol
+(``get_state``/``set_state``), so it checkpoint/restores like the MAB
+router (reference persisted via Redis pickle).
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+class MahalanobisOutlier:
+    """Per-row squared Mahalanobis distance to the running distribution.
+
+    Scores are computed against the state BEFORE the row updates it, so a
+    batch's scores don't depend on its own rows' order of incorporation
+    beyond the running update (first ``warmup`` rows score 0.0 — no stable
+    covariance yet).
+    """
+
+    def __init__(self, warmup: int = 10, shrinkage: float = 1e-2):
+        self.warmup = int(warmup)
+        self.shrinkage = float(shrinkage)
+        self.n = 0
+        self.mean: np.ndarray | None = None
+        self.m2: np.ndarray | None = None  # sum of centered outer products
+
+    # ---- scoring (OUTLIER_DETECTOR contract) --------------------------
+    def score(self, X, feature_names):
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        scores = np.zeros(X.shape[0])
+        for i, x in enumerate(X):
+            scores[i] = self._score_one(x)
+            self._update(x)
+        return scores
+
+    def _score_one(self, x: np.ndarray) -> float:
+        if self.n < max(self.warmup, 2):
+            return 0.0
+        cov = self.m2 / (self.n - 1)
+        # shrinkage toward the diagonal keeps the inverse stable when
+        # features are collinear or n is small
+        diag = np.diag(np.diag(cov)) + np.eye(len(x)) * 1e-9
+        cov = (1 - self.shrinkage) * cov + self.shrinkage * diag
+        d = x - self.mean
+        try:
+            return float(d @ np.linalg.solve(cov, d))
+        except np.linalg.LinAlgError:
+            return 0.0
+
+    def _update(self, x: np.ndarray) -> None:
+        if self.mean is None:
+            self.mean = np.zeros_like(x)
+            self.m2 = np.zeros((len(x), len(x)))
+        self.n += 1
+        delta = x - self.mean
+        self.mean = self.mean + delta / self.n
+        self.m2 = self.m2 + np.outer(delta, x - self.mean)
+
+    # ---- persistence protocol (runtime/persistence.py) ----------------
+    def get_state(self) -> bytes:
+        buf = io.BytesIO()
+        np.savez(
+            buf, n=self.n,
+            mean=self.mean if self.mean is not None else np.zeros(0),
+            m2=self.m2 if self.m2 is not None else np.zeros((0, 0)),
+        )
+        return buf.getvalue()
+
+    def set_state(self, blob: bytes) -> None:
+        data = np.load(io.BytesIO(blob))
+        self.n = int(data["n"])
+        self.mean = data["mean"] if data["mean"].size else None
+        self.m2 = data["m2"] if data["m2"].size else None
+
+    def tags(self):
+        return {"detector": "mahalanobis", "observed": self.n}

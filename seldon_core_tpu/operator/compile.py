@@ -369,6 +369,13 @@ def _distributed_predictor(
                 {"name": "PREDICTIVE_UNIT_ID", "value": unit.name},
                 {"name": "PREDICTOR_ID", "value": p.name},
                 {"name": "SELDON_DEPLOYMENT_ID", "value": dep.name},
+                # runtime service-type refinement beyond the CRD node type
+                # (reference s2i SERVICE_TYPE env; e.g. OUTLIER_DETECTOR
+                # behind a TRANSFORMER node) — the microservice CLI reads
+                # this env, mirroring operator/local.py resolve_component
+                {"name": "SERVICE_TYPE",
+                 "value": str(unit.parameters.get("service_type",
+                                                  unit.resolved_type))},
             ]
         )
         labels = {**_common_labels(dep, p), "seldon-app": name}

@@ -51,9 +51,17 @@ def resolve_component(
     model_class = unit.parameters.get("model_class")
     if model_class:
         mod_name, _, cls_name = model_class.partition(":")
-        params = {k: v for k, v in unit.parameters.items() if k != "model_class"}
+        params = {k: v for k, v in unit.parameters.items()
+                  if k not in ("model_class", "service_type")}
+        # a node may refine its runtime service type beyond the CRD node
+        # type — the reference does this with the container SERVICE_TYPE
+        # env (e.g. an OUTLIER_DETECTOR behind a TRANSFORMER graph node,
+        # s2i `assemble`/`run` contract)
+        service_type = unit.parameters.get(
+            "service_type", unit.resolved_type
+        )
         handle = load_component(
-            mod_name, cls_name or None, params, service_type=unit.resolved_type
+            mod_name, cls_name or None, params, service_type=service_type
         )
         handle.name = unit.name
         if unit.resolved_type == "MODEL" and _batching_enabled(ann):

@@ -60,6 +60,32 @@ def test_mnist_example_boots_with_batching():
     assert np.asarray(out.host_data()).shape == (1, 10)
 
 
+def test_iris_outlier_example_tags_scores():
+    """Outlier detector in front of the classifier (reference
+    seldon-single-model chart's optional outlier transformer +
+    outlier_mahalanobis example): per-row scores tagged, classification
+    unaffected, online state grows with traffic."""
+    local = boot("iris-with-outlier.json")
+    rng = np.random.default_rng(0)
+    normal = np.asarray([5.0, 3.4, 1.5, 0.2])
+    # warm the running distribution with plausible traffic
+    for _ in range(4):
+        batch = normal + rng.normal(0, 0.2, size=(3, 4))
+        out = predict(local, SeldonMessage.from_ndarray(
+            batch.astype(np.float32)))
+    assert "outlierScore" in out.meta.tags
+    assert out.meta.tags["detector"] == "mahalanobis"
+    # an absurd observation must score far above normal traffic
+    probe = np.vstack([normal, [50.0, -30.0, 99.0, 42.0]]).astype(np.float32)
+    out = predict(local, SeldonMessage.from_ndarray(probe))
+    s_norm, s_out = out.meta.tags["outlierScore"]
+    assert s_out > 100 * max(s_norm, 1e-6), (s_norm, s_out)
+    # classification still flows through unchanged shape-wise
+    probs = np.asarray(out.host_data())
+    assert probs.shape == (2, 3)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+
+
 def test_llm_example_boots_and_generates():
     """The LLM serving stack through the standard deployment path:
     model_class boot, message-level passthrough, fully int8-quantized

@@ -78,3 +78,44 @@ def test_resnet50_tiny_forward():
     probs = np.asarray(out.data)
     assert probs.shape == (2, 10)
     np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+
+
+class TestMahalanobisOutlier:
+    def test_scores_separate_outliers(self):
+        from seldon_core_tpu.models.outlier import MahalanobisOutlier
+
+        det = MahalanobisOutlier(warmup=5)
+        rng = np.random.default_rng(1)
+        base = rng.normal(0, 1, size=(50, 4))
+        det.score(base, [])
+        s = det.score(np.vstack([rng.normal(0, 1, (1, 4)),
+                                 np.full((1, 4), 40.0)]), [])
+        assert s[1] > 100 * max(s[0], 1e-6)
+
+    def test_warmup_rows_score_zero(self):
+        from seldon_core_tpu.models.outlier import MahalanobisOutlier
+
+        det = MahalanobisOutlier(warmup=10)
+        s = det.score(np.ones((3, 4)), [])
+        np.testing.assert_array_equal(s, [0.0, 0.0, 0.0])
+
+    def test_state_roundtrip_through_persistence_protocol(self):
+        """The detector is a learning component: its running moments must
+        survive a checkpoint/restore exactly (reference persisted learning
+        components via Redis pickle; ours uses the get_state/set_state
+        blob protocol)."""
+        from seldon_core_tpu.models.outlier import MahalanobisOutlier
+
+        rng = np.random.default_rng(2)
+        det = MahalanobisOutlier(warmup=5)
+        det.score(rng.normal(0, 1, size=(30, 4)), [])
+
+        restored = MahalanobisOutlier(warmup=5)
+        restored.set_state(det.get_state())
+        assert restored.n == det.n
+        np.testing.assert_allclose(restored.mean, det.mean)
+        probe = rng.normal(0, 1, size=(4, 4))
+        np.testing.assert_allclose(
+            restored.score(probe.copy(), []),
+            det.score(probe.copy(), []),
+        )

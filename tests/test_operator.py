@@ -304,3 +304,34 @@ class TestMultihostRuntime:
             assert env["TPU_COORDINATOR_ADDRESS"] == (
                 f"{name}-0.{name}-hosts.default.svc.cluster.local:8476"
             )
+
+
+def test_service_type_refinement_reaches_container_env():
+    """A node's service_type parameter (e.g. OUTLIER_DETECTOR behind a
+    TRANSFORMER graph node) must reach split-pod containers as the
+    SERVICE_TYPE env the microservice CLI reads — otherwise the
+    containerized deployment silently diverges from the colocated engine
+    (reference s2i SERVICE_TYPE contract)."""
+    import json as _json
+    import os
+
+    from seldon_core_tpu.operator.compile import compile_deployment
+    from seldon_core_tpu.operator.local import load_deployment_file
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "graphs", "iris-with-outlier.json")
+    with open(path) as f:
+        dep = _json.load(f)
+    dep["spec"]["annotations"]["seldon.io/colocate-graph"] = "false"
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as t:
+        _json.dump(dep, t)
+    objs = compile_deployment(load_deployment_file(t.name))
+    envs = {
+        c["name"]: {e["name"]: e["value"] for e in c.get("env", [])}
+        for o in objs if o["kind"] == "Deployment"
+        for c in o["spec"]["template"]["spec"]["containers"]
+    }
+    assert envs["outlier-detector"]["SERVICE_TYPE"] == "OUTLIER_DETECTOR"
+    assert envs["classifier"]["SERVICE_TYPE"] == "MODEL"
