@@ -76,6 +76,7 @@ class Gateway:
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_post("/oauth/token", self._handle_token)
         app.router.add_post("/api/v0.1/predictions", self._handle_predict)
+        app.router.add_post("/api/v0.1/stream", self._handle_stream)
         app.router.add_post("/api/v0.1/feedback", self._handle_feedback)
         app.router.add_get("/ready", self._handle_ready)
         app.router.add_get("/live", self._handle_ready)
@@ -182,6 +183,100 @@ class Gateway:
 
     async def _handle_predict(self, request: web.Request) -> web.Response:
         return await self._forward(request, "/api/v0.1/predictions")
+
+    async def _handle_stream(self, request: web.Request) -> web.StreamResponse:
+        """Streaming proxy: auth → engine ``/api/v0.1/stream`` → chunks
+        relayed to the client as they arrive (no buffering, no firehose —
+        SSE events are not request/response pairs).  Retries only apply
+        before the engine connection is established; once bytes flow a
+        failure terminates the stream (SSE convention)."""
+        t0 = time.perf_counter()
+        principal = self._principal(request)
+        if principal is None:
+            return web.json_response(
+                {"error": "invalid_token",
+                 "error_description": "missing or expired bearer token"},
+                status=401,
+            )
+        rec = self.store.by_oauth_key(principal)
+        if rec is None or not rec.engine_url:
+            return web.json_response(
+                {"status": {"code": 404, "status": "FAILURE",
+                            "info": f"no deployment for client {principal}"}},
+                status=404,
+            )
+        body = await request.read()
+        sess = await self.session()
+        # pre-connection retry, same safety argument as _forward: a
+        # ClientConnectorError provably never reached the engine
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                await asyncio.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+                self.registry.counter_inc(
+                    "seldon_api_gateway_retries_total",
+                    {"deployment": rec.name, "path": "/api/v0.1/stream"},
+                )
+            try:
+                return await self._relay_stream(request, rec, sess, body, t0)
+            except aiohttp.ClientConnectorError as e:
+                last_err = e
+        return web.json_response(
+            {"status": {"code": 503, "status": "FAILURE",
+                        "info": f"engine unreachable: {last_err}"}},
+            status=503,
+        )
+
+    async def _relay_stream(self, request, rec, sess, body,
+                            t0) -> web.StreamResponse:
+        try:
+            async with sess.post(
+                rec.engine_url.rstrip("/") + "/api/v0.1/stream",
+                data=body,
+                headers={"Content-Type": request.headers.get(
+                    "Content-Type", "application/json")},
+                # the shared session's 30 s total timeout would kill any
+                # generation longer than that MID-STREAM — streams are
+                # deadline-free by design (connect failures still bounded)
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10),
+            ) as engine_resp:
+                if engine_resp.content_type != "text/event-stream":
+                    # pre-stream error (e.g. 501 STREAM_UNSUPPORTED): pass
+                    # the JSON through with its status
+                    return web.Response(
+                        body=await engine_resp.read(),
+                        status=engine_resp.status,
+                        content_type="application/json",
+                    )
+                out = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                    }
+                )
+                await out.prepare(request)
+                try:
+                    async for chunk in engine_resp.content.iter_any():
+                        await out.write(chunk)
+                    await out.write_eof()
+                except (ConnectionError, OSError):
+                    pass  # client or engine went away mid-stream; closing
+                    # the engine response cancels the upstream generation
+                return out
+        except aiohttp.ClientConnectorError:
+            raise  # retried by the caller (never reached the engine)
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"status": {"code": 503, "status": "FAILURE",
+                            "info": f"engine unreachable: {e}"}},
+                status=503,
+            )
+        finally:
+            self.registry.observe(
+                "seldon_api_server_ingress_seconds",
+                time.perf_counter() - t0,
+                {"deployment": rec.name, "path": "/api/v0.1/stream"},
+            )
 
     async def _handle_feedback(self, request: web.Request) -> web.Response:
         return await self._forward(request, "/api/v0.1/feedback")

@@ -260,6 +260,28 @@ class GraphEngine:
     # ------------------------------------------------------------------
     # feedback
     # ------------------------------------------------------------------
+    def stream(self, request: SeldonMessage):
+        """Async generator of events from a STREAMING graph.
+
+        Defined for graphs whose root is a single streaming node (e.g. an
+        LLM MODEL) — streaming through routers/combiners/transformers has
+        no defined composition semantics, so anything else raises a 501
+        SeldonComponentError the servers map to the wire.  Meta enrichment
+        happens in the events themselves (the component's done-event
+        carries ids/latency/metrics)."""
+        impl = self.root.impl
+        fn = getattr(impl, "stream", None)
+        has = getattr(impl, "has", None)
+        declared = (not callable(has)) or has("stream")
+        if not callable(fn) or self.root.children or not declared:
+            raise SeldonComponentError(
+                f"graph {self.name!r} is not streamable (root must be a "
+                "single streaming node)",
+                status_code=501,
+                reason="STREAM_UNSUPPORTED",
+            )
+        return fn(request)
+
     async def send_feedback(self, fb: Feedback) -> SeldonMessage:
         """Reward propagation (``PredictiveUnitBean.java:174-211``): replay
         the routing recorded in ``response.meta.routing`` down the exact

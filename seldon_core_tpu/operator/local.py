@@ -172,6 +172,11 @@ class LocalDeployment:
     async def predict(self, msg):
         return await self.pick().engine.predict(msg)
 
+    def stream(self, msg):
+        """Token streaming through the predictor split (one predictor is
+        picked per stream, same weighting as predict)."""
+        return self.pick().engine.stream(msg)
+
     async def send_feedback(self, fb):
         # feedback goes to every predictor (each replays its own routing)
         out = None

@@ -117,6 +117,46 @@ class RemoteComponent:
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         return self._decode(await self._post("/predict", self._encode(msg)))
 
+    async def stream(self, msg: SeldonMessage):
+        """Consume the remote component's SSE ``/stream`` route as an async
+        generator of event dicts — so an out-of-process streaming component
+        (split-pod LLM) streams through the engine exactly like an
+        in-process one.  Deadline-free by design (generation length is
+        workload-defined); connect failures still time out."""
+        sess = await self._sess()
+        try:
+            async with sess.post(
+                f"{self.base_url}/stream",
+                json=self._encode(msg),
+                headers={"Content-Type": "application/json"},
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                              sock_read=None),
+            ) as resp:
+                if resp.content_type != "text/event-stream":
+                    raise SeldonComponentError(
+                        f"{self.name}/stream -> HTTP {resp.status} "
+                        "(remote has no stream route?)",
+                        501 if resp.status == 404 else resp.status,
+                        "STREAM_UNSUPPORTED" if resp.status == 404
+                        else "TRANSPORT",
+                    )
+                async for line in resp.content:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    event = _json.loads(line[6:])
+                    if isinstance(event, dict) and set(event) == {"error"}:
+                        # remote mid-stream failure event (rest.py SSE
+                        # convention) → surface as an exception here
+                        raise SeldonComponentError(
+                            event["error"], 500, "STREAM"
+                        )
+                    yield event
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            raise SeldonComponentError(
+                f"{self.name}/stream transport error: {e}", 503, "TRANSPORT"
+            )
+
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
         return self._decode(await self._post("/transform-input", self._encode(msg)))
 

@@ -147,6 +147,25 @@ def _msg_op(summary: str, body_schema: str = "SeldonMessage",
     }
 
 
+def _stream_op(summary: str, secured: bool = False) -> dict:
+    """SSE streaming path object (shared by gateway/engine/component
+    specs so the stream contract cannot drift between surfaces)."""
+    op = {
+        "summary": summary,
+        "tags": ["predict"],
+        "requestBody": _msg_op("", tags=[])["requestBody"],
+        "responses": {
+            "200": {"description": "text/event-stream of JSON events; "
+                                   "final event has done=true",
+                    "content": {"text/event-stream": {}}},
+            "501": {"description": "graph is not streamable"},
+        },
+    }
+    if secured:
+        op["security"] = [{"bearerAuth": []}]
+    return op
+
+
 def _ops_paths() -> dict:
     text_ok = {"200": {"description": "OK", "content": {"text/plain": {}}}}
     return {
@@ -190,6 +209,13 @@ def gateway_spec() -> dict:
                                tags=["predict"]),
                      "security": [{"bearerAuth": []}]},
         },
+        "/api/v0.1/stream": {
+            "post": _stream_op(
+                "SSE token streaming proxied to the deployment "
+                "(501 when the graph is not streamable)",
+                secured=True,
+            )
+        },
         **_ops_paths(),
     }
     return {
@@ -218,6 +244,11 @@ def engine_spec() -> dict:
         "/api/v0.1/feedback": {
             "post": _msg_op("propagate reward feedback down the graph",
                             "Feedback", tags=["predict"])},
+        "/api/v0.1/stream": {
+            "post": _stream_op(
+                "SSE token streaming (graphs whose root is a single "
+                "streaming node; 501 otherwise)"
+            )},
         "/pause": {"get": {"summary": "stop accepting (pre-drain)",
                            "tags": ["ops"],
                            "responses": {"200": {"description": "paused"}}}},

@@ -80,6 +80,89 @@ def _parse_msg(d: dict) -> SeldonMessage:
         )
 
 
+async def _sse_stream(
+    request: web.Request, stream_fn, metrics, name: str
+) -> web.StreamResponse:
+    """Shared server-sent-events writer over an async-generator factory.
+
+    ``stream_fn(msg)`` returns the event generator; raising
+    SeldonComponentError BEFORE the first event maps to a JSON error
+    response (headers not yet sent).  Each event is one JSON object; the
+    final event carries ``{"done": true, ...}``.  Errors mid-stream emit
+    an ``error`` event and end the stream (headers are already on the
+    wire, so a status rewrite is impossible — SSE convention).  The
+    reserved ``metrics`` key on an event merges into the Prometheus
+    registry (streams have no response meta channel); client disconnects
+    close the generator deterministically (slot release on LLM engines)
+    and count as 499.
+    """
+    from seldon_core_tpu.runtime.component import (
+        SeldonComponentError,
+        validate_metrics,
+    )
+
+    msg = _parse_msg(await _payload_json(request))
+    try:
+        agen = stream_fn(msg)
+    except SeldonComponentError as e:
+        return web.Response(
+            text=_err_json(e.status_code, str(e), e.reason),
+            content_type="application/json",
+            status=e.status_code if 400 <= e.status_code < 600 else 500,
+        )
+    resp = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        }
+    )
+    await resp.prepare(request)
+    t0 = time.perf_counter()
+    try:
+        async for event in agen:
+            if isinstance(event, dict) and event.get("metrics"):
+                try:
+                    metrics.merge_custom(
+                        name, validate_metrics(event["metrics"])
+                    )
+                except Exception:
+                    logger.warning(
+                        "ignoring malformed stream-event metrics from %s",
+                        name,
+                    )
+            await resp.write(
+                b"data: " + json.dumps(event).encode() + b"\n\n"
+            )
+        metrics.observe_request(name, time.perf_counter() - t0)
+    except (ConnectionError, OSError):
+        logger.debug("stream client disconnected (%s)", name)
+        metrics.observe_request(name, time.perf_counter() - t0, 499)
+        return resp
+    except asyncio.CancelledError:
+        # the dominant disconnect timing: aiohttp cancels the handler
+        # while it awaits the next token
+        logger.debug("stream cancelled (%s)", name)
+        metrics.observe_request(name, time.perf_counter() - t0, 499)
+        raise
+    except Exception as e:
+        logger.exception("stream failed (%s)", name)
+        metrics.observe_request(name, time.perf_counter() - t0, 500)
+        err = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            await resp.write(b"data: " + json.dumps(err).encode() + b"\n\n")
+        except (ConnectionError, OSError):
+            pass
+    finally:
+        # explicit aclose: an abandoned async generator would otherwise
+        # only finalize at GC time, leaving ghost work running
+        await agen.aclose()
+    try:
+        await resp.write_eof()
+    except (ConnectionError, OSError):
+        pass
+    return resp
+
+
 class EngineServer:
     """Serves one predictor graph (GraphEngine) over REST."""
 
@@ -115,6 +198,29 @@ class EngineServer:
         if self.metrics is not None:
             self.metrics.observe_request(self.name, time.perf_counter() - t0, code)
         return _msg_response(out)
+
+    async def stream(self, request: web.Request) -> web.StreamResponse:
+        """External streaming API: SSE events from a streaming graph
+        (root = single streaming node, e.g. an LLM MODEL).  Non-streamable
+        graphs answer 501 STREAM_UNSUPPORTED as JSON."""
+        if self.paused:
+            return web.Response(
+                status=503, text=_err_json(503, "paused"),
+                content_type="application/json",
+            )
+        fn = getattr(self.engine, "stream", None)
+        if fn is None:
+            return web.Response(
+                status=501,
+                text=_err_json(501, "engine does not support streaming",
+                               "STREAM_UNSUPPORTED"),
+                content_type="application/json",
+            )
+        self._inflight += 1
+        try:
+            return await _sse_stream(request, fn, self.metrics, self.name)
+        finally:
+            self._inflight -= 1
 
     async def feedback(self, request: web.Request) -> web.Response:
         payload = await _payload_json(request)
@@ -191,6 +297,7 @@ class EngineServer:
 
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
+        app.router.add_post("/api/v0.1/stream", self.stream)
         app.router.add_post("/api/v1.0/predictions", self.predictions)  # alias
         app.router.add_post("/api/v0.1/feedback", self.feedback)
         app.router.add_get("/ready", self.ready)
@@ -292,88 +399,10 @@ class ComponentServer:
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent-events token streaming for components exposing an
-        async-generator ``stream(msg)`` (e.g. runtime.llm.LLMComponent).
-        Each event is one JSON object; the final event carries
-        ``{"done": true, ...}``.  Errors mid-stream emit an ``error`` event
-        and end the stream (headers are already on the wire, so a status
-        rewrite is impossible — SSE convention)."""
-        msg = _parse_msg(await _payload_json(request))
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-            }
+        async-generator ``stream(msg)`` (e.g. runtime.llm.LLMComponent)."""
+        return await _sse_stream(
+            request, self.handle.stream, self.metrics, self.handle.name
         )
-        await resp.prepare(request)
-        t0 = time.perf_counter()
-        agen = self.handle.stream(msg)
-        try:
-            async for event in agen:
-                # streams have no response meta: the reserved "metrics" key
-                # on an event is the custom-metric passthrough equivalent.
-                # Tolerant: a malformed value on a user component's event
-                # must not abort a healthy stream mid-generation.
-                if isinstance(event, dict) and event.get("metrics"):
-                    from seldon_core_tpu.runtime.component import (
-                        validate_metrics,
-                    )
-
-                    try:
-                        self.metrics.merge_custom(
-                            self.handle.name,
-                            validate_metrics(event["metrics"]),
-                        )
-                    except Exception:
-                        logger.warning(
-                            "ignoring malformed stream-event metrics from %s",
-                            self.handle.name,
-                        )
-                await resp.write(
-                    b"data: " + json.dumps(event).encode() + b"\n\n"
-                )
-            self.metrics.observe_request(
-                self.handle.name, time.perf_counter() - t0
-            )
-        except (ConnectionError, OSError):
-            # client went away mid-stream; the finally below closes the
-            # generator DETERMINISTICALLY (its own finally releases the
-            # engine slot) — not a component failure, count as cancelled
-            logger.debug("stream client disconnected (%s)", self.handle.name)
-            self.metrics.observe_request(
-                self.handle.name, time.perf_counter() - t0, 499
-            )
-            return resp
-        except asyncio.CancelledError:
-            # the dominant disconnect timing: aiohttp cancels the handler
-            # while it awaits the next token — same 499 accounting, but the
-            # cancellation must propagate
-            logger.debug("stream cancelled (%s)", self.handle.name)
-            self.metrics.observe_request(
-                self.handle.name, time.perf_counter() - t0, 499
-            )
-            raise
-        except Exception as e:
-            logger.exception("component %s stream failed", self.handle.name)
-            self.metrics.observe_request(
-                self.handle.name, time.perf_counter() - t0, 500
-            )
-            err = {"error": f"{type(e).__name__}: {e}"}
-            try:
-                await resp.write(
-                    b"data: " + json.dumps(err).encode() + b"\n\n"
-                )
-            except (ConnectionError, OSError):
-                pass
-        finally:
-            # explicit aclose: an abandoned async generator would otherwise
-            # only finalize at GC time, leaving the ghost request decoding
-            # and its slot blocked for an unbounded interval
-            await agen.aclose()
-        try:
-            await resp.write_eof()
-        except (ConnectionError, OSError):
-            pass
-        return resp
 
     async def health(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")

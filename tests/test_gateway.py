@@ -8,6 +8,7 @@ the OAuth flow in util/loadtester/scripts/predict_rest_locust.py:70-80.
 import asyncio
 import base64
 import json
+import os
 
 import numpy as np
 import pytest
@@ -413,3 +414,110 @@ class TestForwardRetry:
             await gw.close()
 
         asyncio.run(run())
+
+
+class TestStreamingProxy:
+    """Gateway /api/v0.1/stream: auth + chunk-relay to the engine's SSE
+    endpoint — the external boundary of the LLM streaming path."""
+
+    async def _llm_engine_app(self):
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+        )
+        from seldon_core_tpu.operator.local import (
+            LocalDeployment,
+            load_deployment_file,
+        )
+        from seldon_core_tpu.serving.rest import build_app
+
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "graphs", "llm.json")
+        local = LocalDeployment(load_deployment_file(path), seed=0)
+        return build_app(engine=local, metrics=local.metrics), local
+
+    async def test_stream_through_gateway(self):
+        app, local = await self._llm_engine_app()
+        engine = TestClient(TestServer(app))
+        await engine.start_server()
+        gw, client, _ = await make_gateway(
+            engine_url=f"http://127.0.0.1:{engine.port}"
+        )
+        try:
+            token = await get_token(client)
+            body = {"jsonData": {"prompt_ids": [5, 9, 2, 7], "n_new": 4}}
+            events = []
+            async with client.post(
+                "/api/v0.1/stream", json=body,
+                headers={"Authorization": f"Bearer {token}"},
+            ) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"] == "text/event-stream"
+                async for line in r.content:
+                    if line.startswith(b"data: "):
+                        events.append(json.loads(line[6:]))
+            assert len(events) == 5
+            done = events[-1]
+            assert done["done"] and done["prompt_len"] == 4
+            toks = [e["token"] for e in events[:-1]]
+            assert done["ids"] == [5, 9, 2, 7] + toks
+            # identical to the engine's own predict through the gateway
+            pr = await client.post(
+                "/api/v0.1/predictions", json=body,
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert (await pr.json())["jsonData"]["ids"] == done["ids"]
+        finally:
+            await client.close()
+            await engine.close()
+
+    async def test_stream_requires_auth(self):
+        app, _ = await self._llm_engine_app()
+        engine = TestClient(TestServer(app))
+        await engine.start_server()
+        gw, client, _ = await make_gateway(
+            engine_url=f"http://127.0.0.1:{engine.port}"
+        )
+        try:
+            r = await client.post(
+                "/api/v0.1/stream",
+                json={"jsonData": {"prompt_ids": [1], "n_new": 2}},
+            )
+            assert r.status == 401
+        finally:
+            await client.close()
+            await engine.close()
+
+    async def test_non_streamable_graph_501_passthrough(self):
+        from seldon_core_tpu.operator.local import (
+            LocalDeployment,
+            load_deployment_file,
+        )
+        from seldon_core_tpu.serving.rest import build_app
+
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "graphs", "iris.json")
+        local = LocalDeployment(load_deployment_file(path), seed=0)
+        engine = TestClient(
+            TestServer(build_app(engine=local, metrics=local.metrics))
+        )
+        await engine.start_server()
+        gw, client, _ = await make_gateway(
+            engine_url=f"http://127.0.0.1:{engine.port}"
+        )
+        try:
+            token = await get_token(client)
+            r = await client.post(
+                "/api/v0.1/stream",
+                json={"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert r.status == 501
+            body = await r.json()
+            assert body["status"]["reason"] == "STREAM_UNSUPPORTED"
+        finally:
+            await client.close()
+            await engine.close()

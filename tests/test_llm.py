@@ -903,6 +903,64 @@ class TestWrappedDeployment:
         assert "/stream" in openapi.component_spec(stream=True)["paths"]
 
 
+def test_remote_component_streams_through_engine():
+    """Split-pod streaming: engine root = RemoteComponent → component
+    server over a real socket; GraphEngine.stream relays the remote SSE
+    events, byte-identical to streaming the component directly."""
+
+    async def run():
+        from seldon_core_tpu.graph.engine import GraphEngine
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.client import RemoteComponent
+        from seldon_core_tpu.serving.rest import build_app, start_server
+
+        eng_llm = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32)
+        comp = LLMComponent(eng_llm, n_new=4)
+        runner = await start_server(
+            build_app(component=ComponentHandle(comp, name="llm")),
+            "127.0.0.1", 0,
+        )
+        port = runner.addresses[0][1]
+        remote = RemoteComponent(f"http://127.0.0.1:{port}", name="llm")
+        graph = GraphEngine({"name": "llm", "type": "MODEL"},
+                            resolver=lambda u: remote)
+        try:
+            from seldon_core_tpu.messages import SeldonMessage
+
+            p = np.asarray(prompt(4)[0]).tolist()
+            msg = SeldonMessage(json_data={"prompt_ids": p, "n_new": 4})
+            events = [e async for e in graph.stream(msg)]
+            assert events[-1]["done"]
+            direct = [e async for e in comp.stream(msg)]
+            # same ids (events carry latency stats that legitimately differ)
+            assert events[-1]["ids"] == direct[-1]["ids"]
+            assert [e["token"] for e in events[:-1]] == [
+                e["token"] for e in direct[:-1]
+            ]
+        finally:
+            await remote.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_non_streaming_remote_root_is_501():
+    """A remote root whose declared methods exclude stream answers 501
+    up front instead of failing mid-SSE."""
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.runtime.component import SeldonComponentError
+    from seldon_core_tpu.serving.client import RemoteComponent
+
+    remote = RemoteComponent("http://127.0.0.1:9", name="m",
+                             methods=["predict"])
+    graph = GraphEngine({"name": "m", "type": "MODEL"},
+                        resolver=lambda u: remote)
+    from seldon_core_tpu.messages import SeldonMessage
+
+    with pytest.raises(SeldonComponentError, match="not streamable"):
+        graph.stream(SeldonMessage(json_data={"prompt_ids": [1]}))
+
+
 def test_slot_reoccupancy_during_inflight_tick_is_isolated():
     """Identity regression: B admitted into A's slot while a tick is in
     flight (A abandoned mid-tick) must produce exactly its solo output —
