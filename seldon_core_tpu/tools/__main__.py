@@ -59,6 +59,10 @@ def main(argv=None) -> int:
     ld.add_argument("--warmup", type=float, default=0.5)
     ld.add_argument("-b", "--batch-size", type=int, default=1)
     ld.add_argument("--seed", type=int, default=0)
+    ld.add_argument("--stream", action="store_true",
+                    help="drive the SSE streaming endpoint; payload is the "
+                         "raw contract request (LLM contracts use jsonData) "
+                         "and the report adds TTFT percentiles + tokens/s")
 
     args = ap.parse_args(argv)
     contract = Contract.load(args.contract)
@@ -113,6 +117,7 @@ def main(argv=None) -> int:
         FramedDriver,
         GrpcDriver,
         RestDriver,
+        SseStreamDriver,
         oauth_token,
         run_load,
     )
@@ -138,22 +143,34 @@ def main(argv=None) -> int:
                 host or "127.0.0.1", int(port), payload, pool=args.concurrency
             )
             proto = "framed"
+        elif args.stream:
+            driver = SseStreamDriver(
+                args.url, payload,
+                path=(args.path if args.path != "/api/v0.1/predictions"
+                      else "/api/v0.1/stream"),
+                token=token, connections=max(args.concurrency, 16),
+            )
+            proto = "sse-stream"
         else:
             driver = RestDriver(
                 args.url, payload, path=args.path, token=token,
                 connections=max(args.concurrency, 16),
             )
             proto = "rest"
-        return await run_load(
+        res = await run_load(
             driver,
             seconds=args.seconds,
             concurrency=args.concurrency,
             warmup_s=args.warmup,
             protocol=proto,
         )
+        return res, driver
 
-    result = asyncio.run(_run())
-    print(json.dumps(result.to_dict(), indent=2))
+    result, driver = asyncio.run(_run())
+    out = result.to_dict()
+    if isinstance(driver, SseStreamDriver):
+        out["stream"] = driver.stream_stats(result.req_per_s)
+    print(json.dumps(out, indent=2))
     return 0 if result.failures == 0 else 1
 
 

@@ -113,6 +113,11 @@ class RestDriver:
         self._connections = connections
         self._session = None
 
+    def _client_timeout(self):
+        import aiohttp
+
+        return aiohttp.ClientTimeout(total=30)
+
     async def __aenter__(self):
         import aiohttp
 
@@ -120,7 +125,7 @@ class RestDriver:
             connector=aiohttp.TCPConnector(
                 limit=self._connections, keepalive_timeout=60
             ),
-            timeout=aiohttp.ClientTimeout(total=30),
+            timeout=self._client_timeout(),
         )
         return self
 
@@ -135,6 +140,78 @@ class RestDriver:
             await resp.read()
             if resp.status != 200:
                 raise RuntimeError(f"HTTP {resp.status}")
+
+
+class SseStreamDriver(RestDriver):
+    """POST an SSE streaming endpoint (engine/gateway ``/api/v0.1/stream``
+    or component ``/stream``); each request consumes the FULL event stream.
+
+    ``run_load`` latency = whole-stream duration; the driver additionally
+    tracks per-stream token counts and time-to-first-token (per-request
+    quantities over every COMPLETED stream, warmup included)."""
+
+    def __init__(self, base_url, payload, path="/api/v0.1/stream", **kw):
+        super().__init__(base_url, payload, path=path, **kw)
+        self.ttfts_ms: List[float] = []
+        self.tokens = 0
+        self.streams_completed = 0
+
+    def _client_timeout(self):
+        import aiohttp
+
+        # whole-stream duration is workload-defined (no total deadline),
+        # but a wedged server that stops emitting events must not hang the
+        # tool forever — bound the gap between reads
+        return aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                     sock_read=60)
+
+    async def __call__(self) -> None:
+        t0 = time.perf_counter()
+        got_first = False
+        n = 0
+        async with self._session.post(
+            self.base_url + self.path, data=self.body, headers=self.headers
+        ) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}")
+            if resp.content_type != "text/event-stream":
+                raise RuntimeError(f"not a stream: {resp.content_type}")
+            async for line in resp.content:
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                if not got_first:
+                    got_first = True
+                    self.ttfts_ms.append((time.perf_counter() - t0) * 1000.0)
+                event = json.loads(line[6:])
+                if isinstance(event, dict):
+                    if set(event) == {"error"}:
+                        raise RuntimeError(event["error"])
+                    if "token" in event:
+                        n += 1
+        # tallies only for streams that completed cleanly, so failures
+        # don't pollute the per-stream quantities
+        self.tokens += n
+        self.streams_completed += 1
+
+    def stream_stats(self, req_per_s: float) -> dict:
+        """Stream-specific report.  ``tokens_per_s`` is derived as
+        mean-tokens-per-completed-stream x measured-window req/s — raw
+        token tallies span warmup and window-tail streams, so dividing
+        them by the measured window alone would overestimate the rate."""
+        out: dict = {"tokens": self.tokens,
+                     "streams_completed": self.streams_completed}
+        if self.streams_completed:
+            per_stream = self.tokens / self.streams_completed
+            out["tokens_per_s"] = round(per_stream * req_per_s, 1)
+        if self.ttfts_ms:
+            arr = np.asarray(self.ttfts_ms)
+            out["ttft_ms"] = {
+                "p50": round(float(np.percentile(arr, 50)), 3),
+                "p90": round(float(np.percentile(arr, 90)), 3),
+                "p99": round(float(np.percentile(arr, 99)), 3),
+            }
+        return out
 
 
 class GrpcDriver:
