@@ -210,25 +210,36 @@ class Gateway:
         # pre-connection retry, same safety argument as _forward: a
         # ClientConnectorError provably never reached the engine
         last_err: Optional[Exception] = None
-        for attempt in range(self.retries + 1):
-            if attempt:
-                await asyncio.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
-                self.registry.counter_inc(
-                    "seldon_api_gateway_retries_total",
-                    {"deployment": rec.name, "path": "/api/v0.1/stream"},
-                )
-            try:
-                return await self._relay_stream(request, rec, sess, body, t0)
-            except aiohttp.ClientConnectorError as e:
-                last_err = e
-        return web.json_response(
-            {"status": {"code": 503, "status": "FAILURE",
-                        "info": f"engine unreachable: {last_err}"}},
-            status=503,
-        )
+        try:
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    await asyncio.sleep(
+                        self.retry_backoff_s * (2 ** (attempt - 1))
+                    )
+                    self.registry.counter_inc(
+                        "seldon_api_gateway_retries_total",
+                        {"deployment": rec.name, "path": "/api/v0.1/stream"},
+                    )
+                try:
+                    return await self._relay_stream(request, rec, sess, body)
+                except aiohttp.ClientConnectorError as e:
+                    last_err = e
+            return web.json_response(
+                {"status": {"code": 503, "status": "FAILURE",
+                            "info": f"engine unreachable: {last_err}"}},
+                status=503,
+            )
+        finally:
+            # observed HERE, not per relay attempt: each connect-failure
+            # retry would otherwise record an extra histogram sample for
+            # the same request and skew ingress latency stats
+            self.registry.observe(
+                "seldon_api_server_ingress_seconds",
+                time.perf_counter() - t0,
+                {"deployment": rec.name, "path": "/api/v0.1/stream"},
+            )
 
-    async def _relay_stream(self, request, rec, sess, body,
-                            t0) -> web.StreamResponse:
+    async def _relay_stream(self, request, rec, sess, body) -> web.StreamResponse:
         try:
             async with sess.post(
                 rec.engine_url.rstrip("/") + "/api/v0.1/stream",
@@ -259,9 +270,14 @@ class Gateway:
                     async for chunk in engine_resp.content.iter_any():
                         await out.write(chunk)
                     await out.write_eof()
-                except (ConnectionError, OSError):
-                    pass  # client or engine went away mid-stream; closing
-                    # the engine response cancels the upstream generation
+                except (ConnectionError, OSError, aiohttp.ClientError):
+                    # client or engine went away mid-stream (incl. engine
+                    # dying mid-transfer → ClientPayloadError): headers are
+                    # already on the wire, so the only correct move is to
+                    # terminate THIS stream — never fall through to the
+                    # outer JSON-error path, which would send a second
+                    # response on the same connection
+                    pass
                 return out
         except aiohttp.ClientConnectorError:
             raise  # retried by the caller (never reached the engine)
@@ -270,12 +286,6 @@ class Gateway:
                 {"status": {"code": 503, "status": "FAILURE",
                             "info": f"engine unreachable: {e}"}},
                 status=503,
-            )
-        finally:
-            self.registry.observe(
-                "seldon_api_server_ingress_seconds",
-                time.perf_counter() - t0,
-                {"deployment": rec.name, "path": "/api/v0.1/stream"},
             )
 
     async def _handle_feedback(self, request: web.Request) -> web.Response:

@@ -63,9 +63,9 @@ def sample_tokens(logits, temps, top_k, top_p, keys):
     - ``keys``: (S, 2) uint32 per-slot PRNG keys
 
     Returns ``(tokens (S,) int32, new_keys (S, 2) uint32)``.  Filters
-    compose the standard way: temperature first, then top-k, then top-p
-    over the temperature-scaled distribution; sampling happens in sorted
-    space and indices map back through the sort order.
+    compose the standard (HF) sequential way: temperature first, then
+    top-k, then top-p over the RENORMALIZED top-k survivors; sampling
+    happens in sorted space and indices map back through the sort order.
     """
     V = logits.shape[-1]
     logits = logits.astype(jnp.float32)
@@ -78,8 +78,13 @@ def sample_tokens(logits, temps, top_k, top_p, keys):
     pos = jnp.arange(V)[None, :]
     keep_k = pos < jnp.where(top_k > 0, top_k, V)[:, None]
     # nucleus: minimal prefix whose mass reaches p (position 0 always kept
-    # because its exclusive cumsum is 0)
-    keep_p = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+    # because its exclusive cumsum is 0).  The mass is computed over the
+    # RENORMALIZED top-k survivors — the HF sequential filter-then-
+    # renormalize convention — so top_k+top_p compose the way users of
+    # other samplers expect.
+    probs_k = jnp.where(keep_k, probs, 0.0)
+    probs_k = probs_k / jnp.sum(probs_k, axis=-1, keepdims=True)
+    keep_p = (jnp.cumsum(probs_k, axis=-1) - probs_k) < top_p[:, None]
     filtered = jnp.where(keep_k & keep_p, sorted_logits, -jnp.inf)
 
     split = jax.vmap(jax.random.split)(keys)  # (S, 2, 2)
@@ -502,8 +507,6 @@ class LLMEngine:
                 logits, small = self._prefill_for(_bucket(L0))(
                     self.params, padded, logit_pos=L0 - 1
                 )
-            self.cache = self._insert(self.cache, small, slot, true_len=L0)
-            self._pos[slot] = L0
             if self.draft_params is not None and temperature <= 0.0:
                 # the draft model needs its own KV for the whole prompt
                 # (prefix cache entries are target-model state only; the
@@ -516,9 +519,8 @@ class LLMEngine:
                 _, d_small = self._prefill_for(_bucket(L0), draft=True)(
                     self.draft_params, dpad, logit_pos=L0 - 1
                 )
-                self.draft_cache = self._insert(
-                    self.draft_cache, d_small, slot, true_len=L0
-                )
+            else:
+                d_small = None
 
             self._temps[slot] = float(temperature)
             self._topk[slot] = int(top_k)
@@ -539,9 +541,28 @@ class LLMEngine:
                 self._topp[slot : slot + 1],
                 jnp.asarray(key, jnp.uint32)[None, :],
             )
-            self._keys[slot] = np.asarray(key1[0])
-            first_tok = int(tok1[0])  # materializes: deferred device errors
-            # surface here, inside the recovery scope
+            # materialize OFF the event loop (same rule as the tick-loop
+            # fetch: a blocking device→host round trip here would stall
+            # every other handler per admission); deferred device errors
+            # still surface here, inside the recovery scope.  This await
+            # runs BEFORE the shared-cache inserts: the reserved slot is
+            # not yet visible to ticks, so an interleaved tick touching
+            # the half-admitted slot's rows is overwritten by the insert
+            # below (positions >= L0 stay pos-masked).
+            host_tok1, host_key1 = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: (np.asarray(tok1), np.asarray(key1))
+            )
+            # NO awaits between here and self._slots[slot] = st — the
+            # insert → pos → registration sequence must be atomic wrt the
+            # tick loop or a tick could advance a half-admitted slot
+            self.cache = self._insert(self.cache, small, slot, true_len=L0)
+            self._pos[slot] = L0
+            if d_small is not None:
+                self.draft_cache = self._insert(
+                    self.draft_cache, d_small, slot, true_len=L0
+                )
+            self._keys[slot] = host_key1[0]
+            first_tok = int(host_tok1[0])
         except BaseException:
             # a failed admission (e.g. a new bucket's prefill fails to
             # compile) must not leak the slot — after max_slots leaks every

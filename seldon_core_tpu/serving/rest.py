@@ -101,15 +101,50 @@ async def _sse_stream(
         validate_metrics,
     )
 
+    def _err_response(code: int, info: str, reason: str = "") -> web.Response:
+        return web.Response(
+            text=_err_json(code, info, reason),
+            content_type="application/json",
+            status=code if 400 <= code < 600 else 500,
+        )
+
     msg = _parse_msg(await _payload_json(request))
     try:
         agen = stream_fn(msg)
     except SeldonComponentError as e:
-        return web.Response(
-            text=_err_json(e.status_code, str(e), e.reason),
-            content_type="application/json",
-            status=e.status_code if 400 <= e.status_code < 600 else 500,
-        )
+        return _err_response(e.status_code, str(e), e.reason)
+    # Pull the FIRST event before sending headers: request-validation
+    # errors raised lazily inside the generator (missing prompt_ids,
+    # prompt+n_new > max_len, ...) map to real 4xx/5xx JSON responses
+    # instead of an HTTP 200 with an error event.
+    t0 = time.perf_counter()
+    _EMPTY = object()
+    try:
+        first = await agen.__anext__()
+    except StopAsyncIteration:
+        first = _EMPTY
+    except SeldonComponentError as e:
+        await agen.aclose()
+        metrics.observe_request(name, time.perf_counter() - t0, e.status_code)
+        return _err_response(e.status_code, str(e), e.reason)
+    except asyncio.CancelledError:
+        # client hung up while the first token was computing (prefill —
+        # often the longest wait): still a request, still a 499
+        await agen.aclose()
+        metrics.observe_request(name, time.perf_counter() - t0, 499)
+        raise
+    except Exception as e:
+        logger.exception("stream failed before first event (%s)", name)
+        await agen.aclose()
+        metrics.observe_request(name, time.perf_counter() - t0, 500)
+        return _err_response(500, f"{type(e).__name__}: {e}")
+
+    async def _events():
+        if first is not _EMPTY:
+            yield first
+        async for ev in agen:
+            yield ev
+
     resp = web.StreamResponse(
         headers={
             "Content-Type": "text/event-stream",
@@ -117,9 +152,8 @@ async def _sse_stream(
         }
     )
     await resp.prepare(request)
-    t0 = time.perf_counter()
     try:
-        async for event in agen:
+        async for event in _events():
             if isinstance(event, dict) and event.get("metrics"):
                 try:
                     metrics.merge_custom(

@@ -167,7 +167,7 @@ class SseStreamDriver(RestDriver):
 
     async def __call__(self) -> None:
         t0 = time.perf_counter()
-        got_first = False
+        ttft_ms: Optional[float] = None
         n = 0
         async with self._session.post(
             self.base_url + self.path, data=self.body, headers=self.headers
@@ -180,17 +180,19 @@ class SseStreamDriver(RestDriver):
                 line = line.strip()
                 if not line.startswith(b"data: "):
                     continue
-                if not got_first:
-                    got_first = True
-                    self.ttfts_ms.append((time.perf_counter() - t0) * 1000.0)
+                if ttft_ms is None:
+                    ttft_ms = (time.perf_counter() - t0) * 1000.0
                 event = json.loads(line[6:])
                 if isinstance(event, dict):
                     if set(event) == {"error"}:
                         raise RuntimeError(event["error"])
                     if "token" in event:
                         n += 1
-        # tallies only for streams that completed cleanly, so failures
-        # don't pollute the per-stream quantities
+        # ALL tallies (including TTFT) only for streams that completed
+        # cleanly, so mid-flight failures don't pollute any per-stream
+        # quantity
+        if ttft_ms is not None:
+            self.ttfts_ms.append(ttft_ms)
         self.tokens += n
         self.streams_completed += 1
 
