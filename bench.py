@@ -461,6 +461,119 @@ def bench_resnet_serving(seconds: float = 6.0, concurrency: int = 64) -> dict:
     return out
 
 
+def bench_rest_socket_native(seconds: float = 3.0,
+                             connections: int = 32) -> dict:
+    """REST throughput over a REAL localhost socket, native wire tier:
+    C++ HTTP/1.1 epoll server (serving/native_http.py) fronting the Python
+    engine (SIMPLE_MODEL graph), driven by the native C loadgen — the
+    framework's production REST hot path.  Apples-to-apples with the
+    reference's locust→engine 12,089 req/s (docs/benchmarking.md:40,44):
+    same JSON wire format, same orchestrator-with-stub-model measurement,
+    except the reference had a 16-core server host and 3 separate 16-core
+    client nodes; here client AND server share this host's core(s)."""
+    import asyncio as _a
+
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.native import run_native_load
+    from seldon_core_tpu.serving.native_http import NativeRestServer
+
+    body = json.dumps(
+        {"data": {"names": ["a", "b"], "ndarray": [[1.0, 2.0]]}}
+    ).encode()
+
+    async def run() -> dict:
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        srv = NativeRestServer(engine=eng, bind="127.0.0.1")
+        port = await srv.start()
+        loop = _a.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                None,
+                lambda: run_native_load(
+                    "rest", "127.0.0.1", port, "/api/v0.1/predictions",
+                    body, connections, 1, seconds, 0.3,
+                ),
+            )
+        finally:
+            await srv.stop()
+
+    return asyncio.run(run())
+
+
+def bench_grpc_socket_native(seconds: float = 3.0, connections: int = 8,
+                             streams_per_conn: int = 8) -> dict:
+    """gRPC Seldon.Predict throughput over a real localhost socket, native
+    wire tier: C++ h2c server (HPACK/flow control in C, Python engine
+    handler) driven by the native h2 loadgen (reference baseline: 28,256
+    req/s on 16 server cores, docs/benchmarking.md:54)."""
+    import asyncio as _a
+
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.native import run_native_load
+    from seldon_core_tpu.proto.convert import message_to_proto
+    from seldon_core_tpu.serving.native_http import NativeGrpcServer
+
+    req = message_to_proto(
+        SeldonMessage.from_dict(
+            {"data": {"names": ["a", "b"], "ndarray": [[1.0, 2.0]]}}
+        )
+    ).SerializeToString()
+
+    async def run() -> dict:
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        srv = NativeGrpcServer(deployment=eng, bind="127.0.0.1")
+        port = await srv.start()
+        loop = _a.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                None,
+                lambda: run_native_load(
+                    "grpc", "127.0.0.1", port, "/seldon.tpu.Seldon/Predict",
+                    req, connections, streams_per_conn, seconds, 0.3,
+                ),
+            )
+        finally:
+            await srv.stop()
+
+    return asyncio.run(run())
+
+
+def bench_wire_ceiling(seconds: float = 1.5) -> dict:
+    """Pure-native transport ceiling: canned responses, zero Python per
+    request on either side.  Separates wire cost from handler cost — the
+    headroom number that shows where the framework goes on a multi-core
+    serving host (handler work shards across SO_REUSEPORT workers)."""
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.native import NativeHttpServer, run_native_load
+    from seldon_core_tpu.proto.convert import message_to_proto
+
+    out: dict = {}
+    body = json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode()
+    srv = NativeHttpServer(submit=None, http2=False).start()
+    try:
+        srv.set_static_response(200, body)
+        r = run_native_load("rest", "127.0.0.1", srv.port, "/p", body,
+                            32, 1, seconds, 0.2)
+        out["rest_req_per_s"] = r["req_per_s"]
+        out["rest_p50_ms"] = r["latency_ms"]["p50"]
+    finally:
+        srv.stop()
+    pb_req = message_to_proto(
+        SeldonMessage.from_dict({"data": {"ndarray": [[1.0, 2.0]]}})
+    ).SerializeToString()
+    srv2 = NativeHttpServer(submit=None, http2=True).start()
+    try:
+        srv2.set_static_response(0, pb_req)
+        r = run_native_load("grpc", "127.0.0.1", srv2.port, "/x", pb_req,
+                            8, 16, seconds, 0.2)
+        out["grpc_req_per_s"] = r["req_per_s"]
+        out["grpc_p50_ms"] = r["latency_ms"]["p50"]
+    finally:
+        srv2.stop()
+    return out
+
+
 def bench_rest_socket(seconds: float = 3.0, concurrency: int = 64) -> dict:
     """REST throughput over a REAL localhost socket: aiohttp server (engine +
     SIMPLE_MODEL graph) driven by the tools load harness — apples-to-apples
@@ -618,8 +731,9 @@ def main() -> None:
     extras: dict = {}
     orch = bench_orchestrator(args.seconds)
     extras["graph_fanout_req_per_s"] = round(bench_graph_fanout(args.seconds), 1)
+    # headline wire tier: native servers + Python engine + native loadgen
     try:
-        rest = bench_rest_socket(args.seconds)
+        rest = bench_rest_socket_native(args.seconds)
         extras["rest_socket_req_per_s"] = rest["req_per_s"]
         extras["rest_socket_latency_ms"] = rest["latency_ms"]
         extras["rest_socket_vs_baseline"] = round(
@@ -628,12 +742,30 @@ def main() -> None:
     except Exception as e:
         extras["rest_socket_error"] = f"{type(e).__name__}: {e}"
     try:
-        g = bench_grpc_socket(args.seconds)
+        g = bench_grpc_socket_native(args.seconds)
         extras["grpc_socket_req_per_s"] = g["req_per_s"]
         extras["grpc_socket_latency_ms"] = g["latency_ms"]
         extras["grpc_socket_vs_baseline"] = round(g["req_per_s"] / 28256.39, 3)
     except Exception as e:
         extras["grpc_socket_error"] = f"{type(e).__name__}: {e}"
+    try:
+        extras["wire_ceiling"] = bench_wire_ceiling()
+    except Exception as e:
+        extras["wire_ceiling_error"] = f"{type(e).__name__}: {e}"
+    # Python wire tiers (round-2 surfaces, kept for comparison): aiohttp /
+    # grpc.aio server driven by the Python load harness
+    try:
+        rest = bench_rest_socket(min(args.seconds, 2.0))
+        extras["rest_socket_aio_req_per_s"] = rest["req_per_s"]
+        extras["rest_socket_aio_p50_ms"] = rest["latency_ms"]["p50"]
+    except Exception as e:
+        extras["rest_socket_aio_error"] = f"{type(e).__name__}: {e}"
+    try:
+        g = bench_grpc_socket(min(args.seconds, 2.0))
+        extras["grpc_socket_aio_req_per_s"] = g["req_per_s"]
+        extras["grpc_socket_aio_p50_ms"] = g["latency_ms"]["p50"]
+    except Exception as e:
+        extras["grpc_socket_aio_error"] = f"{type(e).__name__}: {e}"
     try:
         fr = bench_framed_socket(args.seconds)
         extras["framed_socket_req_per_s"] = fr["req_per_s"]
@@ -645,10 +777,10 @@ def main() -> None:
     except Exception as e:
         extras["transport_batch_error"] = f"{type(e).__name__}: {e}"
     # socket baselines context: the reference's 12,089/28,256 req/s ran on a
-    # 16-core engine host driven by 64 remote locust slaves; here client AND
-    # server share this host's cores.  Per-core the gRPC path is at parity:
-    # 28,256/16 = 1,766 req/s/core server-only vs ~1.4-2k here carrying both
-    # sides (multi-channel was measured to change nothing — CPU-bound).
+    # 16-core engine host driven by 64 remote locust slaves on 3 MORE 16-core
+    # nodes; here client AND server share this host's core(s).  Per-core
+    # parity bars: REST 12,089/16 = 756, gRPC 28,256/16 = 1,766 req/s/core —
+    # the native tier clears both severalfold while also paying the client.
     extras["host_cores"] = os.cpu_count()
     try:
         # best-of-2: the device tunnel occasionally hiccups for seconds at a

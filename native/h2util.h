@@ -1,0 +1,69 @@
+/* Shared HTTP/2 byte helpers + frame constants for httpserver.cc (server)
+ * and loadgen.cc (client) — one copy of the framing arithmetic. */
+#ifndef SELDON_H2UTIL_H
+#define SELDON_H2UTIL_H
+
+#include <cstdint>
+#include <string>
+
+namespace snh2 {
+
+enum FrameType : uint8_t {
+  F_DATA = 0,
+  F_HEADERS = 1,
+  F_PRIORITY = 2,
+  F_RST_STREAM = 3,
+  F_SETTINGS = 4,
+  F_PUSH_PROMISE = 5,
+  F_PING = 6,
+  F_GOAWAY = 7,
+  F_WINDOW_UPDATE = 8,
+  F_CONTINUATION = 9,
+};
+
+constexpr uint8_t FLAG_END_STREAM = 0x1;
+constexpr uint8_t FLAG_ACK = 0x1;
+constexpr uint8_t FLAG_END_HEADERS = 0x4;
+constexpr uint8_t FLAG_PADDED = 0x8;
+constexpr uint8_t FLAG_PRIORITY = 0x20;
+
+inline void put_u32(std::string *out, uint32_t v) {
+  out->push_back((char)(v >> 24));
+  out->push_back((char)(v >> 16));
+  out->push_back((char)(v >> 8));
+  out->push_back((char)v);
+}
+
+inline void frame_header(std::string *out, uint32_t len, uint8_t type,
+                         uint8_t flags, int32_t stream_id) {
+  out->push_back((char)(len >> 16));
+  out->push_back((char)(len >> 8));
+  out->push_back((char)len);
+  out->push_back((char)type);
+  out->push_back((char)flags);
+  put_u32(out, (uint32_t)stream_id & 0x7fffffffu);
+}
+
+/* Strip PADDED/PRIORITY prologue from a HEADERS frame payload in place.
+ * Returns false on malformed lengths (pad+1 > len, or PRIORITY fields
+ * missing) — both sides must treat that as a connection error. */
+inline bool strip_headers_prologue(const uint8_t *&p, size_t &len,
+                                   uint8_t flags) {
+  if (flags & FLAG_PADDED) {
+    if (len < 1) return false;
+    uint8_t pad = p[0];
+    if ((size_t)pad + 1 > len) return false;
+    len -= (size_t)pad + 1;
+    p += 1;
+  }
+  if (flags & FLAG_PRIORITY) {
+    if (len < 5) return false;
+    p += 5;
+    len -= 5;
+  }
+  return true;
+}
+
+}  // namespace snh2
+
+#endif /* SELDON_H2UTIL_H */

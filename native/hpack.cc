@@ -1,0 +1,256 @@
+/* HPACK (RFC 7541) codec — see hpack.h for the decode/encode asymmetry.
+ * Static + Huffman tables are generated from the RFC data by
+ * scripts/gen_hpack_tables.py into hpack_tables.h. */
+#include "hpack.h"
+
+#include <cstring>
+
+#include "hpack_tables.h"
+
+namespace snhpack {
+
+namespace {
+
+constexpr size_t kStaticCount = 61;
+constexpr size_t kEntryOverhead = 32; /* RFC 7541 §4.1 */
+constexpr size_t kMaxHeaderBytes = 1u << 20; /* sanity cap per string */
+
+/* ---- Huffman decode: binary trie built once from the code table ---- */
+
+struct HuffNode {
+  int16_t child[2];
+  int16_t sym; /* -1 = internal */
+};
+
+struct HuffTrie {
+  std::vector<HuffNode> nodes;
+  HuffTrie() {
+    nodes.push_back({{-1, -1}, -1});
+    for (int s = 0; s < 257; s++) {
+      uint32_t code = kHuffCodes[s];
+      int len = kHuffLens[s];
+      int cur = 0;
+      for (int b = len - 1; b >= 0; b--) {
+        int bit = (code >> b) & 1;
+        int16_t nxt = nodes[cur].child[bit];
+        if (nxt < 0) {
+          nxt = (int16_t)nodes.size();
+          nodes[cur].child[bit] = nxt;
+          nodes.push_back({{-1, -1}, -1});
+        }
+        cur = nxt;
+      }
+      nodes[cur].sym = (int16_t)s;
+    }
+  }
+};
+
+const HuffTrie &Trie() {
+  static const HuffTrie t;
+  return t;
+}
+
+/* HPACK integer (RFC 7541 §5.1). prefix_bits in [1,8].
+ * Returns bytes consumed, 0 on truncation/overflow. */
+size_t DecodeInt(const uint8_t *p, size_t len, int prefix_bits,
+                 uint64_t *out) {
+  if (len == 0) return 0;
+  uint64_t max_prefix = (1u << prefix_bits) - 1;
+  uint64_t v = p[0] & max_prefix;
+  if (v < max_prefix) {
+    *out = v;
+    return 1;
+  }
+  uint64_t m = 0;
+  size_t i = 1;
+  for (;; i++) {
+    if (i >= len || m > 56) return 0;
+    v += (uint64_t)(p[i] & 0x7f) << m;
+    m += 7;
+    if (!(p[i] & 0x80)) break;
+  }
+  *out = v;
+  return i + 1;
+}
+
+void EncodeInt(std::string *out, uint64_t v, int prefix_bits,
+               uint8_t first_byte_flags) {
+  uint64_t max_prefix = (1u << prefix_bits) - 1;
+  if (v < max_prefix) {
+    out->push_back((char)(first_byte_flags | v));
+    return;
+  }
+  out->push_back((char)(first_byte_flags | max_prefix));
+  v -= max_prefix;
+  while (v >= 128) {
+    out->push_back((char)(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out->push_back((char)v);
+}
+
+/* Decode one (possibly Huffman-coded) string; returns bytes consumed, 0 on
+ * error. */
+size_t DecodeString(const uint8_t *p, size_t len, std::string *out) {
+  if (len == 0) return 0;
+  bool huff = (p[0] & 0x80) != 0;
+  uint64_t slen;
+  size_t n = DecodeInt(p, len, 7, &slen);
+  if (n == 0 || slen > kMaxHeaderBytes || n + slen > len) return 0;
+  if (huff) {
+    if (HuffmanDecode(p + n, (size_t)slen, out) != 0) return 0;
+  } else {
+    out->assign((const char *)(p + n), (size_t)slen);
+  }
+  return n + (size_t)slen;
+}
+
+}  // namespace
+
+int HuffmanDecode(const uint8_t *src, size_t len, std::string *out) {
+  const HuffTrie &t = Trie();
+  int cur = 0;
+  int depth = 0;      /* bits since last emitted symbol */
+  bool all_ones = true;
+  for (size_t i = 0; i < len; i++) {
+    for (int b = 7; b >= 0; b--) {
+      int bit = (src[i] >> b) & 1;
+      if (!bit) all_ones = false;
+      int16_t nxt = t.nodes[cur].child[bit];
+      if (nxt < 0) return -1;
+      cur = nxt;
+      depth++;
+      int16_t sym = t.nodes[cur].sym;
+      if (sym >= 0) {
+        if (sym == 256) return -1; /* EOS inside stream is an error */
+        out->push_back((char)sym);
+        cur = 0;
+        depth = 0;
+        all_ones = true;
+      }
+    }
+  }
+  /* trailing padding must be < 8 bits of all-ones (EOS prefix) */
+  if (depth >= 8 || !all_ones) return -1;
+  return 0;
+}
+
+int Decoder::LookupIndexed(uint64_t idx, Header *h) const {
+  if (idx == 0) return -1;
+  if (idx <= kStaticCount) {
+    h->name = kHpackStatic[idx - 1].name;
+    h->value = kHpackStatic[idx - 1].value;
+    return 0;
+  }
+  size_t di = (size_t)(idx - kStaticCount - 1);
+  if (di >= dyn_.size()) return -1;
+  h->name = dyn_[di].first;
+  h->value = dyn_[di].second;
+  return 0;
+}
+
+int Decoder::LookupName(uint64_t idx, std::string *name) const {
+  Header h;
+  if (LookupIndexed(idx, &h) != 0) return -1;
+  *name = std::move(h.name);
+  return 0;
+}
+
+void Decoder::Insert(const std::string &name, const std::string &value) {
+  size_t sz = name.size() + value.size() + kEntryOverhead;
+  dyn_.emplace_front(name, value);
+  dyn_bytes_ += sz;
+  Evict();
+}
+
+void Decoder::Evict() {
+  while (dyn_bytes_ > max_size_ && !dyn_.empty()) {
+    auto &back = dyn_.back();
+    dyn_bytes_ -= back.first.size() + back.second.size() + kEntryOverhead;
+    dyn_.pop_back();
+  }
+}
+
+int Decoder::Decode(const uint8_t *buf, size_t len,
+                    std::vector<Header> *out) {
+  size_t off = 0;
+  while (off < len) {
+    const uint8_t *p = buf + off;
+    size_t rem = len - off;
+    uint8_t b = p[0];
+    if (b & 0x80) { /* indexed */
+      uint64_t idx;
+      size_t n = DecodeInt(p, rem, 7, &idx);
+      if (n == 0) return -1;
+      Header h;
+      if (LookupIndexed(idx, &h) != 0) return -1;
+      out->push_back(std::move(h));
+      off += n;
+    } else if ((b & 0xc0) == 0x40) { /* literal, incremental indexing */
+      uint64_t idx;
+      size_t n = DecodeInt(p, rem, 6, &idx);
+      if (n == 0) return -1;
+      Header h;
+      if (idx) {
+        if (LookupName(idx, &h.name) != 0) return -1;
+      } else {
+        size_t m = DecodeString(p + n, rem - n, &h.name);
+        if (m == 0) return -1;
+        n += m;
+      }
+      size_t m = DecodeString(p + n, rem - n, &h.value);
+      if (m == 0) return -1;
+      n += m;
+      Insert(h.name, h.value);
+      out->push_back(std::move(h));
+      off += n;
+    } else if ((b & 0xe0) == 0x20) { /* dynamic table size update */
+      uint64_t sz;
+      size_t n = DecodeInt(p, rem, 5, &sz);
+      if (n == 0 || sz > max_allowed_) return -1;
+      max_size_ = (size_t)sz;
+      Evict();
+      off += n;
+    } else { /* literal without indexing (0000) / never indexed (0001) */
+      uint64_t idx;
+      size_t n = DecodeInt(p, rem, 4, &idx);
+      if (n == 0) return -1;
+      Header h;
+      if (idx) {
+        if (LookupName(idx, &h.name) != 0) return -1;
+      } else {
+        size_t m = DecodeString(p + n, rem - n, &h.name);
+        if (m == 0) return -1;
+        n += m;
+      }
+      size_t m = DecodeString(p + n, rem - n, &h.value);
+      if (m == 0) return -1;
+      n += m;
+      out->push_back(std::move(h));
+      off += n;
+    }
+  }
+  return 0;
+}
+
+void EncodeIndexed(std::string *out, unsigned idx) {
+  EncodeInt(out, idx, 7, 0x80);
+}
+
+void EncodeLiteralIdxName(std::string *out, unsigned name_idx,
+                          const std::string &value) {
+  EncodeInt(out, name_idx, 4, 0x00); /* literal without indexing */
+  EncodeInt(out, value.size(), 7, 0x00); /* no huffman */
+  out->append(value);
+}
+
+void EncodeLiteral(std::string *out, const std::string &name,
+                   const std::string &value) {
+  EncodeInt(out, 0, 4, 0x00);
+  EncodeInt(out, name.size(), 7, 0x00);
+  out->append(name);
+  EncodeInt(out, value.size(), 7, 0x00);
+  out->append(value);
+}
+
+}  // namespace snhpack

@@ -1,0 +1,61 @@
+/* Internal C++ HPACK (RFC 7541) codec for the native HTTP/2 tier.
+ *
+ * Decoder: full — static + dynamic table, Huffman strings, table-size
+ * updates — because we cannot control what a peer encoder (grpc C-core,
+ * nghttp2, ...) emits.  Encoder: deliberately minimal — static-table
+ * references and literals WITHOUT indexing, no Huffman — which is always
+ * legal (an encoder chooses its own representations) and keeps server
+ * responses stateless.
+ */
+#ifndef SELDON_HPACK_INTERNAL_H
+#define SELDON_HPACK_INTERNAL_H
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace snhpack {
+
+struct Header {
+  std::string name;
+  std::string value;
+};
+
+class Decoder {
+ public:
+  /* Decode one complete header block.  Appends to *out.
+   * Returns 0 on success, negative on malformed input. */
+  int Decode(const uint8_t *buf, size_t len, std::vector<Header> *out);
+
+  /* SETTINGS_HEADER_TABLE_SIZE we advertised: the ceiling for encoder
+   * "dynamic table size update" instructions. */
+  void set_max_allowed(size_t n) { max_allowed_ = n; }
+
+ private:
+  int LookupIndexed(uint64_t idx, Header *h) const;
+  int LookupName(uint64_t idx, std::string *name) const;
+  void Insert(const std::string &name, const std::string &value);
+  void Evict();
+
+  std::deque<std::pair<std::string, std::string>> dyn_;
+  size_t dyn_bytes_ = 0;
+  size_t max_size_ = 4096;     /* current dynamic table budget */
+  size_t max_allowed_ = 4096;  /* ceiling from our SETTINGS */
+};
+
+/* -- encoder helpers (append to *out) ------------------------------------ */
+void EncodeIndexed(std::string *out, unsigned idx); /* 1-based static index */
+void EncodeLiteralIdxName(std::string *out, unsigned name_idx,
+                          const std::string &value);
+void EncodeLiteral(std::string *out, const std::string &name,
+                   const std::string &value);
+
+/* Huffman-decode src into *out.  Returns 0, or negative on bad padding /
+ * EOS in stream. */
+int HuffmanDecode(const uint8_t *src, size_t len, std::string *out);
+
+}  // namespace snhpack
+
+#endif /* SELDON_HPACK_INTERNAL_H */

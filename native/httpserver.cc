@@ -1,0 +1,927 @@
+/* Native HTTP server tier: HTTP/1.1 (REST) and HTTP/2 h2c (gRPC) on one
+ * epoll loop, replacing the Python asyncio servers on the hot wire path.
+ *
+ * The reference's engine serves REST via Spring/Tomcat and gRPC via
+ * grpc-java (engine/.../grpc/SeldonGrpcServer.java:37-127,
+ * api/rest/RestClientController.java:103) — JVM thread-pool servers.  The
+ * TPU-native equivalent keeps ALL protocol work (HTTP/1.1 parse, HTTP/2
+ * framing, HPACK, gRPC message assembly, flow control) in C++ on one IO
+ * thread and crosses into Python exactly once per request through an async
+ * submit/complete ABI:
+ *
+ *   submit(token, method, path, body)   [IO thread -> Python callback]
+ *   sn_http_complete(token, ...)        [any thread -> completion queue]
+ *
+ * so the GIL is held only for real per-request work (protobuf/JSON +
+ * orchestrator), never for byte shuffling.  With submit==NULL the server
+ * answers every request from a canned response — the pure-native transport
+ * ceiling used by bench.py to separate wire cost from handler cost.
+ *
+ * HTTP/2 scope: what a unary gRPC client exercises — SETTINGS, HEADERS
+ * (+CONTINUATION, padding, priority), DATA, WINDOW_UPDATE (both
+ * directions, with response flow control), PING, RST_STREAM, GOAWAY, full
+ * HPACK decode (dynamic table + Huffman).  Server streaming stays on the
+ * grpc.aio tier (serving/grpc_api.py Stream RPC).
+ */
+#include "seldon_native.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <strings.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "h2util.h"
+#include "hpack.h"
+
+namespace {
+
+using namespace snh2;
+
+constexpr size_t kMaxBody = 256u << 20;    /* request body cap */
+constexpr size_t kMaxBuffered = 64u << 20; /* per-conn response backlog cap */
+constexpr size_t kMaxPipeline = 1u << 20;  /* h1 read-ahead while in flight */
+constexpr uint32_t kOurMaxFrame = 1u << 20;
+constexpr int32_t kOurInitialWindow = 1 << 20;
+constexpr int32_t kConnRecvWindow = 16 << 20;
+
+/* ------------------------------------------------------------------ h2 */
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+
+struct H2Stream {
+  std::string path;
+  std::string body;          /* raw DATA bytes (gRPC 5-byte prefix + msg) */
+  bool end_stream = false;   /* client half closed */
+  bool dispatched = false;
+  uint64_t token = 0;        /* nonzero while a submit is pending */
+  int64_t send_window = 65535;
+  std::string pending_data;      /* response DATA blocked on flow control */
+  std::string pending_trailers;  /* serialized trailers frame, sent last */
+  bool responded = false;
+};
+
+struct Conn {
+  int fd = -1;
+  bool is_h2 = false;
+  std::vector<uint8_t> rbuf;
+  size_t rlen = 0;
+  std::string wbuf;
+  size_t woff = 0;
+  bool closing = false; /* close after wbuf drains */
+
+  /* h1 state: nothing beyond the parse loop (requests are independent) */
+  bool h1_keepalive = true;
+
+  /* h2 state */
+  bool preface_done = false;
+  snhpack::Decoder hpack;
+  size_t buffered_bodies = 0; /* un-responded request-body bytes, all streams */
+  std::unordered_map<int32_t, H2Stream> streams;
+  int64_t send_window = 65535; /* connection-level, their receive budget */
+  int64_t peer_initial_window = 65535;
+  uint32_t peer_max_frame = 16384;
+  int32_t cont_stream = -1; /* CONTINUATION in progress */
+  uint8_t cont_flags = 0;
+  std::string header_block;
+  std::vector<int32_t> flow_blocked; /* streams with pending_data */
+};
+
+struct Completion {
+  uint64_t token;
+  int status;
+  std::string message;
+  std::string body;
+};
+
+struct Pending {
+  Conn *conn;
+  int32_t stream_id; /* 0 for h1 */
+};
+
+}  // namespace
+
+struct sn_http_server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;
+  uint16_t port = 0;
+  bool is_h2 = false;
+  sn_http_submit_fn submit = nullptr;
+  void *ud = nullptr;
+  pthread_t thread{};
+  bool running = false;
+  std::atomic<int> stop_flag{0};
+  std::atomic<uint64_t> n_requests{0};
+  std::unordered_map<int, Conn *> conns;
+
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  std::vector<Completion> completions; /* guarded by mu */
+  std::unordered_map<uint64_t, Pending> pending; /* IO thread only */
+  std::atomic<uint64_t> next_token{1};
+
+  int static_status = 0;
+  std::string static_body;
+
+  /* conns closed while iterating an epoll batch: the fd is closed and the
+   * conn unhooked immediately, but the Conn object is deleted only after
+   * the batch — a later evs[] entry may still point at it */
+  std::vector<Conn *> graveyard;
+};
+
+namespace {
+
+constexpr uint64_t kListenTag = 1;
+constexpr uint64_t kWakeTag = 2;
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+/* read backpressure: a client must not force unbounded buffering by
+ * pipelining while handlers are busy — h1 pauses reads past kMaxPipeline
+ * of read-ahead, h2 past kMaxBuffered of un-responded request bodies */
+bool read_paused(Conn *c) {
+  if (c->is_h2) return c->buffered_bodies >= kMaxBuffered;
+  return !c->streams.empty() && c->rlen >= kMaxPipeline;
+}
+
+void arm(sn_http_server *s, Conn *c) {
+  struct epoll_event ev;
+  ev.events = 0;
+  if (c->wbuf.size() > c->woff) ev.events |= EPOLLOUT;
+  if (c->wbuf.size() - c->woff < kMaxBuffered && !c->closing &&
+      !read_paused(c))
+    ev.events |= EPOLLIN;
+  ev.data.ptr = c;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void close_conn(sn_http_server *s, Conn *c) {
+  /* invalidate in-flight submits so late completions are dropped */
+  for (auto &kv : c->streams)
+    if (kv.second.token) s->pending.erase(kv.second.token);
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->fd);
+  c->fd = -1;
+  s->graveyard.push_back(c); /* deleted after the epoll batch */
+}
+
+bool do_write(sn_http_server *s, Conn *c);
+
+/* erase a stream, releasing its request-body bytes from the conn's
+ * backpressure budget */
+void erase_stream(Conn *c, int32_t id) {
+  auto it = c->streams.find(id);
+  if (it == c->streams.end()) return;
+  size_t b = it->second.body.size();
+  c->buffered_bodies -= b > c->buffered_bodies ? c->buffered_bodies : b;
+  c->streams.erase(it);
+}
+
+/* -------------------------------------------------------- h2 emit side */
+
+void emit_settings(std::string *out) {
+  std::string payload;
+  auto setting = [&](uint16_t id, uint32_t v) {
+    payload.push_back((char)(id >> 8));
+    payload.push_back((char)id);
+    put_u32(&payload, v);
+  };
+  setting(3, 1u << 20);                    /* MAX_CONCURRENT_STREAMS */
+  setting(4, (uint32_t)kOurInitialWindow); /* INITIAL_WINDOW_SIZE */
+  setting(5, kOurMaxFrame);                /* MAX_FRAME_SIZE */
+  frame_header(out, payload.size(), F_SETTINGS, 0, 0);
+  out->append(payload);
+  /* grow the connection receive window beyond the fixed 64 KiB default */
+  frame_header(out, 4, F_WINDOW_UPDATE, 0, 0);
+  put_u32(out, (uint32_t)(kConnRecvWindow - 65535));
+}
+
+void emit_window_update(std::string *out, int32_t stream_id, uint32_t n) {
+  frame_header(out, 4, F_WINDOW_UPDATE, 0, stream_id);
+  put_u32(out, n);
+}
+
+void emit_rst(std::string *out, int32_t stream_id, uint32_t code) {
+  frame_header(out, 4, F_RST_STREAM, 0, stream_id);
+  put_u32(out, code);
+}
+
+void emit_goaway(std::string *out, int32_t last_id, uint32_t code) {
+  frame_header(out, 8, F_GOAWAY, 0, 0);
+  put_u32(out, (uint32_t)last_id);
+  put_u32(out, code);
+}
+
+std::string grpc_trailers_frame(int32_t stream_id, int status,
+                                const std::string &message) {
+  std::string block;
+  char buf[16];
+  snprintf(buf, sizeof(buf), "%d", status);
+  snhpack::EncodeLiteral(&block, "grpc-status", buf);
+  if (!message.empty())
+    snhpack::EncodeLiteral(&block, "grpc-message", message);
+  std::string out;
+  frame_header(&out, block.size(), F_HEADERS,
+               FLAG_END_HEADERS | FLAG_END_STREAM, stream_id);
+  out.append(block);
+  return out;
+}
+
+/* response HEADERS (no END_STREAM: DATA + trailers follow) */
+void emit_response_headers(std::string *out, int32_t stream_id) {
+  std::string block;
+  snhpack::EncodeIndexed(&block, 8); /* :status 200 */
+  snhpack::EncodeLiteralIdxName(&block, 31, "application/grpc"); /* c-t */
+  frame_header(out, block.size(), F_HEADERS, FLAG_END_HEADERS, stream_id);
+  out->append(block);
+}
+
+/* Move as much of st->pending_data onto the wire as flow control allows;
+ * append trailers + close the stream once it all went. Returns true if the
+ * stream finished. */
+bool flush_stream_data(Conn *c, int32_t id, H2Stream *st) {
+  while (!st->pending_data.empty() && c->send_window > 0 &&
+         st->send_window > 0) {
+    size_t n = st->pending_data.size();
+    if ((int64_t)n > c->send_window) n = (size_t)c->send_window;
+    if ((int64_t)n > st->send_window) n = (size_t)st->send_window;
+    if (n > c->peer_max_frame) n = c->peer_max_frame;
+    frame_header(&c->wbuf, n, F_DATA, 0, id);
+    c->wbuf.append(st->pending_data, 0, n);
+    st->pending_data.erase(0, n);
+    c->send_window -= (int64_t)n;
+    st->send_window -= (int64_t)n;
+  }
+  if (st->pending_data.empty()) {
+    c->wbuf.append(st->pending_trailers);
+    return true;
+  }
+  return false;
+}
+
+/* queue the full gRPC response for a stream (headers + prefixed DATA +
+ * trailers), honoring flow control */
+void respond_grpc(sn_http_server *s, Conn *c, int32_t id, H2Stream *st,
+                  int status, const std::string &message,
+                  const uint8_t *body, size_t body_len) {
+  st->responded = true;
+  if (status != 0 || body == nullptr) {
+    /* trailers-only response (valid gRPC: HEADERS with both trailers and
+     * response-headers fields) */
+    std::string block;
+    snhpack::EncodeIndexed(&block, 8);
+    snhpack::EncodeLiteralIdxName(&block, 31, "application/grpc");
+    char buf[16];
+    snprintf(buf, sizeof(buf), "%d", status);
+    snhpack::EncodeLiteral(&block, "grpc-status", buf);
+    if (!message.empty())
+      snhpack::EncodeLiteral(&block, "grpc-message", message);
+    frame_header(&c->wbuf, block.size(), F_HEADERS,
+                 FLAG_END_HEADERS | FLAG_END_STREAM, id);
+    c->wbuf.append(block);
+    erase_stream(c, id);
+    return;
+  }
+  emit_response_headers(&c->wbuf, id);
+  st->pending_data.reserve(5 + body_len);
+  st->pending_data.push_back('\0'); /* uncompressed */
+  char len4[4] = {(char)(body_len >> 24), (char)(body_len >> 16),
+                  (char)(body_len >> 8), (char)body_len};
+  st->pending_data.append(len4, 4);
+  st->pending_data.append((const char *)body, body_len);
+  st->pending_trailers = grpc_trailers_frame(id, 0, "");
+  if (flush_stream_data(c, id, st)) {
+    erase_stream(c, id);
+  } else {
+    c->flow_blocked.push_back(id);
+  }
+  (void)s;
+}
+
+/* ------------------------------------------------------- h1 emit side */
+
+const char *h1_reason(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 401: return "Unauthorized";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 429: return "Too Many Requests";
+    case 500: return "Internal Server Error";
+    case 503: return "Service Unavailable";
+    case 504: return "Gateway Timeout";
+    default: return "Status";
+  }
+}
+
+void respond_h1(Conn *c, int status, const uint8_t *body, size_t body_len) {
+  char head[160];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
+                   status, h1_reason(status), body_len,
+                   c->h1_keepalive ? "keep-alive" : "close");
+  c->wbuf.append(head, n);
+  if (body && body_len) c->wbuf.append((const char *)body, body_len);
+  if (!c->h1_keepalive) c->closing = true;
+}
+
+/* ------------------------------------------------------------ dispatch */
+
+void dispatch_h1(sn_http_server *s, Conn *c, const std::string &method,
+                 const std::string &path, const uint8_t *body,
+                 size_t body_len) {
+  s->n_requests++;
+  if (s->submit == nullptr) {
+    respond_h1(c, s->static_status ? s->static_status : 200,
+               (const uint8_t *)s->static_body.data(),
+               s->static_body.size());
+    return;
+  }
+  uint64_t token = s->next_token++;
+  /* h1 answers in order; one request is parsed at a time per conn, so a
+   * single pending slot per conn suffices (keyed by stream_id 0) */
+  s->pending[token] = {c, 0};
+  c->streams[0].token = token; /* for invalidation on close */
+  if (s->submit(token, method.c_str(), path.c_str(), body, body_len,
+                s->ud) != 0) {
+    s->pending.erase(token);
+    erase_stream(c, 0);
+    static const char err[] =
+        "{\"status\":{\"code\":500,\"info\":\"handler rejected request\","
+        "\"status\":\"FAILURE\"}}";
+    respond_h1(c, 500, (const uint8_t *)err, sizeof(err) - 1);
+  }
+}
+
+void dispatch_h2(sn_http_server *s, Conn *c, int32_t id, H2Stream *st) {
+  s->n_requests++;
+  st->dispatched = true;
+  /* unary gRPC: exactly one length-prefixed message */
+  if (st->body.size() < 5) {
+    respond_grpc(s, c, id, st, 13, "malformed gRPC body", nullptr, 0);
+    return;
+  }
+  if (st->body[0] != 0) {
+    respond_grpc(s, c, id, st, 12, "compression not supported", nullptr, 0);
+    return;
+  }
+  uint32_t mlen = ((uint8_t)st->body[1] << 24) | ((uint8_t)st->body[2] << 16) |
+                  ((uint8_t)st->body[3] << 8) | (uint8_t)st->body[4];
+  if ((size_t)mlen + 5 != st->body.size()) {
+    respond_grpc(s, c, id, st, 13, "gRPC length prefix mismatch", nullptr, 0);
+    return;
+  }
+  if (s->submit == nullptr) {
+    respond_grpc(s, c, id, st, s->static_status,
+                 "", (const uint8_t *)s->static_body.data(),
+                 s->static_body.size());
+    return;
+  }
+  uint64_t token = s->next_token++;
+  st->token = token;
+  s->pending[token] = {c, id};
+  if (s->submit(token, "POST", st->path.c_str(),
+                (const uint8_t *)st->body.data() + 5, mlen, s->ud) != 0) {
+    s->pending.erase(token);
+    st->token = 0;
+    respond_grpc(s, c, id, st, 13, "handler rejected request", nullptr, 0);
+  }
+}
+
+/* --------------------------------------------------------- h2 parsing */
+
+bool h2_on_headers_complete(sn_http_server *s, Conn *c, int32_t id,
+                            uint8_t flags) {
+  std::vector<snhpack::Header> headers;
+  if (c->hpack.Decode((const uint8_t *)c->header_block.data(),
+                      c->header_block.size(), &headers) != 0) {
+    emit_goaway(&c->wbuf, id, 9 /* COMPRESSION_ERROR */);
+    c->closing = true;
+    return true;
+  }
+  c->header_block.clear();
+  if (c->closing) return true; /* GOAWAY sent: ignore new streams */
+  H2Stream &st = c->streams[id];
+  st.send_window = c->peer_initial_window;
+  for (auto &h : headers) {
+    if (h.name == ":path") st.path = h.value;
+  }
+  if (flags & FLAG_END_STREAM) st.end_stream = true;
+  if (st.end_stream && !st.dispatched) dispatch_h2(s, c, id, &st);
+  return true;
+}
+
+/* process one complete frame; returns false if the conn died */
+bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
+              int32_t stream_id, const uint8_t *p, size_t len) {
+  switch (type) {
+    case F_HEADERS: {
+      if (!strip_headers_prologue(p, len, flags)) goto proto_err;
+      c->header_block.append((const char *)p, len);
+      if (flags & FLAG_END_HEADERS)
+        return h2_on_headers_complete(s, c, stream_id, flags);
+      c->cont_stream = stream_id;
+      c->cont_flags = flags;
+      return true;
+    }
+    case F_CONTINUATION: {
+      if (stream_id != c->cont_stream) goto proto_err;
+      c->header_block.append((const char *)p, len);
+      if (flags & FLAG_END_HEADERS) {
+        c->cont_stream = -1;
+        return h2_on_headers_complete(s, c, stream_id, c->cont_flags);
+      }
+      return true;
+    }
+    case F_DATA: {
+      auto it = c->streams.find(stream_id);
+      size_t off = 0, payload = len;
+      if (flags & FLAG_PADDED) {
+        if (len < 1) goto proto_err;
+        uint8_t pad = p[0];
+        if ((size_t)pad + 1 > len) goto proto_err;
+        payload = len - 1 - pad;
+        off = 1;
+      }
+      /* replenish receive windows immediately (simple, always-correct) */
+      if (len > 0) {
+        emit_window_update(&c->wbuf, 0, (uint32_t)len);
+        if (it != c->streams.end() && !(flags & FLAG_END_STREAM))
+          emit_window_update(&c->wbuf, stream_id, (uint32_t)len);
+      }
+      if (it == c->streams.end()) return true; /* reset/unknown stream */
+      H2Stream &st = it->second;
+      if (st.body.size() + payload > kMaxBody) {
+        emit_rst(&c->wbuf, stream_id, 11 /* ENHANCE_YOUR_CALM */);
+        erase_stream(c, stream_id);
+        return true;
+      }
+      st.body.append((const char *)p + off, payload);
+      c->buffered_bodies += payload;
+      if (flags & FLAG_END_STREAM) {
+        st.end_stream = true;
+        if (!st.dispatched) dispatch_h2(s, c, stream_id, &st);
+      }
+      return true;
+    }
+    case F_SETTINGS: {
+      if (flags & FLAG_ACK) return true;
+      if (len % 6) goto proto_err;
+      for (size_t i = 0; i + 6 <= len; i += 6) {
+        uint16_t sid = (p[i] << 8) | p[i + 1];
+        uint32_t v = ((uint32_t)p[i + 2] << 24) | (p[i + 3] << 16) |
+                     (p[i + 4] << 8) | p[i + 5];
+        if (sid == 4) { /* INITIAL_WINDOW_SIZE: delta applies to streams */
+          int64_t delta = (int64_t)v - c->peer_initial_window;
+          c->peer_initial_window = v;
+          for (auto &kv : c->streams) kv.second.send_window += delta;
+        } else if (sid == 5) {
+          if (v >= 16384 && v <= 16777215) c->peer_max_frame = v;
+        }
+      }
+      frame_header(&c->wbuf, 0, F_SETTINGS, FLAG_ACK, 0);
+      return true;
+    }
+    case F_WINDOW_UPDATE: {
+      if (len != 4) goto proto_err;
+      uint32_t inc = (((uint32_t)p[0] << 24) | (p[1] << 16) | (p[2] << 8) |
+                      p[3]) & 0x7fffffffu;
+      if (stream_id == 0) {
+        c->send_window += inc;
+      } else {
+        auto it = c->streams.find(stream_id);
+        if (it != c->streams.end()) it->second.send_window += inc;
+      }
+      /* retry flow-blocked responses */
+      if (!c->flow_blocked.empty()) {
+        std::vector<int32_t> still;
+        for (int32_t id : c->flow_blocked) {
+          auto it = c->streams.find(id);
+          if (it == c->streams.end()) continue;
+          if (flush_stream_data(c, id, &it->second))
+            erase_stream(c, id);
+          else
+            still.push_back(id);
+        }
+        c->flow_blocked.swap(still);
+      }
+      return true;
+    }
+    case F_PING: {
+      if (len != 8) goto proto_err;
+      if (!(flags & FLAG_ACK)) {
+        frame_header(&c->wbuf, 8, F_PING, FLAG_ACK, 0);
+        c->wbuf.append((const char *)p, 8);
+      }
+      return true;
+    }
+    case F_RST_STREAM: {
+      auto it = c->streams.find(stream_id);
+      if (it != c->streams.end()) {
+        if (it->second.token) s->pending.erase(it->second.token);
+        erase_stream(c, stream_id);
+      }
+      return true;
+    }
+    case F_GOAWAY:
+      c->closing = c->streams.empty(); /* finish in-flight, then close */
+      return true;
+    case F_PRIORITY:
+    case F_PUSH_PROMISE:
+    default:
+      return true; /* ignore */
+  }
+proto_err:
+  emit_goaway(&c->wbuf, stream_id, 1 /* PROTOCOL_ERROR */);
+  c->closing = true;
+  return true;
+}
+
+bool h2_consume(sn_http_server *s, Conn *c) {
+  size_t off = 0;
+  if (!c->preface_done) {
+    if (c->rlen < kPrefaceLen) return true;
+    if (memcmp(c->rbuf.data(), kPreface, kPrefaceLen) != 0) {
+      close_conn(s, c);
+      return false;
+    }
+    c->preface_done = true;
+    emit_settings(&c->wbuf);
+    off = kPrefaceLen;
+  }
+  while (c->rlen - off >= 9) {
+    const uint8_t *h = c->rbuf.data() + off;
+    uint32_t flen = ((uint32_t)h[0] << 16) | (h[1] << 8) | h[2];
+    if (flen > kOurMaxFrame + 255) { /* beyond what we advertised */
+      close_conn(s, c);
+      return false;
+    }
+    if (c->rlen - off - 9 < flen) break;
+    uint8_t type = h[3], flags = h[4];
+    int32_t sid = (int32_t)((((uint32_t)h[5] << 24) | (h[6] << 16) |
+                             (h[7] << 8) | h[8]) & 0x7fffffffu);
+    if (!h2_frame(s, c, type, flags, sid, h + 9, flen)) return false;
+    off += 9 + flen;
+    if (c->closing) break;
+  }
+  if (off) {
+    memmove(c->rbuf.data(), c->rbuf.data() + off, c->rlen - off);
+    c->rlen -= off;
+  }
+  if (!c->wbuf.empty()) return do_write(s, c);
+  return true;
+}
+
+/* --------------------------------------------------------- h1 parsing */
+
+bool h1_consume(sn_http_server *s, Conn *c) {
+  for (;;) {
+    if (c->streams.count(0)) return true; /* a request is in flight */
+    /* find end of headers */
+    const char *buf = (const char *)c->rbuf.data();
+    const char *end = nullptr;
+    for (size_t i = 3; i < c->rlen; i++) {
+      if (buf[i] == '\n' && buf[i - 1] == '\r' && buf[i - 2] == '\n' &&
+          buf[i - 3] == '\r') {
+        end = buf + i + 1;
+        break;
+      }
+    }
+    if (!end) {
+      if (c->rlen > 64 * 1024) { /* header flood */
+        close_conn(s, c);
+        return false;
+      }
+      return true;
+    }
+    /* request line */
+    const char *sp1 = (const char *)memchr(buf, ' ', end - buf);
+    if (!sp1) goto bad;
+    {
+      const char *sp2 =
+          (const char *)memchr(sp1 + 1, ' ', end - sp1 - 1);
+      if (!sp2) goto bad;
+      std::string method(buf, sp1 - buf);
+      std::string path(sp1 + 1, sp2 - sp1 - 1);
+      /* headers we care about */
+      size_t content_length = 0;
+      bool keepalive = true;
+      const char *line = (const char *)memchr(sp2, '\n', end - sp2);
+      while (line && line + 1 < end) {
+        line++;
+        const char *eol = (const char *)memchr(line, '\n', end - line);
+        if (!eol) break;
+        size_t ll = eol - line;
+        if (ll >= 15 && strncasecmp(line, "content-length:", 15) == 0) {
+          content_length = strtoull(line + 15, nullptr, 10);
+        } else if (ll >= 11 && strncasecmp(line, "connection:", 11) == 0) {
+          const char *v = line + 11;
+          while (*v == ' ') v++;
+          if (strncasecmp(v, "close", 5) == 0) keepalive = false;
+        }
+        line = eol;
+      }
+      if (content_length > kMaxBody) goto bad;
+      size_t head_len = end - buf;
+      if (c->rlen - head_len < content_length) return true; /* need body */
+      c->h1_keepalive = keepalive;
+      dispatch_h1(s, c, method, path, (const uint8_t *)end, content_length);
+      size_t total = head_len + content_length;
+      memmove(c->rbuf.data(), c->rbuf.data() + total, c->rlen - total);
+      c->rlen -= total;
+      if (!c->wbuf.empty() && !do_write(s, c)) return false;
+      continue;
+    }
+  bad:
+    static const char err[] =
+        "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n"
+        "Connection: close\r\n\r\n";
+    c->wbuf.append(err, sizeof(err) - 1);
+    c->closing = true;
+    return do_write(s, c);
+  }
+}
+
+/* ------------------------------------------------------------- IO core */
+
+bool do_write(sn_http_server *s, Conn *c) {
+  while (c->woff < c->wbuf.size()) {
+    ssize_t n =
+        write(c->fd, c->wbuf.data() + c->woff, c->wbuf.size() - c->woff);
+    if (n > 0) {
+      c->woff += (size_t)n;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (c->woff >= (1u << 20)) {
+        c->wbuf.erase(0, c->woff);
+        c->woff = 0;
+      }
+      arm(s, c);
+      return true;
+    } else {
+      close_conn(s, c);
+      return false;
+    }
+  }
+  c->wbuf.clear();
+  c->woff = 0;
+  if (c->closing && c->streams.empty()) {
+    close_conn(s, c);
+    return false;
+  }
+  arm(s, c);
+  return true;
+}
+
+bool do_read(sn_http_server *s, Conn *c) {
+  for (;;) {
+    if (c->wbuf.size() - c->woff >= kMaxBuffered || read_paused(c)) {
+      arm(s, c); /* resume via arm() once responses drain / handlers finish */
+      return true;
+    }
+    if (c->rbuf.size() - c->rlen < 65536) c->rbuf.resize(c->rlen + 262144);
+    ssize_t n = read(c->fd, c->rbuf.data() + c->rlen,
+                     c->rbuf.size() - c->rlen);
+    if (n > 0) {
+      c->rlen += (size_t)n;
+      /* consume() returns false IFF the conn was closed (c freed) */
+      bool ok = c->is_h2 ? h2_consume(s, c) : h1_consume(s, c);
+      if (!ok) return false;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return true;
+    } else {
+      close_conn(s, c);
+      return false;
+    }
+  }
+}
+
+void drain_completions(sn_http_server *s) {
+  std::vector<Completion> done;
+  pthread_mutex_lock(&s->mu);
+  done.swap(s->completions);
+  pthread_mutex_unlock(&s->mu);
+  for (auto &comp : done) {
+    auto it = s->pending.find(comp.token);
+    if (it == s->pending.end()) continue; /* conn closed / stream reset */
+    Conn *c = it->second.conn;
+    int32_t sid = it->second.stream_id;
+    s->pending.erase(it);
+    if (c->is_h2) {
+      auto sit = c->streams.find(sid);
+      if (sit == c->streams.end()) continue;
+      sit->second.token = 0;
+      respond_grpc(s, c, sid, &sit->second, comp.status, comp.message,
+                   (const uint8_t *)comp.body.data(), comp.body.size());
+    } else {
+      erase_stream(c, 0);
+      respond_h1(c, comp.status, (const uint8_t *)comp.body.data(),
+                 comp.body.size());
+      /* parse any pipelined request that arrived meanwhile; false means
+       * the conn closed (c freed) */
+      if (!h1_consume(s, c)) continue;
+    }
+    if (!do_write(s, c)) continue;
+  }
+}
+
+void *loop(void *arg) {
+  sn_http_server *s = static_cast<sn_http_server *>(arg);
+  struct epoll_event evs[64];
+  while (!s->stop_flag) {
+    int n = epoll_wait(s->epoll_fd, evs, 64, 200);
+    for (int i = 0; i < n && !s->stop_flag; i++) {
+      if (evs[i].data.u64 == kWakeTag) {
+        uint64_t tmp;
+        ssize_t r = read(s->wake_fd, &tmp, 8);
+        (void)r;
+        drain_completions(s);
+        continue;
+      }
+      if (evs[i].data.u64 == kListenTag) {
+        for (;;) {
+          int cfd = accept(s->listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn *c = new Conn();
+          c->fd = cfd;
+          c->is_h2 = s->is_h2;
+          s->conns[cfd] = c;
+          struct epoll_event cev;
+          cev.events = EPOLLIN;
+          cev.data.ptr = c;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, cfd, &cev);
+        }
+        continue;
+      }
+      Conn *c = static_cast<Conn *>(evs[i].data.ptr);
+      /* a wake/other-conn handler earlier in THIS batch may have closed
+       * this conn; its Conn* parks in the graveyard until the batch ends,
+       * so a stale evs[] entry is detectable instead of a use-after-free */
+      bool dead = false;
+      for (Conn *g : s->graveyard)
+        if (g == c) {
+          dead = true;
+          break;
+        }
+      if (dead) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(s, c);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) {
+        if (!do_write(s, c)) continue;
+      }
+      if (evs[i].events & EPOLLIN) {
+        if (!do_read(s, c)) continue;
+      }
+    }
+    for (Conn *g : s->graveyard) delete g;
+    s->graveyard.clear();
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+sn_http_server *sn_http_server_create(const char *bind_addr, uint16_t port,
+                                      int is_http2,
+                                      sn_http_submit_fn submit, void *ud,
+                                      int reuseport) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (reuseport)
+    setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  addr.sin_addr.s_addr =
+      bind_addr && *bind_addr ? inet_addr(bind_addr) : htonl(INADDR_LOOPBACK);
+  if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) < 0 ||
+      listen(fd, 1024) < 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr *)&addr, &alen);
+  set_nonblock(fd);
+
+  sn_http_server *s = new sn_http_server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->is_h2 = is_http2 != 0;
+  s->submit = submit;
+  s->ud = ud;
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {
+    if (s->epoll_fd >= 0) close(s->epoll_fd);
+    if (s->wake_fd >= 0) close(s->wake_fd);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = kListenTag;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  struct epoll_event wev;
+  wev.events = EPOLLIN;
+  wev.data.u64 = kWakeTag;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &wev);
+  return s;
+}
+
+int sn_http_server_start(sn_http_server *s) {
+  if (!s || s->running) return -1;
+  s->stop_flag = 0;
+  if (pthread_create(&s->thread, nullptr, loop, s) != 0) return -1;
+  s->running = true;
+  return 0;
+}
+
+uint16_t sn_http_server_port(sn_http_server *s) { return s ? s->port : 0; }
+
+uint64_t sn_http_server_requests(sn_http_server *s) {
+  return s ? s->n_requests.load() : 0;
+}
+
+void sn_http_server_stop(sn_http_server *s) {
+  if (!s || !s->running) return;
+  s->stop_flag = 1;
+  uint64_t one = 1;
+  ssize_t r = write(s->wake_fd, &one, 8);
+  (void)r;
+  pthread_join(s->thread, nullptr);
+  s->running = false;
+}
+
+void sn_http_server_destroy(sn_http_server *s) {
+  if (!s) return;
+  sn_http_server_stop(s);
+  for (auto &kv : s->conns) {
+    close(kv.first);
+    delete kv.second;
+  }
+  s->conns.clear();
+  for (auto *g : s->graveyard) delete g;
+  s->graveyard.clear();
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  if (s->epoll_fd >= 0) close(s->epoll_fd);
+  if (s->wake_fd >= 0) close(s->wake_fd);
+  delete s;
+}
+
+void sn_http_complete(sn_http_server *s, uint64_t token, int status,
+                      const char *message, const uint8_t *body,
+                      uint64_t body_len) {
+  if (!s) return;
+  Completion comp;
+  comp.token = token;
+  comp.status = status;
+  if (message) comp.message = message;
+  if (body && body_len) comp.body.assign((const char *)body, body_len);
+  pthread_mutex_lock(&s->mu);
+  s->completions.push_back(std::move(comp));
+  pthread_mutex_unlock(&s->mu);
+  uint64_t one = 1;
+  ssize_t r = write(s->wake_fd, &one, 8);
+  (void)r;
+}
+
+void sn_http_set_static_response(sn_http_server *s, int status,
+                                 const uint8_t *body, uint64_t body_len) {
+  if (!s) return;
+  s->static_status = status;
+  s->static_body.assign((const char *)body, body ? body_len : 0);
+}
+
+} /* extern "C" */

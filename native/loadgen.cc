@@ -1,0 +1,505 @@
+/* Native closed-loop load generator (HTTP/1.1 POST + gRPC h2c unary).
+ *
+ * The round-2 socket benches bottlenecked on the PYTHON client: grpc.aio /
+ * aiohttp clients sharing one core with the server measured the client's
+ * own event-loop overhead, not the server.  This generator builds request
+ * bytes once, then drives N connections (x M streams for h2) from one
+ * epoll thread entirely in C — the analog of the reference's locust fleet
+ * (64 slaves / 3 nodes, docs/benchmarking.md:33-34) compressed into the
+ * one core this host has.  Latency is per request (send -> final frame),
+ * percentiles computed over the post-warmup window only.
+ *
+ * h2 client scope mirrors the server in httpserver.cc: stateless HPACK
+ * encoding for requests, full HPACK decoding for responses (grpc.aio
+ * responses use dynamic-table + Huffman), SETTINGS/PING acks, flow-control
+ * replenishment.  Request bodies must fit the peer's initial stream
+ * window (guarded; this is a benchmarking client, not a general one).
+ */
+#include "seldon_native.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <strings.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "h2util.h"
+#include "hpack.h"
+
+namespace {
+
+using namespace snh2;
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+struct LConn {
+  int fd = -1;
+  bool connected = false;
+  bool h2_setup = false;
+  std::vector<uint8_t> rbuf;
+  size_t rlen = 0;
+  std::string wbuf;
+  size_t woff = 0;
+  bool dead = false;
+
+  /* h2 */
+  snhpack::Decoder hpack;
+  std::unordered_map<int32_t, uint64_t> start_ns;
+  std::unordered_map<int32_t, bool> stream_err;
+  int32_t next_id = 1;
+  uint32_t inflight = 0;
+  int64_t send_window = 65535;
+  std::string header_block;
+  int32_t cont_stream = -1;
+  uint8_t cont_flags = 0;
+
+  /* h1 */
+  bool awaiting = false;
+  uint64_t t0 = 0;
+};
+
+struct Gen {
+  int mode; /* 0 h1, 1 h2 */
+  int epoll_fd = -1;
+  std::string req_bytes;     /* h1: full request; h2: HEADERS+DATA frames
+                                with stream id patched per request */
+  std::string h2_headers_block;
+  std::string body;
+  uint32_t depth = 1;
+  std::vector<LConn *> conns;
+  /* stats */
+  std::vector<double> lat_ms;
+  uint64_t requests = 0, errors = 0;
+  bool measuring = false;
+  uint64_t t_measure_start = 0;
+  uint64_t deadline_ns = 0;
+  struct sockaddr_in addr {};
+};
+
+void record(Gen *g, uint64_t t0, bool err) {
+  if (g->measuring) {
+    g->requests++;
+    if (err) g->errors++;
+    g->lat_ms.push_back((double)(now_ns() - t0) / 1e6);
+  }
+}
+
+void arm(Gen *g, LConn *c) {
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  if (!c->connected || c->wbuf.size() > c->woff) ev.events |= EPOLLOUT;
+  ev.data.ptr = c;
+  epoll_ctl(g->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+bool flush(Gen *g, LConn *c) {
+  while (c->woff < c->wbuf.size()) {
+    ssize_t n =
+        write(c->fd, c->wbuf.data() + c->woff, c->wbuf.size() - c->woff);
+    if (n > 0) {
+      c->woff += (size_t)n;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (c->woff >= (1u << 20)) {
+        c->wbuf.erase(0, c->woff);
+        c->woff = 0;
+      }
+      arm(g, c);
+      return true;
+    } else {
+      c->dead = true;
+      return false;
+    }
+  }
+  c->wbuf.clear();
+  c->woff = 0;
+  arm(g, c);
+  return true;
+}
+
+/* ---- request senders ---- */
+
+void h1_send(Gen *g, LConn *c) {
+  if (c->awaiting || c->dead) return;
+  if (now_ns() >= g->deadline_ns) return;
+  c->wbuf.append(g->req_bytes);
+  c->t0 = now_ns();
+  c->awaiting = true;
+  flush(g, c);
+}
+
+void h2_open_streams(Gen *g, LConn *c) {
+  if (!c->h2_setup || c->dead) return;
+  uint64_t now = now_ns();
+  if (now >= g->deadline_ns) return;
+  while (c->inflight < g->depth && c->wbuf.size() - c->woff < (1u << 20) &&
+         c->send_window >= (int64_t)g->body.size() + 5) {
+    int32_t id = c->next_id;
+    c->next_id += 2;
+    frame_header(&c->wbuf, g->h2_headers_block.size(), F_HEADERS,
+                 FLAG_END_HEADERS, id);
+    c->wbuf.append(g->h2_headers_block);
+    uint32_t dlen = (uint32_t)g->body.size() + 5;
+    frame_header(&c->wbuf, dlen, F_DATA, FLAG_END_STREAM, id);
+    c->wbuf.push_back('\0');
+    put_u32(&c->wbuf, (uint32_t)g->body.size());
+    c->wbuf.append(g->body);
+    c->send_window -= dlen;
+    c->start_ns[id] = now_ns();
+    c->inflight++;
+  }
+  flush(g, c);
+}
+
+void h2_complete(Gen *g, LConn *c, int32_t id, bool err) {
+  auto it = c->start_ns.find(id);
+  if (it == c->start_ns.end()) return;
+  bool serr = err || c->stream_err.count(id);
+  record(g, it->second, serr);
+  c->start_ns.erase(it);
+  c->stream_err.erase(id);
+  if (c->inflight) c->inflight--;
+  h2_open_streams(g, c);
+}
+
+/* ---- h2 response parsing ---- */
+
+void h2_headers_done(Gen *g, LConn *c, int32_t sid, uint8_t flags) {
+  std::vector<snhpack::Header> hs;
+  if (c->hpack.Decode((const uint8_t *)c->header_block.data(),
+                      c->header_block.size(), &hs) != 0) {
+    c->dead = true;
+    return;
+  }
+  c->header_block.clear();
+  for (auto &h : hs) {
+    if (h.name == "grpc-status" && h.value != "0")
+      c->stream_err[sid] = true;
+    if (h.name == ":status" && h.value.size() && h.value[0] != '2')
+      c->stream_err[sid] = true;
+  }
+  if (flags & FLAG_END_STREAM) h2_complete(g, c, sid, false);
+}
+
+void h2_consume(Gen *g, LConn *c) {
+  size_t off = 0;
+  while (c->rlen - off >= 9 && !c->dead) {
+    const uint8_t *h = c->rbuf.data() + off;
+    uint32_t flen = ((uint32_t)h[0] << 16) | (h[1] << 8) | h[2];
+    if (c->rlen - off - 9 < flen) break;
+    uint8_t type = h[3], flags = h[4];
+    int32_t sid = (int32_t)((((uint32_t)h[5] << 24) | (h[6] << 16) |
+                             (h[7] << 8) | h[8]) & 0x7fffffffu);
+    const uint8_t *p = h + 9;
+    size_t len = flen;
+    switch (type) {
+      case F_HEADERS: {
+        if (!strip_headers_prologue(p, len, flags)) {
+          c->dead = true; /* malformed peer frame: stop using this conn */
+          break;
+        }
+        c->header_block.append((const char *)p, len);
+        if (flags & FLAG_END_HEADERS)
+          h2_headers_done(g, c, sid, flags);
+        else {
+          c->cont_stream = sid;
+          c->cont_flags = flags;
+        }
+        break;
+      }
+      case F_CONTINUATION:
+        c->header_block.append((const char *)p, len);
+        if (flags & FLAG_END_HEADERS) h2_headers_done(g, c, sid, c->cont_flags);
+        break;
+      case F_DATA:
+        if (len > 0) {
+          frame_header(&c->wbuf, 4, F_WINDOW_UPDATE, 0, 0);
+          put_u32(&c->wbuf, (uint32_t)len);
+          if (!(flags & FLAG_END_STREAM)) {
+            frame_header(&c->wbuf, 4, F_WINDOW_UPDATE, 0, sid);
+            put_u32(&c->wbuf, (uint32_t)len);
+          }
+        }
+        if (flags & FLAG_END_STREAM) h2_complete(g, c, sid, false);
+        break;
+      case F_SETTINGS:
+        if (!(flags & FLAG_ACK))
+          frame_header(&c->wbuf, 0, F_SETTINGS, FLAG_ACK, 0);
+        break;
+      case F_PING:
+        if (!(flags & FLAG_ACK) && len == 8) {
+          frame_header(&c->wbuf, 8, F_PING, FLAG_ACK, 0);
+          c->wbuf.append((const char *)p, 8);
+        }
+        break;
+      case F_WINDOW_UPDATE:
+        if (len == 4 && sid == 0)
+          c->send_window += (((uint32_t)p[0] << 24) | (p[1] << 16) |
+                             (p[2] << 8) | p[3]) & 0x7fffffffu;
+        break;
+      case F_RST_STREAM:
+        h2_complete(g, c, sid, true);
+        break;
+      case F_GOAWAY:
+        c->dead = true;
+        break;
+      default:
+        break;
+    }
+    off += 9 + flen;
+  }
+  if (off) {
+    memmove(c->rbuf.data(), c->rbuf.data() + off, c->rlen - off);
+    c->rlen -= off;
+  }
+  if (!c->wbuf.empty()) flush(g, c);
+}
+
+/* ---- h1 response parsing ---- */
+
+void h1_consume(Gen *g, LConn *c) {
+  for (;;) {
+    const char *buf = (const char *)c->rbuf.data();
+    const char *hdr_end = nullptr;
+    for (size_t i = 3; i < c->rlen; i++) {
+      if (buf[i] == '\n' && buf[i - 1] == '\r' && buf[i - 2] == '\n' &&
+          buf[i - 3] == '\r') {
+        hdr_end = buf + i + 1;
+        break;
+      }
+    }
+    if (!hdr_end) return;
+    int status = 0;
+    if (c->rlen > 12 && memcmp(buf, "HTTP/1.", 7) == 0)
+      status = atoi(buf + 9);
+    size_t content_length = (size_t)-1;
+    const char *line = (const char *)memchr(buf, '\n', hdr_end - buf);
+    while (line && line + 1 < hdr_end) {
+      line++;
+      const char *eol = (const char *)memchr(line, '\n', hdr_end - line);
+      if (!eol) break;
+      if ((size_t)(eol - line) >= 15 &&
+          strncasecmp(line, "content-length:", 15) == 0)
+        content_length = strtoull(line + 15, nullptr, 10);
+      line = eol;
+    }
+    if (content_length == (size_t)-1) { /* chunked: unsupported here */
+      c->dead = true;
+      if (g->measuring) g->errors++;
+      return;
+    }
+    size_t head_len = hdr_end - buf;
+    if (c->rlen - head_len < content_length) return;
+    size_t total = head_len + content_length;
+    memmove(c->rbuf.data(), c->rbuf.data() + total, c->rlen - total);
+    c->rlen -= total;
+    c->awaiting = false;
+    record(g, c->t0, status < 200 || status >= 300);
+    h1_send(g, c);
+    if (c->dead || c->awaiting == false) return; /* deadline reached */
+  }
+}
+
+LConn *make_conn(Gen *g) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  int rc = connect(fd, (struct sockaddr *)&g->addr, sizeof(g->addr));
+  if (rc < 0 && errno != EINPROGRESS) {
+    close(fd);
+    return nullptr;
+  }
+  LConn *c = new LConn();
+  c->fd = fd;
+  struct epoll_event ev;
+  ev.events = EPOLLIN | EPOLLOUT;
+  ev.data.ptr = c;
+  epoll_ctl(g->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+  return c;
+}
+
+void on_connected(Gen *g, LConn *c) {
+  c->connected = true;
+  if (g->mode == 1) {
+    c->wbuf.append("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n");
+    frame_header(&c->wbuf, 0, F_SETTINGS, 0, 0); /* empty settings */
+    frame_header(&c->wbuf, 4, F_WINDOW_UPDATE, 0, 0);
+    put_u32(&c->wbuf, (16u << 20) - 65535);
+    c->h2_setup = true;
+    h2_open_streams(g, c);
+  } else {
+    h1_send(g, c);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int sn_loadgen_run(int mode, const char *host, uint16_t port,
+                   const char *path, const uint8_t *body, uint64_t body_len,
+                   uint32_t connections, uint32_t streams_per_conn,
+                   double seconds, double warmup_s, sn_load_result *out) {
+  if (!out || !path || connections == 0) return -1;
+  if (mode == 1 && body_len + 5 > 60000) return -2; /* see file header */
+  memset(out, 0, sizeof(*out));
+
+  Gen g;
+  g.mode = mode;
+  g.depth = mode == 1 ? (streams_per_conn ? streams_per_conn : 1) : 1;
+  g.body.assign((const char *)body, body ? body_len : 0);
+  memset(&g.addr, 0, sizeof(g.addr));
+  g.addr.sin_family = AF_INET;
+  g.addr.sin_port = htons(port);
+  g.addr.sin_addr.s_addr =
+      host && *host ? inet_addr(host) : htonl(INADDR_LOOPBACK);
+
+  if (mode == 0) {
+    char head[512];
+    int n = snprintf(head, sizeof(head),
+                     "POST %s HTTP/1.1\r\nHost: bench\r\n"
+                     "Content-Type: application/json\r\n"
+                     "Content-Length: %llu\r\nConnection: keep-alive\r\n\r\n",
+                     path, (unsigned long long)body_len);
+    g.req_bytes.assign(head, n);
+    g.req_bytes.append(g.body);
+  } else {
+    /* stateless request header block: no dynamic table, no Huffman */
+    std::string *b = &g.h2_headers_block;
+    snhpack::EncodeIndexed(b, 3); /* :method POST */
+    snhpack::EncodeIndexed(b, 6); /* :scheme http */
+    snhpack::EncodeLiteralIdxName(b, 4, path);     /* :path */
+    snhpack::EncodeLiteralIdxName(b, 1, "bench");  /* :authority */
+    snhpack::EncodeLiteralIdxName(b, 31, "application/grpc");
+    snhpack::EncodeLiteral(b, "te", "trailers");
+  }
+
+  g.epoll_fd = epoll_create1(0);
+  if (g.epoll_fd < 0) return -1;
+  uint64_t t0 = now_ns();
+  uint64_t warmup_end = t0 + (uint64_t)(warmup_s * 1e9);
+  g.deadline_ns = warmup_end + (uint64_t)(seconds * 1e9);
+  g.lat_ms.reserve(1u << 20);
+
+  for (uint32_t i = 0; i < connections; i++) {
+    LConn *c = make_conn(&g);
+    if (c) g.conns.push_back(c);
+  }
+  if (g.conns.empty()) {
+    close(g.epoll_fd);
+    return -1;
+  }
+
+  struct epoll_event evs[64];
+  for (;;) {
+    uint64_t now = now_ns();
+    if (now >= g.deadline_ns) break;
+    if (!g.measuring && now >= warmup_end) {
+      g.measuring = true;
+      g.t_measure_start = now;
+      g.lat_ms.clear();
+      g.requests = 0;
+      g.errors = 0;
+    }
+    int n = epoll_wait(g.epoll_fd, evs, 64, 20);
+    for (int i = 0; i < n; i++) {
+      LConn *c = (LConn *)evs[i].data.ptr;
+      if (c->dead) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        c->dead = true;
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) {
+        if (!c->connected) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          if (err) {
+            c->dead = true;
+            continue;
+          }
+          on_connected(&g, c);
+        } else if (!flush(&g, c)) {
+          continue;
+        } else if (g.mode == 1) {
+          h2_open_streams(&g, c); /* wbuf drained: top up streams */
+        }
+      }
+      if (evs[i].events & EPOLLIN) {
+        for (;;) {
+          if (c->rbuf.size() - c->rlen < 65536)
+            c->rbuf.resize(c->rlen + 262144);
+          ssize_t r =
+              read(c->fd, c->rbuf.data() + c->rlen, c->rbuf.size() - c->rlen);
+          if (r > 0) {
+            c->rlen += (size_t)r;
+          } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            break;
+          } else {
+            c->dead = true;
+            break;
+          }
+        }
+        if (!c->dead) {
+          if (g.mode == 1)
+            h2_consume(&g, c);
+          else
+            h1_consume(&g, c);
+        }
+      }
+    }
+    /* all conns dead -> bail */
+    bool any = false;
+    for (auto *c : g.conns)
+      if (!c->dead) any = true;
+    if (!any) break;
+  }
+
+  uint64_t t_end = now_ns();
+  double window =
+      g.measuring ? (double)(t_end - g.t_measure_start) / 1e9 : 0.0;
+  out->requests = g.requests;
+  out->errors = g.errors;
+  out->seconds = window;
+  out->req_per_s = window > 0 ? (double)g.requests / window : 0.0;
+  if (!g.lat_ms.empty()) {
+    std::sort(g.lat_ms.begin(), g.lat_ms.end());
+    auto pct = [&](double p) {
+      size_t idx = (size_t)(p * (g.lat_ms.size() - 1));
+      return g.lat_ms[idx];
+    };
+    out->p50_ms = pct(0.50);
+    out->p90_ms = pct(0.90);
+    out->p99_ms = pct(0.99);
+    double sum = 0;
+    for (double v : g.lat_ms) sum += v;
+    out->mean_ms = sum / g.lat_ms.size();
+  }
+  for (auto *c : g.conns) {
+    close(c->fd);
+    delete c;
+  }
+  close(g.epoll_fd);
+  return 0;
+}
+
+} /* extern "C" */

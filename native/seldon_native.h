@@ -170,6 +170,65 @@ uint64_t sn_server_requests(sn_server *s);
 int sn_echo_handler(const uint8_t *req, uint64_t req_len, uint8_t **resp,
                     uint64_t *resp_len, void *ud);
 
+/* ---------------------------------------------- HTTP/1.1 + HTTP/2 servers */
+
+typedef struct sn_http_server sn_http_server;
+
+/* Async request handler.  Called on the IO thread with pointers valid ONLY
+ * for the duration of the call (copy what you keep).  The callee must
+ * eventually call sn_http_complete(token) from any thread.  Return nonzero
+ * to fail the request immediately (500 / grpc INTERNAL). */
+typedef int (*sn_http_submit_fn)(uint64_t token, const char *method,
+                                 const char *path, const uint8_t *body,
+                                 uint64_t body_len, void *ud);
+
+/* is_http2: 0 = HTTP/1.1 REST server, 1 = gRPC h2c server (prior-knowledge
+ * HTTP/2, unary RPCs; body passed to submit is the protobuf message with
+ * the 5-byte gRPC prefix already stripped/validated).
+ * submit == NULL: static-response mode (see sn_http_set_static_response).
+ * reuseport: bind with SO_REUSEPORT for multi-process worker scaling. */
+sn_http_server *sn_http_server_create(const char *bind_addr, uint16_t port,
+                                      int is_http2,
+                                      sn_http_submit_fn submit, void *ud,
+                                      int reuseport);
+int sn_http_server_start(sn_http_server *s);
+uint16_t sn_http_server_port(sn_http_server *s);
+uint64_t sn_http_server_requests(sn_http_server *s);
+void sn_http_server_stop(sn_http_server *s);
+void sn_http_server_destroy(sn_http_server *s);
+
+/* Complete a submitted request (any thread).
+ * HTTP/2: status = grpc-status (0 OK), message = grpc-message or NULL.
+ * HTTP/1.1: status = HTTP status, message ignored. */
+void sn_http_complete(sn_http_server *s, uint64_t token, int status,
+                      const char *message, const uint8_t *body,
+                      uint64_t body_len);
+
+/* Canned response for static mode (h2: status is the grpc-status). */
+void sn_http_set_static_response(sn_http_server *s, int status,
+                                 const uint8_t *body, uint64_t body_len);
+
+/* -------------------------------------------------------- load generator */
+
+typedef struct {
+  uint64_t requests; /* completed in the measured window */
+  uint64_t errors;   /* non-2xx / grpc-status!=0 / transport errors */
+  double seconds;    /* measured window wall time */
+  double req_per_s;
+  double p50_ms, p90_ms, p99_ms, mean_ms;
+} sn_load_result;
+
+/* Closed-loop load over real sockets, C-side request generation/parsing so
+ * the client never bottlenecks on an interpreter.  mode: 0 = HTTP/1.1 POST
+ * (body = full JSON payload), 1 = gRPC h2c unary (body = serialized
+ * request protobuf; the 5-byte gRPC prefix is added on the wire).
+ * streams_per_conn: concurrent streams per connection (h2 only; h1 runs
+ * one request at a time per connection).  Returns 0 on success. */
+int sn_loadgen_run(int mode, const char *host, uint16_t port,
+                   const char *path, const uint8_t *body, uint64_t body_len,
+                   uint32_t connections, uint32_t streams_per_conn,
+                   double seconds, double warmup_s, sn_load_result *out);
+
 #ifdef __cplusplus
 }
 #endif

@@ -30,6 +30,8 @@ __all__ = [
     "Frame",
     "NativeBatchQueue",
     "FramedServer",
+    "NativeHttpServer",
+    "run_native_load",
     "MSG_PREDICT",
     "MSG_RESPONSE",
     "MSG_FEEDBACK",
@@ -111,6 +113,30 @@ _HANDLER = C.CFUNCTYPE(
     C.POINTER(C.c_uint64),
     C.c_void_p,
 )
+
+# sn_http_submit_fn(token, method, path, body, body_len, ud)
+_HTTP_SUBMIT = C.CFUNCTYPE(
+    C.c_int,
+    C.c_uint64,
+    C.c_char_p,
+    C.c_char_p,
+    C.POINTER(C.c_uint8),
+    C.c_uint64,
+    C.c_void_p,
+)
+
+
+class _LoadResult(C.Structure):
+    _fields_ = [
+        ("requests", C.c_uint64),
+        ("errors", C.c_uint64),
+        ("seconds", C.c_double),
+        ("req_per_s", C.c_double),
+        ("p50_ms", C.c_double),
+        ("p90_ms", C.c_double),
+        ("p99_ms", C.c_double),
+        ("mean_ms", C.c_double),
+    ]
 
 _lib: Optional[C.CDLL] = None
 _lib_lock = threading.Lock()
@@ -247,6 +273,31 @@ def _bind(lib: C.CDLL) -> None:
     lib.sn_server_requests.restype = C.c_uint64
     lib.sn_server_requests.argtypes = [C.c_void_p]
     lib.sn_echo_handler.restype = C.c_int
+
+    lib.sn_http_server_create.restype = C.c_void_p
+    lib.sn_http_server_create.argtypes = [
+        C.c_char_p, C.c_uint16, C.c_int, _HTTP_SUBMIT, C.c_void_p, C.c_int,
+    ]
+    lib.sn_http_server_start.restype = C.c_int
+    lib.sn_http_server_start.argtypes = [C.c_void_p]
+    lib.sn_http_server_port.restype = C.c_uint16
+    lib.sn_http_server_port.argtypes = [C.c_void_p]
+    lib.sn_http_server_requests.restype = C.c_uint64
+    lib.sn_http_server_requests.argtypes = [C.c_void_p]
+    lib.sn_http_server_stop.argtypes = [C.c_void_p]
+    lib.sn_http_server_destroy.argtypes = [C.c_void_p]
+    lib.sn_http_complete.argtypes = [
+        C.c_void_p, C.c_uint64, C.c_int, C.c_char_p, u8p, C.c_uint64,
+    ]
+    lib.sn_http_set_static_response.argtypes = [
+        C.c_void_p, C.c_int, u8p, C.c_uint64,
+    ]
+    lib.sn_loadgen_run.restype = C.c_int
+    lib.sn_loadgen_run.argtypes = [
+        C.c_int, C.c_char_p, C.c_uint16, C.c_char_p, u8p, C.c_uint64,
+        C.c_uint32, C.c_uint32, C.c_double, C.c_double,
+        C.POINTER(_LoadResult),
+    ]
 
 
 HAVE_NATIVE = load() is not None
@@ -487,3 +538,146 @@ class FramedServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class NativeHttpServer:
+    """Native HTTP/1.1 (REST) or HTTP/2 h2c (gRPC unary) server.
+
+    ``submit(token, method, path, body)`` is called on the IO thread with
+    COPIED bytes; the handler must eventually call
+    ``server.complete(token, status, body, message)`` from any thread.
+    With ``submit=None`` the server runs in static-response mode (set via
+    ``set_static_response``) — the pure-native transport ceiling.
+
+    The higher-level asyncio bridge lives in ``serving/native_http.py``.
+    """
+
+    def __init__(
+        self,
+        submit: Optional[Callable[[int, str, str, bytes], None]] = None,
+        http2: bool = False,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+        reuseport: bool = False,
+    ):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.http2 = http2
+        if submit is None:
+            self._cb = C.cast(None, _HTTP_SUBMIT)
+        else:
+
+            def trampoline(token, method, path, body_p, body_len, _ud):
+                try:
+                    body = C.string_at(body_p, body_len) if body_len else b""
+                    submit(
+                        token,
+                        method.decode(),
+                        path.decode(errors="replace"),
+                        body,
+                    )
+                    return 0
+                except Exception:
+                    return 1  # native side answers 500 / grpc INTERNAL
+
+            self._cb = _HTTP_SUBMIT(trampoline)
+        self._h = self._lib.sn_http_server_create(
+            bind.encode(), port, 1 if http2 else 0, self._cb, None,
+            1 if reuseport else 0,
+        )
+        if not self._h:
+            raise OSError(f"failed to bind {bind}:{port}")
+
+    def set_static_response(self, status: int, body: bytes) -> None:
+        buf = (C.c_uint8 * max(len(body), 1)).from_buffer_copy(body or b"\0")
+        self._lib.sn_http_set_static_response(
+            self._h, status, buf, len(body)
+        )
+
+    def complete(
+        self,
+        token: int,
+        status: int,
+        body: bytes = b"",
+        message: Optional[str] = None,
+    ) -> None:
+        buf = (
+            (C.c_uint8 * len(body)).from_buffer_copy(body) if body else None
+        )
+        self._lib.sn_http_complete(
+            self._h, token, status,
+            message.encode() if message else None, buf, len(body),
+        )
+
+    def start(self) -> "NativeHttpServer":
+        if self._lib.sn_http_server_start(self._h) != 0:
+            raise OSError("failed to start server thread")
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._lib.sn_http_server_port(self._h)
+
+    @property
+    def requests(self) -> int:
+        return self._lib.sn_http_server_requests(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.sn_http_server_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "NativeHttpServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def run_native_load(
+    mode: str,
+    host: str,
+    port: int,
+    path: str,
+    body: bytes,
+    connections: int = 16,
+    streams_per_conn: int = 8,
+    seconds: float = 3.0,
+    warmup_s: float = 0.3,
+) -> dict:
+    """Blocking native closed-loop load run (releases the GIL for the whole
+    window — the client costs zero interpreter time).
+
+    ``mode``: ``"rest"`` (HTTP/1.1 POST) or ``"grpc"`` (h2c unary;
+    ``body`` is the serialized request protobuf)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    m = {"rest": 0, "grpc": 1}[mode]
+    res = _LoadResult()
+    buf = (C.c_uint8 * max(len(body), 1)).from_buffer_copy(body or b"\0")
+    rc = lib.sn_loadgen_run(
+        m, host.encode(), port, path.encode(), buf, len(body),
+        connections, streams_per_conn, seconds, warmup_s, C.byref(res),
+    )
+    if rc != 0:
+        raise RuntimeError(f"loadgen failed (code {rc})")
+    return {
+        "requests": res.requests,
+        "errors": res.errors,
+        "seconds": round(res.seconds, 3),
+        "req_per_s": round(res.req_per_s, 1),
+        "latency_ms": {
+            "p50": round(res.p50_ms, 3),
+            "p90": round(res.p90_ms, 3),
+            "p99": round(res.p99_ms, 3),
+            "mean": round(res.mean_ms, 3),
+        },
+    }

@@ -240,7 +240,24 @@ def engine_main(argv: Optional[list] = None) -> None:
                          " (compile.py); reference engine gRPC is port 5000 "
                          "(SeldonGrpcServer.java:37)")
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--native-port", type=int,
+                    default=int(os.environ.get("ENGINE_NATIVE_PORT", "0")),
+                    help="native (C++ epoll) REST tier port; 0 disables")
+    ap.add_argument("--native-grpc-port", type=int,
+                    default=int(os.environ.get("ENGINE_NATIVE_GRPC_PORT", "0")),
+                    help="native (C++ h2c) unary gRPC tier port; 0 disables")
+    ap.add_argument("--workers", type=int,
+                    default=int(os.environ.get("ENGINE_WORKERS", "1")),
+                    help="SO_REUSEPORT worker processes (all tiers); each "
+                         "worker runs an independent engine")
     args = ap.parse_args(argv)
+    # fork BEFORE jax/threads initialize (serving/workers.py contract)
+    reuse_port = args.workers > 1
+    if reuse_port:
+        from seldon_core_tpu.serving.workers import fork_workers
+
+        worker_idx = fork_workers(args.workers)
+        print(f"worker {worker_idx} (pid {os.getpid()})", flush=True)
     _honor_jax_platforms_env()
     # multi-host slice pods join the jax.distributed mesh BEFORE any jax
     # call (operator-injected env; no-op single-host)
@@ -266,7 +283,7 @@ def engine_main(argv: Optional[list] = None) -> None:
         from seldon_core_tpu.serving.rest import build_app, start_server
 
         app = build_app(engine=local, metrics=local.metrics)
-        await start_server(app, args.host, args.port)
+        await start_server(app, args.host, args.port, reuse_port=reuse_port)
         if args.grpc_port:
             from seldon_core_tpu.serving.grpc_api import (
                 GrpcServer,
@@ -280,6 +297,24 @@ def engine_main(argv: Optional[list] = None) -> None:
             await gserver.start()
             print(f"gRPC Seldon service on {args.host}:{gserver.port}",
                   flush=True)
+        if args.native_port:
+            from seldon_core_tpu.serving.native_http import NativeRestServer
+
+            nrest = NativeRestServer(
+                engine=local, metrics=local.metrics, port=args.native_port,
+                bind=args.host, reuseport=reuse_port,
+            )
+            await nrest.start()
+            print(f"native REST tier on {args.host}:{nrest.port}", flush=True)
+        if args.native_grpc_port:
+            from seldon_core_tpu.serving.native_http import NativeGrpcServer
+
+            ngrpc = NativeGrpcServer(
+                deployment=local, port=args.native_grpc_port,
+                bind=args.host, reuseport=reuse_port,
+            )
+            await ngrpc.start()
+            print(f"native gRPC tier on {args.host}:{ngrpc.port}", flush=True)
         print(f"serving deployment {dep.name!r} on {args.host}:{args.port}",
               flush=True)
         await asyncio.Event().wait()

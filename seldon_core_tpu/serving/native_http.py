@@ -1,0 +1,376 @@
+"""Native wire tier: asyncio bridges over the C++ HTTP/1.1 + HTTP/2 servers.
+
+The reference serves its hot path from JVM servers (gRPC:
+``engine/src/main/java/io/seldon/engine/grpc/SeldonGrpcServer.java:37-127``,
+REST: ``api/rest/RestClientController.java:103``).  Round 2 matched the
+surface with grpc.aio/aiohttp, but Python servers cap the wire at ~0.1-0.4x
+the reference's throughput on this host.  This module puts the native epoll
+servers (``native/httpserver.cc``) in front of the SAME Python handlers:
+protocol bytes never touch the interpreter; each request crosses into
+Python exactly once (protobuf/JSON + engine call) through the async
+submit/complete ABI.
+
+Two routers share one bridge mechanism:
+
+- :class:`NativeGrpcServer` — the external ``Seldon`` service and the
+  per-role component services (unary methods of serving/grpc_api.py's
+  SERVICE_METHODS), wire-compatible with reference grpc clients.  Server
+  streaming (``Stream`` RPC) stays on the grpc.aio tier.
+- :class:`NativeRestServer` — the external prediction API + internal
+  microservice API routes of serving/rest.py, JSON-compatible.
+
+Both run all handler work on the caller's asyncio loop, so engines,
+components, metrics, and the dynamic batcher behave identically to the
+Python-server tiers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Awaitable, Callable, Optional
+
+from seldon_core_tpu.messages import Feedback, SeldonMessage, Status
+from seldon_core_tpu.native import NativeHttpServer
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["NativeGrpcServer", "NativeRestServer"]
+
+# router result: (status, body_bytes, message) — status is the grpc-status
+# for h2 and the HTTP status for h1
+_Result = "tuple[int, bytes, Optional[str]]"
+
+
+class _AsyncBridge:
+    """Pumps native-server submissions onto an asyncio loop and completions
+    back.  One instance per server."""
+
+    def __init__(
+        self,
+        router: Callable[[str, str, bytes], Awaitable[Any]],
+        http2: bool,
+        port: int = 0,
+        bind: str = "0.0.0.0",
+        reuseport: bool = False,
+        error_result: Callable[[Exception], Any] = None,
+    ):
+        self._router = router
+        self._error_result = error_result
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._tasks: set = set()
+        self.server = NativeHttpServer(
+            submit=self._submit, http2=http2, port=port, bind=bind,
+            reuseport=reuseport,
+        )
+
+    # IO thread (GIL held by ctypes) — enqueue and return immediately
+    def _submit(self, token: int, method: str, path: str, body: bytes) -> None:
+        self._loop.call_soon_threadsafe(self._spawn, token, method, path, body)
+
+    def _spawn(self, token: int, method: str, path: str, body: bytes) -> None:
+        t = self._loop.create_task(self._run(token, method, path, body))
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _run(self, token, method, path, body) -> None:
+        try:
+            status, out, msg = await self._router(method, path, body)
+        except Exception as e:  # router bug: fail the request, keep serving
+            logger.exception("native bridge handler failed (%s)", path)
+            status, out, msg = self._error_result(e)
+        self.server.complete(token, status, out, msg)
+
+    async def start(self) -> int:
+        self._loop = asyncio.get_running_loop()
+        self.server.start()
+        return self.server.port
+
+    async def stop(self) -> None:
+        self.server.stop()
+        for t in list(self._tasks):
+            t.cancel()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+
+# ---------------------------------------------------------------------------
+# gRPC (h2c) router
+# ---------------------------------------------------------------------------
+
+
+class NativeGrpcServer:
+    """Unary gRPC over the native h2c server.
+
+    ``deployment``: object with async ``predict(msg)`` / ``send_feedback(fb)``
+    (engine mode, external ``Seldon`` service).  ``component``: a
+    ComponentHandle (adds the per-role internal services).  ``auth``:
+    optional ``(metadata_dict) -> principal_or_None`` — note the native tier
+    does not parse request metadata, so auth'd deployments must keep the
+    grpc.aio front (the gateway); this mirrors the reference's split where
+    apife authenticates and the engine trusts its caller.
+    """
+
+    def __init__(
+        self,
+        deployment: Any = None,
+        component: Any = None,
+        port: int = 0,
+        bind: str = "0.0.0.0",
+        reuseport: bool = False,
+    ):
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.proto.convert import (
+            feedback_from_proto,
+            message_from_proto,
+            message_to_proto,
+        )
+        from seldon_core_tpu.serving.grpc_api import (
+            _PKG,
+            SERVICE_METHODS,
+            _ComponentRpc,
+        )
+
+        self._pb = pb
+        self._routes: dict[str, Callable[[bytes], Awaitable[bytes]]] = {}
+
+        if deployment is not None:
+
+            async def _predict(data: bytes) -> bytes:
+                req = pb.SeldonMessage.FromString(data)
+                out = await deployment.predict(message_from_proto(req))
+                return message_to_proto(out).SerializeToString()
+
+            async def _feedback(data: bytes) -> bytes:
+                req = pb.Feedback.FromString(data)
+                out = await deployment.send_feedback(feedback_from_proto(req))
+                return message_to_proto(out).SerializeToString()
+
+            self._routes[f"/{_PKG}.Seldon/Predict"] = _predict
+            self._routes[f"/{_PKG}.Seldon/SendFeedback"] = _feedback
+
+        if component is not None:
+            rpc = _ComponentRpc(component)
+            for svc, methods in SERVICE_METHODS.items():
+                if svc == "Seldon":
+                    continue
+                for method, (req_cls, _resp_cls) in methods.items():
+
+                    async def _call(data: bytes, _m=method, _rc=req_cls):
+                        req = _rc.FromString(data)
+                        out = await rpc.call(_m, req)
+                        return out.SerializeToString()
+
+                    self._routes[f"/{_PKG}.{svc}/{method}"] = _call
+
+        self._bridge = _AsyncBridge(
+            self._route, http2=True, port=port, bind=bind,
+            reuseport=reuseport, error_result=self._error,
+        )
+
+    @staticmethod
+    def _error(e: Exception):
+        return (13, b"", f"{type(e).__name__}: {e}")  # INTERNAL
+
+    async def _route(self, method: str, path: str, body: bytes):
+        fn = self._routes.get(path)
+        if fn is None:
+            return (12, b"", f"unknown method {path}")  # UNIMPLEMENTED
+        try:
+            out = await fn(body)
+        except Exception as e:
+            # component-level errors already map to FAILURE SeldonMessages
+            # inside _ComponentRpc; anything surfacing here is a wire/proto
+            # problem
+            logger.exception("native gRPC handler failed (%s)", path)
+            return (13, b"", f"{type(e).__name__}: {e}")
+        return (0, out, None)
+
+    async def start(self) -> int:
+        return await self._bridge.start()
+
+    async def stop(self) -> None:
+        await self._bridge.stop()
+
+    @property
+    def port(self) -> int:
+        return self._bridge.port
+
+
+# ---------------------------------------------------------------------------
+# REST (h1) router
+# ---------------------------------------------------------------------------
+
+
+def _fail_json(code: int, info: str, reason: str = "") -> bytes:
+    return SeldonMessage(
+        status=Status.failure(code, info, reason)
+    ).to_json().encode()
+
+
+class NativeRestServer:
+    """External prediction API (+ internal microservice API) over the native
+    HTTP/1.1 server.  JSON wire format identical to serving/rest.py; the
+    aiohttp tier remains for SSE streaming, form-encoded bodies, OpenAPI,
+    and trace endpoints."""
+
+    def __init__(
+        self,
+        engine: Any = None,
+        component: Any = None,
+        metrics: Any = None,
+        name: str = "predictor",
+        port: int = 0,
+        bind: str = "0.0.0.0",
+        reuseport: bool = False,
+    ):
+        self.engine = engine
+        self.component = component
+        self.name = name
+        self.metrics = metrics or getattr(engine, "metrics", None)
+        self._routes: dict[
+            tuple[str, str], Callable[[bytes], Awaitable[Any]]
+        ] = {}
+        if engine is not None:
+            self._routes[("POST", "/api/v0.1/predictions")] = self._predict
+            self._routes[("POST", "/api/v1.0/predictions")] = self._predict
+            self._routes[("POST", "/api/v0.1/feedback")] = self._feedback
+        if component is not None:
+            self._routes[("POST", "/predict")] = self._c_predict
+            self._routes[("POST", "/transform-input")] = self._c_transform_in
+            self._routes[("POST", "/transform-output")] = self._c_transform_out
+            self._routes[("POST", "/route")] = self._c_route
+            self._routes[("POST", "/aggregate")] = self._c_aggregate
+            self._routes[("POST", "/send-feedback")] = self._c_feedback
+        self._bridge = _AsyncBridge(
+            self._route, http2=False, port=port, bind=bind,
+            reuseport=reuseport, error_result=self._error,
+        )
+
+    @staticmethod
+    def _error(e: Exception):
+        return (500, _fail_json(500, f"{type(e).__name__}: {e}"), None)
+
+    async def _route(self, method: str, path: str, body: bytes):
+        import time
+
+        if method == "GET":
+            if path in ("/ready", "/live"):
+                return (200, path[1:].encode(), None)
+            if path == "/metrics" and self.metrics is not None:
+                return (200, self.metrics.render().encode(), None)
+            return (404, _fail_json(404, f"no route {path}"), None)
+        fn = self._routes.get((method, path))
+        if fn is None:
+            return (404, _fail_json(404, f"no route {method} {path}"), None)
+        t0 = time.perf_counter()
+        try:
+            msg = await fn(body)
+        except _BadRequest as e:
+            return (400, _fail_json(400, str(e)), None)
+        code = 200
+        if msg.status is not None and msg.status.status == "FAILURE":
+            code = msg.status.code if 400 <= msg.status.code < 600 else 500
+        if self.metrics is not None:
+            self.metrics.observe_request(
+                self.name, time.perf_counter() - t0, code
+            )
+        return (code, msg.to_json().encode(), None)
+
+    # -- engine routes --------------------------------------------------
+    async def _predict(self, body: bytes) -> SeldonMessage:
+        return await self.engine.predict(_parse_msg(body))
+
+    async def _feedback(self, body: bytes) -> SeldonMessage:
+        try:
+            fb = Feedback.from_dict(_parse_json(body))
+        except _BadRequest:
+            raise
+        except Exception as e:
+            raise _BadRequest(f"bad Feedback: {e}")
+        return await self.engine.send_feedback(fb)
+
+    # -- component routes (microservice API) ----------------------------
+    async def _component(self, method: str, arg) -> SeldonMessage:
+        from seldon_core_tpu.utils import maybe_await
+
+        try:
+            return await maybe_await(getattr(self.component, method)(arg))
+        except Exception as e:
+            code = getattr(e, "status_code", 500)
+            return SeldonMessage(
+                status=Status.failure(code, f"{type(e).__name__}: {e}")
+            )
+
+    async def _c_predict(self, body: bytes) -> SeldonMessage:
+        return await self._component("predict", _parse_msg(body))
+
+    async def _c_transform_in(self, body: bytes) -> SeldonMessage:
+        return await self._component("transform_input", _parse_msg(body))
+
+    async def _c_transform_out(self, body: bytes) -> SeldonMessage:
+        return await self._component("transform_output", _parse_msg(body))
+
+    async def _c_route(self, body: bytes) -> SeldonMessage:
+        import numpy as np
+
+        from seldon_core_tpu.utils import maybe_await
+
+        branch = await maybe_await(self.component.route(_parse_msg(body)))
+        return SeldonMessage(
+            data=np.array([[int(branch)]], dtype=np.int32), encoding="ndarray"
+        )
+
+    async def _c_aggregate(self, body: bytes) -> SeldonMessage:
+        payload = _parse_json(body)
+        msgs = [
+            _parse_msg_dict(m) for m in payload.get("seldonMessages", [])
+        ]
+        return await self._component("aggregate", msgs)
+
+    async def _c_feedback(self, body: bytes) -> SeldonMessage:
+        try:
+            fb = Feedback.from_dict(_parse_json(body))
+        except Exception as e:
+            raise _BadRequest(f"bad Feedback: {e}")
+        ret = await self._component("send_feedback", fb)
+        return ret if isinstance(ret, SeldonMessage) else SeldonMessage(
+            status=Status()
+        )
+
+    async def start(self) -> int:
+        return await self._bridge.start()
+
+    async def stop(self) -> None:
+        await self._bridge.stop()
+
+    @property
+    def port(self) -> int:
+        return self._bridge.port
+
+
+class _BadRequest(Exception):
+    pass
+
+
+def _parse_json(body: bytes) -> dict:
+    if not body:
+        raise _BadRequest("empty request body")
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise _BadRequest(f"malformed request: {e}")
+
+
+def _parse_msg_dict(d: dict) -> SeldonMessage:
+    try:
+        return SeldonMessage.from_dict(d)
+    except Exception as e:
+        raise _BadRequest(f"bad SeldonMessage: {e}")
+
+
+def _parse_msg(body: bytes) -> SeldonMessage:
+    return _parse_msg_dict(_parse_json(body))

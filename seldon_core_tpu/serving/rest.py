@@ -485,9 +485,11 @@ def build_app(
     return app
 
 
-async def start_server(app: web.Application, host: str = "0.0.0.0", port: int = 8000):
+async def start_server(app: web.Application, host: str = "0.0.0.0",
+                       port: int = 8000, reuse_port: bool = False):
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    site = web.TCPSite(runner, host, port,
+                       reuse_port=reuse_port or None)
     await site.start()
     return runner

@@ -1,0 +1,151 @@
+"""Multi-process worker scaling for the serving tiers (SO_REUSEPORT).
+
+The reference scales its engine with threads inside one JVM (Tomcat/grpc
+thread pools) and replicas across pods.  A CPython server can't scale with
+threads (GIL), so the equivalent knob here is kernel socket sharding: N
+worker PROCESSES bind the same port with ``SO_REUSEPORT`` and the kernel
+spreads connections across them — no proxy hop, no shared state.  All four
+wire tiers support it:
+
+- native REST / native gRPC (``native/httpserver.cc`` binds with
+  SO_REUSEPORT when asked),
+- aiohttp (``reuse_port=`` on TCPSite),
+- grpc.aio (the grpc core sets SO_REUSEPORT by default on Linux).
+
+Workers are full processes with independent engines — the same sharing
+model as reference replica scaling (CRD ``replicas:``), collapsed onto one
+host.  Metrics must be aggregated by the scraper (each worker serves its
+own /metrics; the analytics chart's Prometheus does this by design).
+
+``fork_workers`` MUST run before JAX or any thread pool initializes:
+forking a process with live XLA threads deadlocks the child.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Callable, Optional
+
+__all__ = ["fork_workers", "WorkerPool", "pick_free_port"]
+
+
+def pick_free_port(host: str = "127.0.0.1") -> int:
+    """Reserve-then-release a port for SO_REUSEPORT groups (the workers
+    re-bind it immediately; standard small race accepted)."""
+    import socket
+
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def fork_workers(n: int) -> int:
+    """Fork ``n`` worker children; the calling process becomes a supervisor
+    that never returns (exits when the group stops).  Each child returns its
+    worker index.  Fail-fast: one worker dying stops the group — the
+    orchestrator (k8s) owns restarts, matching reference pod semantics.
+
+    Call BEFORE initializing JAX/threads.
+    """
+    if n <= 1:
+        return 0
+    pids = []
+    for i in range(n):
+        pid = os.fork()
+        if pid == 0:
+            return i
+        pids.append(pid)
+
+    def _term(*_sig) -> None:
+        for p in pids:
+            try:
+                os.kill(p, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    try:
+        os.waitpid(-1, 0)  # first exit (crash or stop) ...
+    except ChildProcessError:
+        pass
+    _term()  # ... stops the whole group
+    for p in pids:
+        try:
+            os.waitpid(p, 0)
+        except ChildProcessError:
+            continue  # already reaped (e.g. the one waitpid(-1) saw)
+    sys.exit(0)
+
+
+class WorkerPool:
+    """Programmatic fork-based pool: runs ``boot(worker_index)`` (a blocking
+    callable) in each of ``n`` child processes.
+
+    Use from tests/tools; servers inside ``boot`` should bind a fixed port
+    with ``reuseport=True`` (see ``pick_free_port``).  The parent process
+    stays interactive (unlike :func:`fork_workers`).
+    """
+
+    def __init__(self, boot: Callable[[int], None], n: int):
+        self.boot = boot
+        self.n = n
+        self.pids: list[int] = []
+
+    def start(self) -> "WorkerPool":
+        for i in range(self.n):
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    self.boot(i)
+                except KeyboardInterrupt:
+                    pass
+                finally:
+                    os._exit(0)
+            self.pids.append(pid)
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        for p in self.pids:
+            try:
+                os.kill(p, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        deadline = time.monotonic() + timeout_s
+        for p in self.pids:
+            while time.monotonic() < deadline:
+                done, _ = os.waitpid(p, os.WNOHANG)
+                if done:
+                    break
+                time.sleep(0.02)
+            else:
+                try:
+                    os.kill(p, signal.SIGKILL)
+                    os.waitpid(p, 0)
+                except (ProcessLookupError, ChildProcessError):
+                    pass
+        self.pids.clear()
+
+    def __enter__(self) -> "WorkerPool":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def alive(pool: Optional["WorkerPool"]) -> int:
+    if pool is None:
+        return 0
+    n = 0
+    for p in pool.pids:
+        try:
+            os.kill(p, 0)
+            n += 1
+        except ProcessLookupError:
+            pass
+    return n

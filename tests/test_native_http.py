@@ -1,0 +1,432 @@
+"""Native HTTP tier: wire compatibility of the C++ HTTP/1.1 + HTTP/2 (gRPC)
+servers against REAL Python clients (aiohttp, grpc.aio — the same stacks
+reference users run), the asyncio bridge, flow control on >window payloads,
+SO_REUSEPORT sharding, and the native load generator.
+
+Reference surfaces covered: engine gRPC server
+(engine/.../grpc/SeldonGrpcServer.java:37-127), engine REST
+(api/rest/RestClientController.java:103), internal microservice API
+(docs/reference/internal-api.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.native import (
+    HAVE_NATIVE,
+    NativeHttpServer,
+    run_native_load,
+)
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.convert import message_from_proto, message_to_proto
+from seldon_core_tpu.serving.native_http import (
+    NativeGrpcServer,
+    NativeRestServer,
+)
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="native library unavailable"
+)
+
+PAYLOAD = {"data": {"names": ["a", "b"], "ndarray": [[1.0, 2.0]]}}
+
+
+def _engine() -> GraphEngine:
+    return GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+
+
+def _grpc_call(port: int, path: str = "/seldon.tpu.Seldon/Predict"):
+    import grpc.aio
+
+    ch = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary(
+        path,
+        request_serializer=pb.SeldonMessage.SerializeToString,
+        response_deserializer=pb.SeldonMessage.FromString,
+    )
+    return ch, call
+
+
+class TestNativeGrpcServer:
+    def test_grpc_aio_client_roundtrip(self):
+        """A real grpc C-core client (HPACK dynamic table + Huffman on the
+        wire) must interop with the native h2 server."""
+
+        async def run():
+            srv = NativeGrpcServer(deployment=_engine(), bind="127.0.0.1")
+            port = await srv.start()
+            ch, call = _grpc_call(port)
+            try:
+                req = message_to_proto(SeldonMessage.from_dict(PAYLOAD))
+                for _ in range(3):  # exercises the client's dyn-table reuse
+                    out = message_from_proto(await call(req, timeout=10))
+                    assert out.to_dict()["data"]["ndarray"] == [[1.0, 2.0, 3.0]]
+            finally:
+                await ch.close()
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_unknown_method_unimplemented(self):
+        async def run():
+            srv = NativeGrpcServer(deployment=_engine(), bind="127.0.0.1")
+            port = await srv.start()
+            ch, call = _grpc_call(port, "/seldon.tpu.Nope/Missing")
+            try:
+                import grpc
+
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await call(pb.SeldonMessage(), timeout=10)
+                assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+            finally:
+                await ch.close()
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_large_tensor_flow_control(self):
+        """1 MiB tensors both directions: exceeds the 64 KiB default flow
+        windows, so WINDOW_UPDATE replenishment (recv) and window-respecting
+        DATA chunking (send) both engage."""
+
+        async def run():
+            class Echo:
+                async def predict(self, msg):
+                    return SeldonMessage(data=msg.host_data())
+
+                async def send_feedback(self, fb):
+                    return SeldonMessage()
+
+            srv = NativeGrpcServer(deployment=Echo(), bind="127.0.0.1")
+            port = await srv.start()
+            ch, call = _grpc_call(port)
+            try:
+                big = np.arange(256 * 1024, dtype=np.float32).reshape(512, -1)
+                req = message_to_proto(SeldonMessage(data=big))
+                out = message_from_proto(await call(req, timeout=30))
+                np.testing.assert_array_equal(
+                    np.asarray(out.host_data(), np.float32), big
+                )
+            finally:
+                await ch.close()
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_component_services(self):
+        """Per-role unary services route through the same _ComponentRpc
+        semantics as the grpc.aio tier."""
+        from seldon_core_tpu.runtime.component import ComponentHandle
+
+        class Comp:
+            def predict(self, X, names=None, meta=None):
+                return X * 2
+
+        async def run():
+            handle = ComponentHandle(Comp(), name="c")
+            srv = NativeGrpcServer(component=handle, bind="127.0.0.1")
+            port = await srv.start()
+            ch, call = _grpc_call(port, "/seldon.tpu.Model/Predict")
+            try:
+                req = message_to_proto(
+                    SeldonMessage(data=np.array([[1.0, 2.0]]))
+                )
+                out = message_from_proto(await call(req, timeout=10))
+                np.testing.assert_allclose(
+                    np.asarray(out.host_data()), [[2.0, 4.0]]
+                )
+            finally:
+                await ch.close()
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_handler_exception_is_internal(self):
+        async def run():
+            class Boom:
+                async def predict(self, msg):
+                    raise RuntimeError("kaput")
+
+                async def send_feedback(self, fb):
+                    return SeldonMessage()
+
+            srv = NativeGrpcServer(deployment=Boom(), bind="127.0.0.1")
+            port = await srv.start()
+            ch, call = _grpc_call(port)
+            try:
+                import grpc
+
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await call(
+                        message_to_proto(SeldonMessage.from_dict(PAYLOAD)),
+                        timeout=10,
+                    )
+                assert ei.value.code() == grpc.StatusCode.INTERNAL
+                assert "kaput" in ei.value.details()
+            finally:
+                await ch.close()
+                await srv.stop()
+
+        asyncio.run(run())
+
+
+class TestNativeRestServer:
+    def test_aiohttp_client_roundtrip(self):
+        import aiohttp
+
+        async def run():
+            srv = NativeRestServer(engine=_engine(), bind="127.0.0.1")
+            port = await srv.start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json=PAYLOAD,
+                    ) as r:
+                        assert r.status == 200
+                        d = await r.json()
+                        assert d["data"]["ndarray"] == [[1.0, 2.0, 3.0]]
+                    async with s.get(
+                        f"http://127.0.0.1:{port}/ready"
+                    ) as r:
+                        assert await r.text() == "ready"
+            finally:
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_error_statuses(self):
+        import aiohttp
+
+        async def run():
+            srv = NativeRestServer(engine=_engine(), bind="127.0.0.1")
+            port = await srv.start()
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"{base}/api/v0.1/predictions", data=b"not json"
+                    ) as r:
+                        assert r.status == 400
+                        assert (await r.json())["status"]["status"] == "FAILURE"
+                    async with s.post(f"{base}/nope", json={}) as r:
+                        assert r.status == 404
+            finally:
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_component_routes(self):
+        import aiohttp
+
+        from seldon_core_tpu.runtime.component import ComponentHandle
+
+        class Comp:
+            def predict(self, X, names=None, meta=None):
+                return X + 1
+
+            def route(self, X, names=None, meta=None):
+                return 1
+
+        async def run():
+            handle = ComponentHandle(Comp(), name="c")
+            srv = NativeRestServer(component=handle, bind="127.0.0.1")
+            port = await srv.start()
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"{base}/predict",
+                        json={"data": {"ndarray": [[1.0]]}},
+                    ) as r:
+                        assert r.status == 200
+                        assert (await r.json())["data"]["ndarray"] == [[2.0]]
+                    async with s.post(
+                        f"{base}/route",
+                        json={"data": {"ndarray": [[1.0]]}},
+                    ) as r:
+                        assert (await r.json())["data"]["ndarray"] == [[1]]
+            finally:
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_reuseport_two_servers_one_port(self):
+        """SO_REUSEPORT worker mode: two native servers share a port; the
+        kernel spreads connections between them."""
+        import aiohttp
+
+        async def run():
+            s1 = NativeRestServer(
+                engine=_engine(), bind="127.0.0.1", reuseport=True
+            )
+            port = await s1.start()
+            s2 = NativeRestServer(
+                engine=_engine(), bind="127.0.0.1", port=port, reuseport=True
+            )
+            await s2.start()
+            try:
+                # force fresh connections so both sockets get traffic
+                for _ in range(8):
+                    async with aiohttp.ClientSession() as s:
+                        async with s.post(
+                            f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                            json=PAYLOAD,
+                        ) as r:
+                            assert r.status == 200
+                total = (
+                    s1._bridge.server.requests + s2._bridge.server.requests
+                )
+                assert total == 8
+            finally:
+                await s1.stop()
+                await s2.stop()
+
+        asyncio.run(run())
+
+
+def _pid_boot(port: int, _idx: int) -> None:
+    """Worker child: serve a pid-echoing component on the shared port."""
+
+    class PidComp:
+        async def predict(self, msg):
+            import os
+
+            return SeldonMessage(json_data={"pid": os.getpid()})
+
+    async def run():
+        srv = NativeRestServer(
+            component=PidComp(), bind="127.0.0.1", port=port, reuseport=True
+        )
+        await srv.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+class TestWorkerPool:
+    def test_two_workers_share_port(self):
+        """SO_REUSEPORT process pool: fresh connections land on different
+        worker pids (kernel socket sharding, the multi-core scaling path)."""
+        import functools
+
+        from seldon_core_tpu.serving.workers import WorkerPool, pick_free_port
+
+        port = pick_free_port()
+        pool = WorkerPool(functools.partial(_pid_boot, port), n=2)
+
+        async def drive() -> set:
+            import aiohttp
+
+            pids = set()
+            # wait for workers to bind
+            deadline = asyncio.get_running_loop().time() + 10
+            for _ in range(24):
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.post(
+                            f"http://127.0.0.1:{port}/predict",
+                            json={"data": {"ndarray": [[1.0]]}},
+                        ) as r:
+                            if r.status == 200:
+                                pids.add((await r.json())["jsonData"]["pid"])
+                except aiohttp.ClientError:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.25)
+            return pids
+
+        with pool:
+            pids = asyncio.run(drive())
+        assert len(pids) == 2, f"expected both workers hit, got {pids}"
+
+
+class TestNativeLoadgen:
+    def test_rest_static(self):
+        srv = NativeHttpServer(submit=None, http2=False).start()
+        try:
+            srv.set_static_response(200, b'{"ok":true}')
+            res = run_native_load(
+                "rest", "127.0.0.1", srv.port, "/p", b'{"x":1}',
+                connections=4, seconds=0.5, warmup_s=0.1,
+            )
+            assert res["errors"] == 0
+            assert res["requests"] > 50
+            assert res["latency_ms"]["p50"] > 0
+        finally:
+            srv.stop()
+
+    def test_grpc_static(self):
+        resp = pb.SeldonMessage()
+        resp.strData = "y"
+        srv = NativeHttpServer(submit=None, http2=True).start()
+        try:
+            srv.set_static_response(0, resp.SerializeToString())
+            req = pb.SeldonMessage()
+            req.strData = "x"
+            res = run_native_load(
+                "grpc", "127.0.0.1", srv.port, "/seldon.tpu.Seldon/Predict",
+                req.SerializeToString(), connections=2, streams_per_conn=8,
+                seconds=0.5, warmup_s=0.1,
+            )
+            assert res["errors"] == 0
+            assert res["requests"] > 50
+        finally:
+            srv.stop()
+
+    def test_grpc_loadgen_against_grpc_aio_server(self):
+        """Cross-check the h2 CLIENT against the grpc.aio SERVER (the tier
+        the loadgen replaces locust for) — both directions of our h2 code
+        interop with C-core."""
+
+        async def run():
+            from seldon_core_tpu.serving.grpc_api import (
+                GrpcServer,
+                seldon_service_handler,
+            )
+
+            eng = _engine()
+            server = GrpcServer(
+                [seldon_service_handler(eng)], port=0, host="127.0.0.1"
+            )
+            port = await server.start()
+            try:
+                req = message_to_proto(SeldonMessage.from_dict(PAYLOAD))
+                loop = asyncio.get_running_loop()
+                res = await loop.run_in_executor(
+                    None,
+                    lambda: run_native_load(
+                        "grpc", "127.0.0.1", port,
+                        "/seldon.tpu.Seldon/Predict",
+                        req.SerializeToString(), connections=2,
+                        streams_per_conn=4, seconds=0.5, warmup_s=0.1,
+                    ),
+                )
+                assert res["errors"] == 0
+                assert res["requests"] > 10
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_errors_counted(self):
+        """grpc-status != 0 must count as errors, not silently pass."""
+        srv = NativeHttpServer(submit=None, http2=True).start()
+        try:
+            srv.set_static_response(13, b"")  # INTERNAL trailers-only
+            req = pb.SeldonMessage()
+            res = run_native_load(
+                "grpc", "127.0.0.1", srv.port, "/x", req.SerializeToString(),
+                connections=1, streams_per_conn=2, seconds=0.3, warmup_s=0.05,
+            )
+            assert res["requests"] > 0
+            assert res["errors"] == res["requests"]
+        finally:
+            srv.stop()
