@@ -187,6 +187,11 @@ void close_conn(sn_http_server *s, Conn *c) {
 
 bool do_write(sn_http_server *s, Conn *c);
 
+/* re-run flush for responses parked on flow control (after WINDOW_UPDATE
+ * or a SETTINGS INITIAL_WINDOW_SIZE raise — RFC 7540 s6.9.2 requires
+ * honoring window growth from either) */
+void retry_flow_blocked(Conn *c);
+
 /* erase a stream, releasing its request-body bytes from the conn's
  * backpressure budget */
 void erase_stream(Conn *c, int32_t id) {
@@ -232,6 +237,25 @@ void emit_goaway(std::string *out, int32_t last_id, uint32_t code) {
   put_u32(out, code);
 }
 
+/* gRPC spec: grpc-message is percent-encoded — bytes outside 0x20-0x7E
+ * plus '%' itself become %XX, so exception text with '%' or UTF-8 survives
+ * conforming clients' percent-decode instead of corrupting the trailer */
+std::string pct_encode(const std::string &in) {
+  static const char hex[] = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char ch : in) {
+    if (ch >= 0x20 && ch <= 0x7e && ch != '%') {
+      out.push_back((char)ch);
+    } else {
+      out.push_back('%');
+      out.push_back(hex[ch >> 4]);
+      out.push_back(hex[ch & 0xf]);
+    }
+  }
+  return out;
+}
+
 std::string grpc_trailers_frame(int32_t stream_id, int status,
                                 const std::string &message) {
   std::string block;
@@ -239,7 +263,7 @@ std::string grpc_trailers_frame(int32_t stream_id, int status,
   snprintf(buf, sizeof(buf), "%d", status);
   snhpack::EncodeLiteral(&block, "grpc-status", buf);
   if (!message.empty())
-    snhpack::EncodeLiteral(&block, "grpc-message", message);
+    snhpack::EncodeLiteral(&block, "grpc-message", pct_encode(message));
   std::string out;
   frame_header(&out, block.size(), F_HEADERS,
                FLAG_END_HEADERS | FLAG_END_STREAM, stream_id);
@@ -279,6 +303,20 @@ bool flush_stream_data(Conn *c, int32_t id, H2Stream *st) {
   return false;
 }
 
+void retry_flow_blocked(Conn *c) {
+  if (c->flow_blocked.empty()) return;
+  std::vector<int32_t> still;
+  for (int32_t id : c->flow_blocked) {
+    auto it = c->streams.find(id);
+    if (it == c->streams.end()) continue;
+    if (flush_stream_data(c, id, &it->second))
+      erase_stream(c, id);
+    else
+      still.push_back(id);
+  }
+  c->flow_blocked.swap(still);
+}
+
 /* queue the full gRPC response for a stream (headers + prefixed DATA +
  * trailers), honoring flow control */
 void respond_grpc(sn_http_server *s, Conn *c, int32_t id, H2Stream *st,
@@ -295,7 +333,7 @@ void respond_grpc(sn_http_server *s, Conn *c, int32_t id, H2Stream *st,
     snprintf(buf, sizeof(buf), "%d", status);
     snhpack::EncodeLiteral(&block, "grpc-status", buf);
     if (!message.empty())
-      snhpack::EncodeLiteral(&block, "grpc-message", message);
+      snhpack::EncodeLiteral(&block, "grpc-message", pct_encode(message));
     frame_header(&c->wbuf, block.size(), F_HEADERS,
                  FLAG_END_HEADERS | FLAG_END_STREAM, id);
     c->wbuf.append(block);
@@ -438,6 +476,10 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
               int32_t stream_id, const uint8_t *p, size_t len) {
   switch (type) {
     case F_HEADERS: {
+      /* RFC 7540 s6.10: nothing but CONTINUATION may interleave while a
+       * header block is open — concatenating two streams' fragments would
+       * desync the shared HPACK dynamic table for the whole conn */
+      if (c->cont_stream != -1) goto proto_err;
       if (!strip_headers_prologue(p, len, flags)) goto proto_err;
       c->header_block.append((const char *)p, len);
       if (flags & FLAG_END_HEADERS)
@@ -497,6 +539,7 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
           int64_t delta = (int64_t)v - c->peer_initial_window;
           c->peer_initial_window = v;
           for (auto &kv : c->streams) kv.second.send_window += delta;
+          if (delta > 0) retry_flow_blocked(c);
         } else if (sid == 5) {
           if (v >= 16384 && v <= 16777215) c->peer_max_frame = v;
         }
@@ -514,19 +557,7 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
         auto it = c->streams.find(stream_id);
         if (it != c->streams.end()) it->second.send_window += inc;
       }
-      /* retry flow-blocked responses */
-      if (!c->flow_blocked.empty()) {
-        std::vector<int32_t> still;
-        for (int32_t id : c->flow_blocked) {
-          auto it = c->streams.find(id);
-          if (it == c->streams.end()) continue;
-          if (flush_stream_data(c, id, &it->second))
-            erase_stream(c, id);
-          else
-            still.push_back(id);
-        }
-        c->flow_blocked.swap(still);
-      }
+      retry_flow_blocked(c);
       return true;
     }
     case F_PING: {
@@ -628,6 +659,7 @@ bool h1_consume(sn_http_server *s, Conn *c) {
       /* headers we care about */
       size_t content_length = 0;
       bool keepalive = true;
+      bool chunked = false;
       const char *line = (const char *)memchr(sp2, '\n', end - sp2);
       while (line && line + 1 < end) {
         line++;
@@ -640,8 +672,22 @@ bool h1_consume(sn_http_server *s, Conn *c) {
           const char *v = line + 11;
           while (*v == ' ') v++;
           if (strncasecmp(v, "close", 5) == 0) keepalive = false;
+        } else if (ll >= 18 &&
+                   strncasecmp(line, "transfer-encoding:", 18) == 0) {
+          chunked = true; /* any TE on a request means a framed body */
         }
         line = eol;
+      }
+      if (chunked) {
+        /* chunked bodies are not parsed here; silently treating one as
+         * zero-length would desync requests/responses (smuggling class).
+         * 501 + close per RFC 7230 s3.3.1 fallback. */
+        static const char e501[] =
+            "HTTP/1.1 501 Not Implemented\r\nContent-Length: 0\r\n"
+            "Connection: close\r\n\r\n";
+        c->wbuf.append(e501, sizeof(e501) - 1);
+        c->closing = true;
+        return do_write(s, c);
       }
       if (content_length > kMaxBody) goto bad;
       size_t head_len = end - buf;

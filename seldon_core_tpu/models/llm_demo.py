@@ -48,6 +48,7 @@ class DemoLLM(LLMComponent):
         chunk_prefill: int = 0,
         seed: int = 0,
         dtype: str = "float32",
+        tp: int = 1,
     ):
         cfg = TransformerConfig(
             vocab_size=vocab_size,
@@ -60,13 +61,29 @@ class DemoLLM(LLMComponent):
             dtype=jnp.dtype(dtype),
         )
         params = init_params(jax.random.PRNGKey(seed), cfg)
+        mesh = None
+        if tp > 1:
+            # tensor-parallel serving over the visible chips (the operator
+            # sizes the pod via the seldon.io/tpu-chips annotation); int8
+            # "full" (attention projections) stays single-chip — the
+            # quantize path documents the restriction
+            from seldon_core_tpu.models.transformer import shard_params
+            from seldon_core_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(n_devices=tp, tp=tp, pp=1)
+            params = shard_params(params, mesh, cfg)
+            if int8 == "full":
+                raise ValueError(
+                    "int8='full' (attention projections) is single-chip; "
+                    "use int8='ffn' with tp>1"
+                )
         if int8 in ("ffn", "full"):
-            params = quantize_ffn_params(params)
+            params = quantize_ffn_params(params, mesh=mesh)
         if int8 == "full":
             params = quantize_attn_params(params)
         super().__init__(
             LLMEngine(params, cfg, max_slots=max_slots,
-                      chunk_prefill=chunk_prefill),
+                      chunk_prefill=chunk_prefill, mesh=mesh),
             n_new=n_new,
         )
         self.name = "llm"

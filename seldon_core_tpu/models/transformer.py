@@ -705,16 +705,36 @@ def make_train_step(cfg: TransformerConfig, mesh=None, pp: int = 1,
 # decode (serving path): KV-cache incremental generation
 # ----------------------------------------------------------------------
 
-def init_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None):
+def init_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = None,
+               mesh=None):
     """KV cache: (layers, B, T, kv_heads, d_head) — GQA shrinks it by
-    n_heads/kv_heads, the decode memory/bandwidth win."""
+    n_heads/kv_heads, the decode memory/bandwidth win.
+
+    With ``mesh``, K/V shard their HEAD axis over "tp" (the Megatron
+    serving layout: each device holds the KV heads whose q-heads it owns,
+    so decode attention runs without cross-device K/V traffic) and ``pos``
+    replicates.  Requires ``cfg.kv_heads % tp == 0`` (same contract as
+    shard_params)."""
     max_len = max_len or cfg.max_seq
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.d_head)
-    return {
+    cache = {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
         "pos": jnp.zeros((batch,), jnp.int32),
     }
+    if mesh is not None:
+        tp = mesh.shape.get("tp", 1)
+        if cfg.kv_heads % tp:
+            raise ValueError(
+                f"n_kv_heads {cfg.kv_heads} must divide by tp {tp}"
+            )
+        kv_s = NamedSharding(mesh, P(None, None, None, "tp", None))
+        cache["k"] = jax.device_put(cache["k"], kv_s)
+        cache["v"] = jax.device_put(cache["v"], kv_s)
+        cache["pos"] = jax.device_put(
+            cache["pos"], NamedSharding(mesh, P())
+        )
+    return cache
 
 
 def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
@@ -793,12 +813,14 @@ def decode_step(params, cache, token_ids, cfg: TransformerConfig, mesh=None):
 
 
 def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
-            logit_pos=None):
+            logit_pos=None, mesh=None):
     """Batched prefill: ONE forward over the whole prompt that also fills
     the KV cache (round-1 generate() prefilled token-by-token, one device
-    call per prompt token).  Single-chip serving path (mesh=None — sharded
-    prefill goes through the mesh-aware forward/decode_step instead; the
-    KV-cache layout assumes whole sequences per device).
+    call per prompt token).  With ``mesh``, runs tensor-parallel: the
+    attention/FFN blocks shard the Megatron way (mesh-aware
+    attention_block/ffn_block/_vocab_proj) and the returned K/V shards its
+    head axis over "tp" — matching init_cache(mesh=...)'s serving layout,
+    so the engine's cache insert stays a device-local copy.
 
     Returns ``(logits, cache)`` with ``cache['pos'] = L``.  With
     ``logit_pos`` (an index, traceable) only that position is projected
@@ -816,9 +838,9 @@ def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
     positions = jnp.arange(L)[None, :]
 
     def block(p, x):
-        x, (k, v) = attention_block(p, x, positions, cfg, mesh=None,
+        x, (k, v) = attention_block(p, x, positions, cfg, mesh=mesh,
                                     return_kv=True)
-        x, _ = ffn_block(p, x, cfg, mesh=None)
+        x, _ = ffn_block(p, x, cfg, mesh=mesh)
         return x, (k, v)
 
     if _has_q8(params["blocks"]):
@@ -839,11 +861,13 @@ def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
     if logit_pos is not None:
         # project ONE position: (B, 1, D) through the vocab matrix
         x = jax.lax.dynamic_slice_in_dim(x, logit_pos, 1, axis=1)
-        logits = _vocab_proj(x, params["lm_head"], cfg)[:, 0].astype(
+        logits = _vocab_proj(x, params["lm_head"], cfg, mesh)[:, 0].astype(
             jnp.float32
         )
     else:
-        logits = _vocab_proj(x, params["lm_head"], cfg).astype(jnp.float32)
+        logits = _vocab_proj(x, params["lm_head"], cfg, mesh).astype(
+            jnp.float32
+        )
 
     pad = max_len - L
     cache = {

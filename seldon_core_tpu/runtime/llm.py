@@ -133,9 +133,22 @@ class LLMEngine:
         draft_cfg: Optional[TransformerConfig] = None,
         k_draft: int = 4,
         chunk_prefill: int = 0,
+        mesh=None,
     ):
+        """``mesh``: serve TENSOR-PARALLEL over a jax.sharding.Mesh with a
+        "tp" axis.  Params must be placed to match (``shard_params`` for
+        bf16, ``quantize_ffn_params(mesh=...)`` for int8 FFNs); the KV
+        cache shards its head axis over "tp" (init_cache(mesh=)), prefill
+        and every decode tick compile as partitioned programs (Megatron
+        pattern: XLA inserts the all-reduces), and the engine's own logic
+        (slots, sampling fetch, speculation bookkeeping) is unchanged —
+        sampled token ids are replicated scalars by the time they cross to
+        host.  Multi-host: the same engine runs on each host of a slice
+        with jax.distributed initialized (runtime/multihost.py); requests
+        enter through host 0's serving tier."""
         self.params = params
         self.cfg = cfg
+        self.mesh = mesh
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq
         self.draft_params = draft_params
@@ -155,10 +168,10 @@ class LLMEngine:
         # earlier rows)
         cache_len = self.max_len + (k_draft + 1 if draft_params is not None
                                     else 0)
-        self.cache = init_cache(cfg, max_slots, max_len=cache_len)
+        self.cache = init_cache(cfg, max_slots, max_len=cache_len, mesh=mesh)
         if draft_params is not None:
             self.draft_cache = init_cache(draft_cfg, max_slots,
-                                          max_len=cache_len)
+                                          max_len=cache_len, mesh=mesh)
             self._spec = jax.jit(self._spec_impl)
             self._step_sync = jax.jit(self._step_sync_impl)
             self._draft_prefills: dict[int, Any] = {}
@@ -193,7 +206,8 @@ class LLMEngine:
         the device-side ones, which go stale after a speculative rewind."""
         if pos is not None:
             cache = {**cache, "pos": pos}
-        logits, cache = decode_step(params, cache, tok, cfg=self.cfg)
+        logits, cache = decode_step(params, cache, tok, cfg=self.cfg,
+                                    mesh=self.mesh)
         toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
         return toks, keys, cache
 
@@ -206,9 +220,10 @@ class LLMEngine:
         nothing, making it slower than plain decoding."""
         t_cache = {**t_cache, "pos": pos}
         d_cache = {**d_cache, "pos": pos}
-        logits, t_cache = decode_step(params, t_cache, tok, cfg=self.cfg)
+        logits, t_cache = decode_step(params, t_cache, tok, cfg=self.cfg,
+                                      mesh=self.mesh)
         _, d_cache = decode_step(draft_params, d_cache, tok,
-                                 cfg=self.draft_cfg)
+                                 cfg=self.draft_cfg, mesh=self.mesh)
         toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
         return toks, keys, t_cache, d_cache
 
@@ -224,7 +239,7 @@ class LLMEngine:
         def body(carry, _):
             d_cache, t = carry
             dl, d_cache = decode_step(draft_params, d_cache, t,
-                                      cfg=self.draft_cfg)
+                                      cfg=self.draft_cfg, mesh=self.mesh)
             t = jnp.argmax(dl, -1).astype(jnp.int32)
             return (d_cache, t), t
 
@@ -238,7 +253,8 @@ class LLMEngine:
         )
         drafts = jnp.moveaxis(drafts, 0, 1)[:, : self.k_draft]  # [S, k]
         vtokens = jnp.concatenate([tok[:, None], drafts], axis=1)
-        vlogits, t_cache = decode_step(params, t_cache, vtokens, cfg=self.cfg)
+        vlogits, t_cache = decode_step(params, t_cache, vtokens, cfg=self.cfg,
+                                       mesh=self.mesh)
         tgt = jnp.argmax(vlogits, -1).astype(jnp.int32)  # [S, k+1]
         return drafts, tgt, t_cache, d_cache
 
@@ -296,7 +312,7 @@ class LLMEngine:
                     "pos": jnp.full((1,), true_prefix_len, jnp.int32),
                 }
                 chunk_logits, cache = decode_step(
-                    params, cache, suffix, cfg=self.cfg
+                    params, cache, suffix, cfg=self.cfg, mesh=self.mesh
                 )
                 # last TRUE suffix position's logits, selected in-program —
                 # an eager slice outside jit would cost one extra dispatch
@@ -357,7 +373,7 @@ class LLMEngine:
         if fn is None:
             fn = memo[bucket] = jax.jit(
                 partial(prefill, cfg=self.draft_cfg if draft else self.cfg,
-                        max_len=bucket)
+                        max_len=bucket, mesh=self.mesh)
             )
         return fn
 

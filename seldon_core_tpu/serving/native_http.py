@@ -250,13 +250,27 @@ class NativeRestServer:
             reuseport=reuseport, error_result=self._error,
         )
 
-    @staticmethod
-    def _error(e: Exception):
+    def _observe(self, t0: float, code: int) -> None:
+        """Every terminal response records a request sample — same contract
+        as the aiohttp tier, so error-rate dashboards see 4xx/5xx here
+        too."""
+        if self.metrics is not None:
+            import time
+
+            self.metrics.observe_request(
+                self.name, time.perf_counter() - t0, code
+            )
+
+    def _error(self, e: Exception):
+        import time
+
+        self._observe(time.perf_counter(), 500)
         return (500, _fail_json(500, f"{type(e).__name__}: {e}"), None)
 
     async def _route(self, method: str, path: str, body: bytes):
         import time
 
+        t0 = time.perf_counter()
         if method == "GET":
             if path in ("/ready", "/live"):
                 return (200, path[1:].encode(), None)
@@ -265,19 +279,17 @@ class NativeRestServer:
             return (404, _fail_json(404, f"no route {path}"), None)
         fn = self._routes.get((method, path))
         if fn is None:
+            self._observe(t0, 404)
             return (404, _fail_json(404, f"no route {method} {path}"), None)
-        t0 = time.perf_counter()
         try:
             msg = await fn(body)
         except _BadRequest as e:
+            self._observe(t0, 400)
             return (400, _fail_json(400, str(e)), None)
         code = 200
         if msg.status is not None and msg.status.status == "FAILURE":
             code = msg.status.code if 400 <= msg.status.code < 600 else 500
-        if self.metrics is not None:
-            self.metrics.observe_request(
-                self.name, time.perf_counter() - t0, code
-            )
+        self._observe(t0, code)
         return (code, msg.to_json().encode(), None)
 
     # -- engine routes --------------------------------------------------

@@ -148,6 +148,37 @@ class TestNativeGrpcServer:
 
         asyncio.run(run())
 
+    def test_grpc_message_percent_and_utf8_survive(self):
+        """grpc-message is percent-encoded per the gRPC spec: '%' and
+        non-ASCII in exception text must reach the client's details()
+        intact, not corrupt the trailer."""
+
+        async def run():
+            class Boom:
+                async def predict(self, msg):
+                    raise RuntimeError("50% of café failed: %d")
+
+                async def send_feedback(self, fb):
+                    return SeldonMessage()
+
+            srv = NativeGrpcServer(deployment=Boom(), bind="127.0.0.1")
+            port = await srv.start()
+            ch, call = _grpc_call(port)
+            try:
+                import grpc
+
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await call(
+                        message_to_proto(SeldonMessage.from_dict(PAYLOAD)),
+                        timeout=10,
+                    )
+                assert "50% of café failed: %d" in ei.value.details()
+            finally:
+                await ch.close()
+                await srv.stop()
+
+        asyncio.run(run())
+
     def test_handler_exception_is_internal(self):
         async def run():
             class Boom:
@@ -253,6 +284,57 @@ class TestNativeRestServer:
                         json={"data": {"ndarray": [[1.0]]}},
                     ) as r:
                         assert (await r.json())["data"]["ndarray"] == [[1]]
+            finally:
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_chunked_request_rejected_not_smuggled(self):
+        """Transfer-Encoding: chunked is not parsed; it must be REFUSED
+        (501 + close), never treated as a zero-length body with the chunk
+        data left to desync the next request (smuggling class)."""
+        import socket
+
+        async def run():
+            srv = NativeRestServer(engine=_engine(), bind="127.0.0.1")
+            port = await srv.start()
+            try:
+                s = socket.create_connection(("127.0.0.1", port), timeout=5)
+                s.sendall(
+                    b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                    b"Host: x\r\nTransfer-Encoding: chunked\r\n\r\n"
+                    b"5\r\nhello\r\n0\r\n\r\n"
+                )
+                data = s.recv(4096)
+                assert data.startswith(b"HTTP/1.1 501"), data[:40]
+                s.close()
+            finally:
+                await srv.stop()
+
+        asyncio.run(run())
+
+    def test_error_statuses_observed_in_metrics(self):
+        """4xx/5xx responses must record request samples (same contract as
+        the aiohttp tier) so error-rate dashboards see them."""
+        import aiohttp
+
+        from seldon_core_tpu.utils.metrics import EngineMetrics
+
+        async def run():
+            metrics = EngineMetrics()
+            srv = NativeRestServer(
+                engine=_engine(), metrics=metrics, bind="127.0.0.1"
+            )
+            port = await srv.start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        data=b"not json",
+                    ) as r:
+                        assert r.status == 400
+                rendered = metrics.render()
+                assert 'code="400"' in rendered, rendered
             finally:
                 await srv.stop()
 
