@@ -474,12 +474,12 @@ bool h2_on_headers_complete(sn_http_server *s, Conn *c, int32_t id,
 /* process one complete frame; returns false if the conn died */
 bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
               int32_t stream_id, const uint8_t *p, size_t len) {
+  /* RFC 7540 s6.10: while a header block is open, NOTHING but
+   * CONTINUATION may arrive — any other frame type is a connection error
+   * (an interleaved HEADERS would also desync the shared HPACK table) */
+  if (c->cont_stream != -1 && type != F_CONTINUATION) goto proto_err;
   switch (type) {
     case F_HEADERS: {
-      /* RFC 7540 s6.10: nothing but CONTINUATION may interleave while a
-       * header block is open — concatenating two streams' fragments would
-       * desync the shared HPACK dynamic table for the whole conn */
-      if (c->cont_stream != -1) goto proto_err;
       if (!strip_headers_prologue(p, len, flags)) goto proto_err;
       c->header_block.append((const char *)p, len);
       if (flags & FLAG_END_HEADERS)

@@ -49,6 +49,8 @@ class DemoLLM(LLMComponent):
         seed: int = 0,
         dtype: str = "float32",
         tp: int = 1,
+        paged_pages: int = 0,
+        page_size: int = 16,
     ):
         cfg = TransformerConfig(
             vocab_size=vocab_size,
@@ -81,11 +83,24 @@ class DemoLLM(LLMComponent):
             params = quantize_ffn_params(params, mesh=mesh)
         if int8 == "full":
             params = quantize_attn_params(params)
-        super().__init__(
-            LLMEngine(params, cfg, max_slots=max_slots,
-                      chunk_prefill=chunk_prefill, mesh=mesh),
-            n_new=n_new,
-        )
+        if paged_pages > 0:
+            # paged KV serving (runtime/paged.py): HBM ~ tokens in flight;
+            # single-chip (see PagedLLMEngine docstring for why tp/spec
+            # stay on the slab engine)
+            if mesh is not None:
+                raise ValueError("paged_pages composes with tp=1 only")
+            from seldon_core_tpu.runtime.llm import PagedLLMEngine
+            from seldon_core_tpu.runtime.paged import PagedConfig
+
+            engine = PagedLLMEngine(
+                params, cfg,
+                PagedConfig(n_pages=paged_pages, page_size=page_size),
+                max_slots=max_slots, chunk_prefill=chunk_prefill,
+            )
+        else:
+            engine = LLMEngine(params, cfg, max_slots=max_slots,
+                               chunk_prefill=chunk_prefill, mesh=mesh)
+        super().__init__(engine, n_new=n_new)
         self.name = "llm"
 
     def tags(self):

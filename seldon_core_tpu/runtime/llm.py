@@ -43,7 +43,7 @@ from seldon_core_tpu.models.transformer import (
     prefill,
 )
 
-__all__ = ["LLMEngine", "LLMComponent"]
+__all__ = ["LLMEngine", "PagedLLMEngine", "LLMComponent"]
 
 
 def _bucket(n: int) -> int:
@@ -51,6 +51,34 @@ def _bucket(n: int) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _filter_pipeline(logits, temps, top_k, top_p):
+    """Shared sampling-filter math — the ONE definition of the engine's
+    sampling distribution, consumed by both :func:`sample_tokens` (which
+    draws from it) and :func:`filtered_probs` (which reports it for
+    rejection-sampling verification); any divergence between the two would
+    silently bias speculative-sampled outputs.
+
+    Filters compose the standard (HF) sequential way: temperature first,
+    then top-k, then top-p over the RENORMALIZED top-k survivors (the
+    nucleus mass uses the renormalized distribution; position 0 is always
+    kept because its exclusive cumsum is 0).
+
+    Returns ``(order (S, V) descending sort, sorted_logits (S, V)
+    temperature-scaled in sorted space, keep (S, V) mask)``."""
+    V = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    temp = jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(-logits, axis=-1)  # descending
+    sorted_logits = jnp.take_along_axis(logits / temp, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    pos = jnp.arange(V)[None, :]
+    keep_k = pos < jnp.where(top_k > 0, top_k, V)[:, None]
+    probs_k = jnp.where(keep_k, probs, 0.0)
+    probs_k = probs_k / jnp.sum(probs_k, axis=-1, keepdims=True)
+    keep_p = (jnp.cumsum(probs_k, axis=-1) - probs_k) < top_p[:, None]
+    return order, sorted_logits, keep_k & keep_p
 
 
 def sample_tokens(logits, temps, top_k, top_p, keys):
@@ -62,30 +90,14 @@ def sample_tokens(logits, temps, top_k, top_p, keys):
     - ``top_p``: (S,) float; >= 1 disables the nucleus filter
     - ``keys``: (S, 2) uint32 per-slot PRNG keys
 
-    Returns ``(tokens (S,) int32, new_keys (S, 2) uint32)``.  Filters
-    compose the standard (HF) sequential way: temperature first, then
-    top-k, then top-p over the RENORMALIZED top-k survivors; sampling
-    happens in sorted space and indices map back through the sort order.
+    Returns ``(tokens (S,) int32, new_keys (S, 2) uint32)``.  Sampling
+    happens in sorted space (see :func:`_filter_pipeline` for the filter
+    semantics) and indices map back through the sort order.
     """
-    V = logits.shape[-1]
-    logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    temp = jnp.maximum(temps, 1e-6)[:, None]
-    order = jnp.argsort(-logits, axis=-1)  # descending
-    sorted_logits = jnp.take_along_axis(logits / temp, order, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    pos = jnp.arange(V)[None, :]
-    keep_k = pos < jnp.where(top_k > 0, top_k, V)[:, None]
-    # nucleus: minimal prefix whose mass reaches p (position 0 always kept
-    # because its exclusive cumsum is 0).  The mass is computed over the
-    # RENORMALIZED top-k survivors — the HF sequential filter-then-
-    # renormalize convention — so top_k+top_p compose the way users of
-    # other samplers expect.
-    probs_k = jnp.where(keep_k, probs, 0.0)
-    probs_k = probs_k / jnp.sum(probs_k, axis=-1, keepdims=True)
-    keep_p = (jnp.cumsum(probs_k, axis=-1) - probs_k) < top_p[:, None]
-    filtered = jnp.where(keep_k & keep_p, sorted_logits, -jnp.inf)
+    order, sorted_logits, keep = _filter_pipeline(logits, temps, top_k,
+                                                  top_p)
+    filtered = jnp.where(keep, sorted_logits, -jnp.inf)
 
     split = jax.vmap(jax.random.split)(keys)  # (S, 2, 2)
     new_keys, use = split[:, 0], split[:, 1]
@@ -93,6 +105,96 @@ def sample_tokens(logits, temps, top_k, top_p, keys):
     sampled = jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0]
     toks = jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
     return toks, new_keys
+
+
+def filtered_probs(logits, temps, top_k, top_p):
+    """The exact (S, V) distribution :func:`sample_tokens` draws from when
+    ``temperature > 0``, scattered back to vocab order.  Used by
+    speculative verification: rejection sampling needs p(x)/q(x) under the
+    REAL sampling distributions, or acceptance would bias outputs."""
+    order, sorted_logits, keep = _filter_pipeline(logits, temps, top_k,
+                                                  top_p)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    kept = jnp.where(keep, probs, 0.0)
+    kept = kept / jnp.sum(kept, axis=-1, keepdims=True)
+    out = jnp.zeros_like(kept)
+    S = logits.shape[0]
+    return out.at[jnp.arange(S)[:, None], order].set(kept)
+
+
+def rejection_verify(pprobs, qprobs, drafts, tgt_greedy, temps, keys):
+    """Per-slot speculative verification (Leviathan/Chen rejection
+    sampling), vectorized over slots; greedy slots (temp<=0) use exact
+    argmax matching — the temp->0 limit of the same rule.
+
+    - ``pprobs``: (S, k+1, V) filtered TARGET distributions per position
+    - ``qprobs``: (S, k, V) filtered DRAFT distributions the drafts were
+      sampled from
+    - ``drafts``: (S, k) draft proposals; ``tgt_greedy``: (S, k+1) target
+      argmax per position
+    - ``keys``: (S, 2) PRNG state
+
+    Returns ``(tokens (S, k+1), n_emit (S,), new_keys)``: emit
+    ``tokens[s, :n_emit[s]]`` — accepted draft prefix plus one token that
+    is a residual resample on rejection or the position-k bonus sample on
+    full acceptance.  Marginal distribution of every emitted token is
+    EXACTLY the target sampling distribution.
+    """
+    S, k = drafts.shape
+    sidx = jnp.arange(S)
+
+    split = jax.vmap(partial(jax.random.split, num=4))(keys)  # (S, 4, 2)
+    new_keys, k_u, k_res, k_bonus = (split[:, i] for i in range(4))
+
+    # acceptance: u*q(x) < p(x)  <=>  u < p/q (q(x)>0: x was drawn from q)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(k_u)  # (S, k)
+    px = jnp.take_along_axis(
+        pprobs[:, :k], drafts[:, :, None], axis=2
+    )[:, :, 0]
+    qx = jnp.take_along_axis(qprobs, drafts[:, :, None], axis=2)[:, :, 0]
+    accept_sampled = u * qx < px
+    accept_greedy = drafts == tgt_greedy[:, :k]
+    accept = jnp.where((temps > 0.0)[:, None], accept_sampled, accept_greedy)
+    acc_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(acc_prefix, axis=1)  # (S,) in [0, k]
+
+    # residual distributions norm(max(p - q, 0)) for every position (the
+    # rejected one is selected after); zero-mass residual (p == q) falls
+    # back to p
+    res = jnp.maximum(pprobs[:, :k] - qprobs, 0.0)
+    res_sum = jnp.sum(res, axis=-1, keepdims=True)
+    res = jnp.where(res_sum > 0, res / jnp.maximum(res_sum, 1e-20),
+                    pprobs[:, :k])
+    res_keys = jax.vmap(partial(jax.random.split, num=k))(k_res)  # (S,k,2)
+    log_res = jnp.log(jnp.maximum(res, 1e-38))
+    resamples = jax.vmap(jax.vmap(jax.random.categorical))(
+        res_keys, log_res
+    )  # (S, k)
+    bonus = jax.vmap(jax.random.categorical)(
+        k_bonus, jnp.log(jnp.maximum(pprobs[:, k], 1e-38))
+    )  # (S,)
+
+    # the single non-draft token: residual resample at the rejection
+    # position, bonus on full acceptance; greedy slots take target argmax
+    final_sampled = jnp.where(
+        n_acc < k,
+        jnp.take_along_axis(
+            resamples, jnp.minimum(n_acc, k - 1)[:, None], axis=1
+        )[:, 0],
+        bonus,
+    )
+    final_greedy = jnp.take_along_axis(
+        tgt_greedy, n_acc[:, None], axis=1
+    )[:, 0]
+    final = jnp.where(temps > 0.0, final_sampled, final_greedy).astype(
+        jnp.int32
+    )
+
+    tokens = jnp.concatenate(
+        [drafts, jnp.zeros((S, 1), drafts.dtype)], axis=1
+    )
+    tokens = tokens.at[sidx, n_acc].set(final)
+    return tokens, (n_acc + 1).astype(jnp.int32), new_keys
 
 
 _DONE = object()  # end-of-stream sentinel on a slot's token queue
@@ -112,15 +214,21 @@ class LLMEngine:
     ``await engine.generate(prompt_ids, n_new)`` → generated ids
     ``[1, L0 + n_new]``.  Greedy by default; per-request temperature.
 
-    With ``draft_params``/``draft_cfg``, ticks run GREEDY SPECULATIVE
-    decoding across all slots at once: the draft proposes ``k_draft``
-    tokens per slot inside one compiled program (``lax.scan``), the target
-    verifies them in one K-token chunk, and each slot accepts its longest
-    agreeing prefix + the target's correction — 1..k_draft+1 tokens per
-    target call, per slot, with per-slot position rewind (free under the
-    pos-masked static cache).  Output is EXACTLY the target's own greedy
-    decode.  Ticks with any sampled (temperature>0) slot active fall back
-    to the normal one-token tick, so sampling semantics are unchanged.
+    With ``draft_params``/``draft_cfg``, ticks run SPECULATIVE decoding
+    across all slots at once: the draft proposes ``k_draft`` tokens per
+    slot inside one compiled program (``lax.scan``), the target verifies
+    them in one K-token chunk, and each slot accepts per-slot — 1..k+1
+    tokens per target call, with per-slot position rewind (free under the
+    pos-masked static cache).  Greedy slots accept their longest
+    draft/target argmax-agreeing prefix: output is EXACTLY the target's
+    own greedy decode.  Sampled (temperature>0) slots use REJECTION
+    SAMPLING (accept x_i w.p. min(1, p(x_i)/q(x_i)) under the slot's
+    filtered distributions, residual resample on rejection, bonus draw on
+    full acceptance): every emitted token's marginal distribution is
+    exactly the target sampling distribution — the published
+    speculative-sampling guarantee — and greedy + sampled slots
+    speculate SIMULTANEOUSLY instead of sampled arrivals suspending
+    speculation engine-wide.
     """
 
     def __init__(
@@ -168,12 +276,11 @@ class LLMEngine:
         # earlier rows)
         cache_len = self.max_len + (k_draft + 1 if draft_params is not None
                                     else 0)
-        self.cache = init_cache(cfg, max_slots, max_len=cache_len, mesh=mesh)
+        self.cache = self._init_cache(cache_len)  # PagedLLMEngine overrides
         if draft_params is not None:
             self.draft_cache = init_cache(draft_cfg, max_slots,
                                           max_len=cache_len, mesh=mesh)
             self._spec = jax.jit(self._spec_impl)
-            self._step_sync = jax.jit(self._step_sync_impl)
             self._draft_prefills: dict[int, Any] = {}
         self._slots: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
@@ -199,64 +306,72 @@ class LLMEngine:
         self._prefixes: dict[tuple, dict] = {}
         self._extends: dict[tuple, Any] = {}  # (cap0, Bs) -> jitted extend
 
-    def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys,
-                   pos=None):
+    def _init_cache(self, cache_len: int):
+        return init_cache(self.cfg, self.max_slots, max_len=cache_len,
+                          mesh=self.mesh)
+
+    def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys):
         """One decode tick + on-device sampling: logits never leave HBM.
-        ``pos`` (speculative mode): host-owned per-slot positions override
-        the device-side ones, which go stale after a speculative rewind."""
-        if pos is not None:
-            cache = {**cache, "pos": pos}
+        (Speculative mode never runs plain ticks — _spec_impl owns the
+        host-position threading there.)"""
         logits, cache = decode_step(params, cache, tok, cfg=self.cfg,
                                     mesh=self.mesh)
         toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
         return toks, keys, cache
 
-    def _step_sync_impl(self, params, draft_params, t_cache, d_cache, tok,
-                        temps, top_k, top_p, keys, pos):
-        """Plain tick in speculative mode: the draft model steps ALONGSIDE
-        the target on the same token, so greedy slots' draft KV stays in
-        sync through fallback interludes (sampled slot active) — otherwise
-        resumed speculation would draft against zero K/V rows and accept
-        nothing, making it slower than plain decoding."""
-        t_cache = {**t_cache, "pos": pos}
-        d_cache = {**d_cache, "pos": pos}
-        logits, t_cache = decode_step(params, t_cache, tok, cfg=self.cfg,
-                                      mesh=self.mesh)
-        _, d_cache = decode_step(draft_params, d_cache, tok,
-                                 cfg=self.draft_cfg, mesh=self.mesh)
-        toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
-        return toks, keys, t_cache, d_cache
-
-    def _spec_impl(self, params, draft_params, t_cache, d_cache, tok, pos):
-        """One speculative tick, fully on device: draft k tokens per slot
-        (scan), verify in one (k+1)-token target chunk, return greedy draft
-        + target tokens for host-side acceptance."""
+    def _spec_impl(self, params, draft_params, t_cache, d_cache, tok, pos,
+                   temps, top_k, top_p, keys):
+        """One speculative tick, fully on device: SAMPLE k draft tokens per
+        slot from the slot's filtered draft distribution (argmax for greedy
+        slots), verify in one (k+1)-token target chunk with per-slot
+        rejection sampling (:func:`rejection_verify`), and return the
+        tokens to emit + per-slot counts.  Sampled slots' outputs follow
+        EXACTLY the target sampling distribution; greedy slots reproduce
+        the target's greedy decode byte-for-byte."""
         from jax import lax
 
         t_cache = {**t_cache, "pos": pos}
         d_cache = {**d_cache, "pos": pos}
+        k = self.k_draft
 
         def body(carry, _):
-            d_cache, t = carry
+            d_cache, t, keys = carry
             dl, d_cache = decode_step(draft_params, d_cache, t,
                                       cfg=self.draft_cfg, mesh=self.mesh)
-            t = jnp.argmax(dl, -1).astype(jnp.int32)
-            return (d_cache, t), t
+            q = filtered_probs(dl, temps, top_k, top_p)
+            split = jax.vmap(jax.random.split)(keys)
+            keys, sub = split[:, 0], split[:, 1]
+            samp = jax.vmap(jax.random.categorical)(
+                sub, jnp.log(jnp.maximum(q, 1e-38))
+            )
+            greedy = jnp.argmax(dl, -1)
+            t = jnp.where(temps > 0.0, samp, greedy).astype(jnp.int32)
+            return (d_cache, t, keys), (t, q)
 
         # k_draft + 1 steps: the extra step processes d_{k-1} so its draft
         # KV row is WRITTEN — on full acceptance the rewound position counts
         # that row as valid, and a never-written row there would leave a
         # permanent zero the draft attends over forever after, decaying
         # acceptance round by round.  Its proposed token is discarded.
-        (d_cache, _), drafts = lax.scan(
-            body, (d_cache, tok), None, length=self.k_draft + 1
+        (d_cache, _, keys), (drafts, qprobs) = lax.scan(
+            body, (d_cache, tok, keys), None, length=k + 1
         )
-        drafts = jnp.moveaxis(drafts, 0, 1)[:, : self.k_draft]  # [S, k]
+        drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]          # [S, k]
+        qprobs = jnp.moveaxis(qprobs, 0, 1)[:, :k]          # [S, k, V]
         vtokens = jnp.concatenate([tok[:, None], drafts], axis=1)
         vlogits, t_cache = decode_step(params, t_cache, vtokens, cfg=self.cfg,
                                        mesh=self.mesh)
-        tgt = jnp.argmax(vlogits, -1).astype(jnp.int32)  # [S, k+1]
-        return drafts, tgt, t_cache, d_cache
+        tgt = jnp.argmax(vlogits, -1).astype(jnp.int32)     # [S, k+1]
+        S, V = vlogits.shape[0], vlogits.shape[2]
+        pprobs = filtered_probs(
+            vlogits.reshape(S * (k + 1), V),
+            jnp.repeat(temps, k + 1), jnp.repeat(top_k, k + 1),
+            jnp.repeat(top_p, k + 1),
+        ).reshape(S, k + 1, V)
+        tokens, n_emit, keys = rejection_verify(
+            pprobs, qprobs, drafts, tgt, temps, keys
+        )
+        return tokens, n_emit, keys, t_cache, d_cache
 
     # -- prefix caching --------------------------------------------------
     def register_prefix(self, prefix_ids) -> None:
@@ -471,6 +586,9 @@ class LLMEngine:
             return
         slot = await self._acquire_slot()
         try:
+            # capacity hook (no-op here): PagedLLMEngine reserves KV pages
+            # for the request's worst case, waiting if the pool is empty
+            await self._reserve_capacity(slot, L0, n_new)
             # prefix set is re-checked AFTER slot acquisition: a prefix may
             # have been registered while this request waited in the queue
             if self._prefixes and host_ids is None:
@@ -523,12 +641,12 @@ class LLMEngine:
                 logits, small = self._prefill_for(_bucket(L0))(
                     self.params, padded, logit_pos=L0 - 1
                 )
-            if self.draft_params is not None and temperature <= 0.0:
+            if self.draft_params is not None:
                 # the draft model needs its own KV for the whole prompt
                 # (prefix cache entries are target-model state only; the
-                # draft prefill is cheap by construction).  Sampled
-                # requests skip it: speculation never runs while a sampled
-                # slot is active, so its draft KV would be dead work.
+                # draft prefill is cheap by construction) — sampled
+                # requests too: per-slot rejection-sampling speculation
+                # drafts for every slot every tick
                 dpad = jnp.pad(
                     prompt_ids, ((0, 0), (0, _bucket(L0) - L0))
                 )
@@ -604,6 +722,10 @@ class LLMEngine:
                 self._finish(slot, st)
 
     # -- internals -------------------------------------------------------
+    async def _reserve_capacity(self, slot: int, L0: int, n_new: int) -> None:
+        """Capacity admission hook — the slab engine's capacity IS the slot
+        (max_slots x max_len rows preallocated), so nothing to do."""
+
     async def _acquire_slot(self) -> int:
         """FIFO slot admission — waiters are woken in arrival order by
         ``_release_slot`` (no polling)."""
@@ -649,20 +771,7 @@ class LLMEngine:
         # token sampled from the previous occupant's logits row — index
         # membership alone cannot distinguish re-occupancy
         active = dict(self._slots)
-        if self.draft_params is not None:
-            # speculative mode: host mirror owns positions (device pos goes
-            # stale after rewinds) and the draft cache steps alongside
-            toks, keys, self.cache, self.draft_cache = self._step_sync(
-                self.params, self.draft_params, self.cache,
-                self.draft_cache, self._tokens, self._temps, self._topk,
-                self._topp, self._keys, self._pos,
-            )
-        else:
-            toks, keys, self.cache = self._step(
-                self.params, self.cache,
-                self._tokens, self._temps, self._topk, self._topp,
-                self._keys,
-            )
+        toks, keys, self.cache = self._dispatch_plain()
         # one transfer per tick for all slots, OFF the event loop — a
         # blocking fetch here would stall every other handler (health
         # probes, new arrivals) for the device round trip.  Only the
@@ -678,46 +787,57 @@ class LLMEngine:
             self._pos[slot] += 1
             self._emit(slot, st, int(host_toks[slot]))
 
-    async def _spec_tick(self, loop) -> None:
-        """Speculative tick (all active slots greedy): accept each slot's
-        longest draft/target agreeing prefix + the target correction."""
-        active = dict(self._slots)
-        drafts, tgt, self.cache, self.draft_cache = self._spec(
-            self.params, self.draft_params, self.cache, self.draft_cache,
-            self._tokens, self._pos,
+    def _dispatch_plain(self):
+        """Dispatch one plain decode tick (overridden by PagedLLMEngine to
+        thread the page tables + host positions through)."""
+        return self._step(
+            self.params, self.cache,
+            self._tokens, self._temps, self._topk, self._topp,
+            self._keys,
         )
-        host_d, host_t = await loop.run_in_executor(
-            None, lambda: (np.asarray(drafts), np.asarray(tgt))
+
+    async def _spec_tick(self, loop) -> None:
+        """Speculative tick, per-slot accept/reject on device
+        (:func:`rejection_verify`): greedy slots emit their longest
+        draft/target agreeing prefix + the correction; sampled slots emit
+        their accepted prefix + a residual/bonus sample — both 1..k+1
+        tokens per tick, simultaneously."""
+        active = dict(self._slots)
+        tokens, n_emit, keys, self.cache, self.draft_cache = self._spec(
+            self.params, self.draft_params, self.cache, self.draft_cache,
+            self._tokens, self._pos, self._temps, self._topk, self._topp,
+            self._keys,
+        )
+        host_tok, host_n, host_keys = await loop.run_in_executor(
+            None,
+            lambda: (np.asarray(tokens), np.asarray(n_emit),
+                     np.asarray(keys)),
         )
         k = self.k_draft
         self.spec_stats["rounds"] += 1
         for slot, st in active.items():
             if self._slots.get(slot) is not st:
                 continue
-            d, t = host_d[slot], host_t[slot]
-            n_acc = 0
-            while n_acc < k and d[n_acc] == t[n_acc]:
-                n_acc += 1
+            self._keys[slot] = host_keys[slot]
+            n = int(host_n[slot])
             self.spec_stats["drafted"] += k
-            self.spec_stats["accepted"] += n_acc
+            self.spec_stats["accepted"] += n - 1
             pos0 = int(self._pos[slot])
-            for tokv in [int(x) for x in d[:n_acc]] + [int(t[n_acc])]:
+            for tokv in [int(x) for x in host_tok[slot, :n]]:
                 self._emit(slot, st, tokv)
                 if self._slots.get(slot) is not st:
                     break  # finished mid-chunk (stop/n_new); extra tokens
                     # discarded, slot freed — pos reset at next admission
             else:
-                # survived the whole chunk: processed = cur + accepted
-                # drafts; rejected rows are masked by the rewound pos
-                self._pos[slot] = pos0 + 1 + n_acc
+                # survived the whole chunk: processed = cur + emitted
+                # tokens; rejected rows are masked by the rewound pos
+                self._pos[slot] = pos0 + n
 
     async def _tick_loop(self) -> None:
         loop = asyncio.get_running_loop()
         try:
             while self._slots:
-                if self.draft_params is not None and all(
-                    self._temps[s] <= 0.0 for s in self._slots
-                ):
+                if self.draft_params is not None:
                     await self._spec_tick(loop)
                 else:
                     await self._plain_tick(loop)
@@ -730,6 +850,158 @@ class LLMEngine:
             raise
         finally:
             self._tick_task = None
+
+
+class PagedLLMEngine(LLMEngine):
+    """Continuous batching over a PAGED KV cache (runtime/paged.py).
+
+    HBM scales with tokens actually in flight instead of
+    ``max_slots x max_len``: requests reserve ``ceil((L0+n_new)/page_size)``
+    pages at admission (FIFO-fair waiting when the pool is dry, same
+    semantics as slot admission), so ``max_slots`` becomes a pure
+    concurrency knob — many short requests fit where the slab engine's
+    preallocation would cap out or refuse.  On TPU the decode attention
+    runs the fused Pallas paged-attention kernel; elsewhere an exact jnp
+    reference (tests assert byte-identical output vs the slab engine).
+
+    Composes with sampling, stop tokens, streaming, prefix caching, and
+    chunked prefill (all inherited — only the big-cache insert and the
+    decode tick differ).  NOT composable with speculative decoding: the
+    K-token verification chunk needs multi-query attention against pages,
+    which the TPU kernel doesn't expose — speculation stays on the slab
+    engine (the draft/verify workload is compute-dense, not
+    capacity-bound, so the pairing loses little).  Tensor-parallel
+    serving likewise stays on the slab engine for now (the kernel is
+    invoked per-device; sharding the page pool is future work).
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: TransformerConfig,
+        paged,
+        max_slots: int = 16,
+        max_len: Optional[int] = None,
+        chunk_prefill: int = 0,
+        use_kernel: Optional[bool] = None,
+    ):
+        from seldon_core_tpu.runtime.paged import (
+            PagedConfig,
+            insert_rows,
+            paged_decode_step,
+        )
+
+        if not isinstance(paged, PagedConfig):
+            raise TypeError("paged must be a PagedConfig")
+        if paged.n_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the trash page)")
+        self.paged_cfg = paged
+        self.use_kernel = use_kernel
+        self._paged_decode_step = paged_decode_step
+        super().__init__(params, cfg, max_slots=max_slots, max_len=max_len,
+                         chunk_prefill=chunk_prefill)
+        self.max_pp = paged.pages_for(self.max_len)
+        if self.max_pp > paged.n_pages - 1:
+            # a single max-length request must be admissible
+            raise ValueError(
+                f"max_len {self.max_len} needs {self.max_pp} pages but the "
+                f"pool has {paged.n_pages - 1} usable"
+            )
+        self._free_pages = list(range(1, paged.n_pages))
+        self._page_waiters: list[tuple[int, asyncio.Future]] = []
+        self._tables = np.zeros((max_slots, self.max_pp), np.int32)
+        self._reserved: dict[int, list] = {}
+        self._step_paged = jax.jit(self._paged_step_impl)
+        self._insert_rows = jax.jit(
+            insert_rows, static_argnames=("true_len",)
+        )
+        self._insert = self._paged_insert
+
+    # -- cache plumbing overrides ---------------------------------------
+    def _init_cache(self, cache_len: int):
+        from seldon_core_tpu.runtime.paged import init_paged_cache
+
+        return init_paged_cache(self.cfg, self.paged_cfg)
+
+    def _paged_step_impl(self, params, cache, tables, pos, tok, temps,
+                         top_k, top_p, keys):
+        logits, cache = self._paged_decode_step(
+            params, cache, tables, pos, tok, cfg=self.cfg,
+            paged=self.paged_cfg, use_kernel=self.use_kernel,
+        )
+        toks, keys = sample_tokens(logits, temps, top_k, top_p, keys)
+        return toks, keys, cache
+
+    def _dispatch_plain(self):
+        return self._step_paged(
+            self.params, self.cache, jnp.asarray(self._tables), self._pos,
+            self._tokens, self._temps, self._topk, self._topp, self._keys,
+        )
+
+    def _paged_insert(self, cache, small, slot, true_len: int):
+        ps = self.paged_cfg.page_size
+        idx = np.arange(true_len)
+        rows = self._tables[slot][idx // ps] * ps + idx % ps
+        return self._insert_rows(
+            cache, small, jnp.asarray(rows, jnp.int32), true_len=true_len
+        )
+
+    # -- page accounting -------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    async def _reserve_capacity(self, slot: int, L0: int, n_new: int) -> None:
+        need = self.paged_cfg.pages_for(L0 + n_new)
+        # (stream() already bounds L0+n_new <= max_len <= pool capacity)
+        if not self._page_waiters and len(self._free_pages) >= need:
+            pages = [self._free_pages.pop() for _ in range(need)]
+        else:
+            # FIFO: join the queue even if pages would fit — jumping ahead
+            # of a bigger earlier request would starve it under churn.
+            # Pages are HANDED OFF through the future (not re-checked), so
+            # a later arrival can never steal them between wake and run.
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._page_waiters.append((need, fut))
+            try:
+                pages = await fut
+            except BaseException:
+                if fut.done() and not fut.cancelled() \
+                        and fut.exception() is None:
+                    # cancelled after hand-off: return the pages
+                    self._free_pages.extend(fut.result())
+                else:
+                    self._page_waiters = [
+                        (n, f) for n, f in self._page_waiters if f is not fut
+                    ]
+                self._wake_page_waiters()
+                raise
+        self._reserved[slot] = pages
+        self._tables[slot, :] = 0
+        self._tables[slot, :need] = pages
+
+    def _wake_page_waiters(self) -> None:
+        while self._page_waiters:
+            need, fut = self._page_waiters[0]
+            if fut.done():
+                self._page_waiters.pop(0)
+                continue
+            if len(self._free_pages) < need:
+                break  # strict FIFO: later smaller requests wait too
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self._page_waiters.pop(0)
+            fut.set_result(pages)
+
+    def _release_slot(self, slot: int) -> None:
+        pages = self._reserved.pop(slot, None)
+        if pages:
+            self._tables[slot, :] = 0
+            self._free_pages.extend(pages)
+        # inactive slots' ticks write to the trash page at offset 0
+        self._pos[slot] = 0
+        super()._release_slot(slot)
+        if pages:
+            self._wake_page_waiters()
 
 
 class LLMComponent:

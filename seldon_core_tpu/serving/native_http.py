@@ -75,11 +75,16 @@ class _AsyncBridge:
         t.add_done_callback(self._tasks.discard)
 
     async def _run(self, token, method, path, body) -> None:
+        import time
+
+        t0 = time.perf_counter()
         try:
             status, out, msg = await self._router(method, path, body)
         except Exception as e:  # router bug: fail the request, keep serving
             logger.exception("native bridge handler failed (%s)", path)
-            status, out, msg = self._error_result(e)
+            status, out, msg = self._error_result(
+                e, time.perf_counter() - t0
+            )
         self.server.complete(token, status, out, msg)
 
     async def start(self) -> int:
@@ -172,7 +177,7 @@ class NativeGrpcServer:
         )
 
     @staticmethod
-    def _error(e: Exception):
+    def _error(e: Exception, elapsed_s: float = 0.0):
         return (13, b"", f"{type(e).__name__}: {e}")  # INTERNAL
 
     async def _route(self, method: str, path: str, body: bytes):
@@ -250,21 +255,20 @@ class NativeRestServer:
             reuseport=reuseport, error_result=self._error,
         )
 
-    def _observe(self, t0: float, code: int) -> None:
+    def _observe_s(self, seconds: float, code: int) -> None:
         """Every terminal response records a request sample — same contract
         as the aiohttp tier, so error-rate dashboards see 4xx/5xx here
         too."""
         if self.metrics is not None:
-            import time
+            self.metrics.observe_request(self.name, seconds, code)
 
-            self.metrics.observe_request(
-                self.name, time.perf_counter() - t0, code
-            )
-
-    def _error(self, e: Exception):
+    def _observe(self, t0: float, code: int) -> None:
         import time
 
-        self._observe(time.perf_counter(), 500)
+        self._observe_s(time.perf_counter() - t0, code)
+
+    def _error(self, e: Exception, elapsed_s: float = 0.0):
+        self._observe_s(elapsed_s, 500)
         return (500, _fail_json(500, f"{type(e).__name__}: {e}"), None)
 
     async def _route(self, method: str, path: str, body: bytes):
@@ -276,6 +280,7 @@ class NativeRestServer:
                 return (200, path[1:].encode(), None)
             if path == "/metrics" and self.metrics is not None:
                 return (200, self.metrics.render().encode(), None)
+            self._observe(t0, 404)
             return (404, _fail_json(404, f"no route {path}"), None)
         fn = self._routes.get((method, path))
         if fn is None:

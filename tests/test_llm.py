@@ -679,17 +679,104 @@ class TestSpeculativeEngine:
 
         asyncio.run(run())
 
-    def test_sampled_request_falls_back_and_matches(self):
+    def test_sampled_request_speculates_and_is_seed_deterministic(self):
+        """Rejection-sampling speculation (VERDICT r2 weak #4): sampled
+        requests now SPECULATE (no engine-wide suspension) and a fixed
+        seed is reproducible."""
+
         async def run():
             kw = dict(temperature=1.0, top_k=8, seed=13)
-            base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
-            want = np.asarray((await base.generate(prompt(5), 8, **kw))[0])
             eng = self._spec_engine()
             got = np.asarray((await eng.generate(prompt(5), 8, **kw))[0])
-            np.testing.assert_array_equal(got, want)
-            assert eng.spec_stats["rounds"] == 0  # never speculated
+            assert eng.spec_stats["rounds"] >= 1  # speculation DID run
+            eng2 = self._spec_engine()
+            got2 = np.asarray((await eng2.generate(prompt(5), 8, **kw))[0])
+            np.testing.assert_array_equal(got, got2)
 
         asyncio.run(run())
+
+    def test_mixed_greedy_and_sampled_slots_speculate_together(self):
+        """A sampled slot running concurrently must not change greedy
+        slots' output (still byte-exact target greedy decode) and both
+        must speculate in the same ticks."""
+
+        async def run():
+            eng = self._spec_engine()
+            greedy_out, sampled_out = await asyncio.gather(
+                eng.generate(prompt(4), 10),
+                eng.generate(prompt(6, seed=3), 10, temperature=1.0,
+                             top_k=8, seed=21),
+            )
+            return np.asarray(greedy_out), eng.spec_stats
+
+        got, stats = asyncio.run(run())
+        ref = generate(PARAMS, prompt(4), 10, TINY)
+        np.testing.assert_array_equal(got, np.asarray(ref))
+        assert stats["rounds"] >= 1
+
+    def test_rejection_verify_preserves_target_distribution(self):
+        """The core speculative-sampling guarantee, tested directly on the
+        verification math: over many trials with a BIASED draft
+        distribution, emitted tokens follow the target distribution (TV
+        distance < 0.05), position-by-position."""
+        from seldon_core_tpu.runtime.llm import rejection_verify
+
+        rng = np.random.default_rng(0)
+        V, k, N = 8, 1, 4000
+        p = np.asarray([0.4, 0.2, 0.15, 0.1, 0.05, 0.05, 0.03, 0.02])
+        q = np.asarray([0.05, 0.05, 0.3, 0.3, 0.1, 0.1, 0.05, 0.05])
+
+        pprobs = jnp.asarray(
+            np.tile(p, (N, k + 1, 1)), jnp.float32
+        )  # bonus position uses p too
+        qprobs = jnp.asarray(np.tile(q, (N, k, 1)), jnp.float32)
+        drafts = jnp.asarray(
+            rng.choice(V, size=(N, k), p=q), jnp.int32
+        )
+        tgt_greedy = jnp.zeros((N, k + 1), jnp.int32)
+        temps = jnp.ones((N,), jnp.float32)
+        keys = jnp.asarray(
+            np.stack([np.asarray(jax.random.PRNGKey(i)) for i in range(N)]),
+            jnp.uint32,
+        )
+        tokens, n_emit, _ = jax.jit(rejection_verify)(
+            pprobs, qprobs, drafts, tgt_greedy, temps, keys
+        )
+        tokens, n_emit = np.asarray(tokens), np.asarray(n_emit)
+        # position 0's emitted token (accepted draft or residual resample)
+        # must be p-distributed; when accepted, position 1 (bonus) too
+        emp0 = np.bincount(tokens[:, 0], minlength=V) / N
+        assert np.abs(emp0 - p).sum() / 2 < 0.05, emp0
+        acc = n_emit == 2
+        if acc.sum() > 500:
+            emp1 = np.bincount(tokens[acc, 1], minlength=V) / acc.sum()
+            assert np.abs(emp1 - p).sum() / 2 < 0.07, emp1
+
+    def test_engine_sampled_distribution_matches_plain(self):
+        """End-to-end distribution check: the SECOND generated token's
+        distribution (first token produced by the spec tick) matches the
+        plain engine's across seeds, TV < 0.12 at N=250."""
+        N = 250
+        kw = dict(temperature=1.0, top_k=8)
+
+        async def collect(make):
+            toks = []
+            eng = make()
+            for seed in range(N):
+                out = await eng.generate(prompt(5), 2, seed=seed, **kw)
+                toks.append(int(np.asarray(out)[0, -1]))
+            return np.bincount(toks, minlength=64) / N
+
+        async def run():
+            spec = await collect(self._spec_engine)
+            plain = await collect(
+                lambda: LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
+            )
+            return spec, plain
+
+        spec, plain = asyncio.run(run())
+        tv = np.abs(spec - plain).sum() / 2
+        assert tv < 0.12, f"TV distance {tv}: spec sampling is biased"
 
     def test_no_draft_kv_holes_after_full_acceptance(self):
         """On full acceptance the rewound position counts row pos+k as
@@ -711,11 +798,13 @@ class TestSpeculativeEngine:
 
         asyncio.run(run())
 
-    def test_draft_cache_stays_synced_through_fallback(self):
-        """A sampled slot forces plain ticks; during those, the draft cache
-        must advance with the target (draft steps alongside), or resumed
-        speculation drafts against zero K/V.  With draft == target the
-        invariant is sharp: acceptance stays PERFECT after the interlude."""
+    def test_perfect_draft_accepts_sampled_slots_too(self):
+        """With draft == target, rejection sampling accepts with
+        probability min(1, p/q) = 1 — so acceptance stays PERFECT even
+        with a sampled slot speculating alongside a greedy one, and the
+        greedy slot's output is still byte-exact target greedy decode.
+        (This sharpens the old fallback-sync test: there is no fallback
+        anymore — sampled slots speculate too.)"""
 
         async def run():
             eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48,
@@ -723,19 +812,17 @@ class TestSpeculativeEngine:
             base = LLMEngine(PARAMS, TINY, max_slots=2, max_len=48)
             pg, ps = prompt(5, seed=1), prompt(4, seed=2)
             want_g = np.asarray((await base.generate(pg, 14))[0])
-            want_s = np.asarray(
-                (await base.generate(ps, 4, temperature=1.0, seed=9))[0]
-            )
             g, s = await asyncio.gather(
                 eng.generate(pg, 14),
                 eng.generate(ps, 4, temperature=1.0, seed=9),
             )
             np.testing.assert_array_equal(np.asarray(g[0]), want_g)
-            np.testing.assert_array_equal(np.asarray(s[0]), want_s)
+            assert np.asarray(s).shape[1] == 4 + 4
             st = eng.spec_stats
             assert st["rounds"] > 0
-            # perfect draft: every drafted token must verify, INCLUDING the
-            # rounds after the sampled slot's fallback interlude
+            # draft == target: every drafted token verifies, greedy AND
+            # sampled (p == q -> acceptance probability 1, up to float
+            # reduction-order noise which would need u within ~1e-6 of 1)
             assert st["accepted"] == st["drafted"], st
 
         asyncio.run(run())
@@ -1203,3 +1290,169 @@ class TestMeshEngine:
         ref = generate(self.GQA_PARAMS, prompt(4), 8, self.GQA)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
         assert stats["rounds"] >= 1
+
+
+class TestPagedEngine:
+    """Paged KV cache (VERDICT r2 weak #6): HBM scales with tokens in
+    flight; admission reserves pages, not slabs.  Every path must be
+    byte-identical to the slab engine."""
+
+    GQA = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=64, dtype=jnp.float32,
+    )
+    GQA_PARAMS = init_params(jax.random.PRNGKey(0), GQA)
+
+    def _paged(self, n_pages=17, page_size=4, **kw):
+        from seldon_core_tpu.runtime.llm import PagedLLMEngine
+        from seldon_core_tpu.runtime.paged import PagedConfig
+
+        kw.setdefault("max_slots", 6)
+        kw.setdefault("max_len", 32)
+        return PagedLLMEngine(
+            self.GQA_PARAMS, self.GQA,
+            PagedConfig(n_pages=n_pages, page_size=page_size), **kw
+        )
+
+    def test_greedy_exactness_and_page_return(self):
+        eng = self._paged()
+
+        async def run():
+            return await asyncio.gather(
+                eng.generate(prompt(4), 6), eng.generate(prompt(7, 2), 4)
+            )
+
+        outs = asyncio.run(run())
+        np.testing.assert_array_equal(
+            np.asarray(outs[0]),
+            np.asarray(generate(self.GQA_PARAMS, prompt(4), 6, self.GQA)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(outs[1]),
+            np.asarray(generate(self.GQA_PARAMS, prompt(7, 2), 4, self.GQA)),
+        )
+        assert eng.free_pages == 16  # every page returned
+
+    def test_sampled_and_stop_match_slab_engine(self):
+        eng = self._paged()
+        slab = LLMEngine(self.GQA_PARAMS, self.GQA, max_slots=6, max_len=32)
+        kw = dict(temperature=0.9, top_k=16, top_p=0.9, seed=5,
+                  stop_tokens=(13,))
+
+        async def run(e):
+            return await e.generate(prompt(3, 3), 8, **kw)
+
+        np.testing.assert_array_equal(
+            np.asarray(asyncio.run(run(eng))),
+            np.asarray(asyncio.run(run(slab))),
+        )
+
+    def test_streaming_is_incremental_and_exact(self):
+        eng = self._paged()
+
+        async def run():
+            toks = []
+            async for t in eng.stream(prompt(4), 6):
+                toks.append(t)
+            return toks
+
+        toks = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, prompt(4), 6, self.GQA)
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.asarray(ref)[0, 4:])
+
+    def test_more_concurrency_than_slab_hbm_allows(self):
+        """The capacity story: with a 64-token-row HBM budget (16 usable
+        4-token pages), a slab engine at max_len=32 fits TWO slots; the
+        paged engine serves SIX concurrent short requests in the same
+        budget, each byte-exact."""
+        eng = self._paged(n_pages=17, page_size=4, max_slots=6)
+        slab_slots_same_hbm = (16 * 4) // 32
+        assert slab_slots_same_hbm == 2
+        reqs = [(prompt(3, seed=s), 5) for s in range(6)]
+
+        async def run():
+            return await asyncio.gather(
+                *(eng.generate(p, n) for p, n in reqs)
+            )
+
+        outs = asyncio.run(run())
+        for (p, n), out in zip(reqs, outs):
+            np.testing.assert_array_equal(
+                np.asarray(out),
+                np.asarray(generate(self.GQA_PARAMS, p, n, self.GQA)),
+            )
+        assert eng.free_pages == 16
+
+    def test_page_exhaustion_waits_not_fails(self):
+        """Requests beyond the page pool WAIT (FIFO) and complete once
+        earlier requests release — admission backpressure, not an error."""
+        # pool: 4 usable pages x 4 tokens = 16 rows; each request needs
+        # 8 rows (2 pages) -> two run concurrently, two wait
+        eng = self._paged(n_pages=5, page_size=4, max_slots=6, max_len=16)
+        reqs = [(prompt(3, seed=s), 5) for s in range(4)]
+
+        async def run():
+            return await asyncio.gather(
+                *(eng.generate(p, n) for p, n in reqs)
+            )
+
+        outs = asyncio.run(run())
+        for (p, n), out in zip(reqs, outs):
+            np.testing.assert_array_equal(
+                np.asarray(out),
+                np.asarray(generate(self.GQA_PARAMS, p, n, self.GQA)),
+            )
+        assert eng.free_pages == 4
+
+    def test_prefix_cache_and_chunked_prefill_compose(self):
+        pre = prompt(12, seed=11)
+        suf = prompt(5, seed=12)
+        full = jnp.concatenate([pre, suf], axis=1)
+        eng = self._paged(chunk_prefill=4)
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+
+        async def run():
+            return await eng.generate(np.asarray(full).reshape(-1), 5)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, full, 5, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_abandoned_stream_returns_pages(self):
+        eng = self._paged()
+
+        async def run():
+            agen = eng.stream(prompt(4), 20)
+            await agen.__anext__()
+            await agen.aclose()
+            # slot release is synchronous on aclose
+            return eng.free_pages
+
+        assert asyncio.run(run()) == 16
+
+    def test_pool_too_small_for_max_len_rejected(self):
+        with pytest.raises(ValueError, match="pages"):
+            self._paged(n_pages=3, page_size=4, max_len=32)
+
+
+def test_demo_llm_paged_parameter():
+    """The deployable component exposes paged serving via CRD parameters[]
+    (paged_pages/page_size) — same jsonData surface, paged engine inside."""
+    from seldon_core_tpu.models.llm_demo import DemoLLM
+    from seldon_core_tpu.runtime.llm import PagedLLMEngine
+
+    comp = DemoLLM(d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+                   vocab_size=64, max_seq=32, paged_pages=9, page_size=4)
+    assert isinstance(comp.engine, PagedLLMEngine)
+
+    async def run():
+        from seldon_core_tpu.messages import SeldonMessage
+
+        out = await comp.predict(SeldonMessage(
+            json_data={"prompt_ids": [3, 1, 4], "n_new": 4}
+        ))
+        return out.json_data
+
+    d = asyncio.run(run())
+    assert len(d["ids"]) == 7 and d["prompt_len"] == 3
