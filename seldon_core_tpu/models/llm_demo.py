@@ -51,6 +51,7 @@ class DemoLLM(LLMComponent):
         tp: int = 1,
         paged_pages: int = 0,
         page_size: int = 16,
+        auto_prefix_tokens: int = -1,
     ):
         cfg = TransformerConfig(
             vocab_size=vocab_size,
@@ -83,6 +84,11 @@ class DemoLLM(LLMComponent):
             params = quantize_ffn_params(params, mesh=mesh)
         if int8 == "full":
             params = quantize_attn_params(params)
+        if auto_prefix_tokens < 0:
+            # ON by default in the serving component: real traffic shares
+            # system prompts without announcing them (engine default is
+            # off so library users opt in explicitly)
+            auto_prefix_tokens = 4 * max_seq
         if paged_pages > 0:
             # paged KV serving (runtime/paged.py): HBM ~ tokens in flight;
             # single-chip (see PagedLLMEngine docstring for why tp/spec
@@ -96,10 +102,12 @@ class DemoLLM(LLMComponent):
                 params, cfg,
                 PagedConfig(n_pages=paged_pages, page_size=page_size),
                 max_slots=max_slots, chunk_prefill=chunk_prefill,
+                auto_prefix_tokens=auto_prefix_tokens,
             )
         else:
             engine = LLMEngine(params, cfg, max_slots=max_slots,
-                               chunk_prefill=chunk_prefill, mesh=mesh)
+                               chunk_prefill=chunk_prefill, mesh=mesh,
+                               auto_prefix_tokens=auto_prefix_tokens)
         super().__init__(engine, n_new=n_new)
         self.name = "llm"
 

@@ -242,6 +242,8 @@ class LLMEngine:
         k_draft: int = 4,
         chunk_prefill: int = 0,
         mesh=None,
+        auto_prefix_tokens: int = 0,
+        auto_prefix_granularity: int = 16,
     ):
         """``mesh``: serve TENSOR-PARALLEL over a jax.sharding.Mesh with a
         "tp" axis.  Params must be placed to match (``shard_params`` for
@@ -305,6 +307,20 @@ class LLMEngine:
         # "len", "logits"}; see register_prefix
         self._prefixes: dict[tuple, dict] = {}
         self._extends: dict[tuple, Any] = {}  # (cap0, Bs) -> jitted extend
+        # AUTOMATIC prefix caching: every admitted prompt's KV is cached
+        # (token-budget LRU) and later admissions reuse their longest
+        # COMMON prefix with any entry — causal attention makes rows
+        # 0..c-1 of a stored prompt exactly the KV of the shared prefix,
+        # so PARTIAL overlap reuses without a radix tree.  Reuse lengths
+        # round down to `auto_prefix_granularity` so the extend-program
+        # variety stays bounded (each distinct cap0 is a compile).
+        # auto_prefix_tokens=0 disables (the serving component enables it
+        # by default; see models/llm_demo.py).
+        self._auto_budget = int(auto_prefix_tokens)
+        self._auto_gran = max(int(auto_prefix_granularity), 1)
+        self._auto_entries: list[dict] = []  # LRU order, oldest first
+        self.prefix_stats = {"auto_hits": 0, "auto_tokens_reused": 0,
+                             "auto_stored": 0, "auto_evicted": 0}
 
     def _init_cache(self, cache_len: int):
         return init_cache(self.cfg, self.max_slots, max_len=cache_len,
@@ -398,8 +414,71 @@ class LLMEngine:
         }
 
     def clear_prefixes(self) -> None:
-        """Drop all cached prefixes (frees their HBM)."""
+        """Drop all cached prefixes, registered AND automatic (frees their
+        HBM)."""
         self._prefixes.clear()
+        self._auto_entries.clear()
+
+    # -- automatic prefix caching ---------------------------------------
+    def _auto_store(self, host_ids, small, L0: int) -> None:
+        """Cache an admitted prompt's KV for future common-prefix reuse
+        (token-budget LRU).  Slicing to L0 rows is one device op; the
+        entry shares no buffers with the slot cache, so slot recycling
+        can't corrupt it."""
+        if L0 > self._auto_budget or L0 < self._auto_gran:
+            return
+        ids = np.asarray(host_ids, np.int32).reshape(-1)[:L0]
+        for e in self._auto_entries:
+            if e["len"] >= L0 and np.array_equal(e["ids"][:L0], ids):
+                return  # an entry already covers this prompt
+        self._auto_entries.append({
+            "ids": ids,
+            "k": small["k"][:, :, :L0],
+            "v": small["v"][:, :, :L0],
+            "len": L0,
+        })
+        self.prefix_stats["auto_stored"] += 1
+        total = sum(e["len"] for e in self._auto_entries)
+        while total > self._auto_budget and len(self._auto_entries) > 1:
+            gone = self._auto_entries.pop(0)
+            total -= gone["len"]
+            self.prefix_stats["auto_evicted"] += 1
+
+    def _match_auto(self, host_ids, L0: int):
+        """Longest common prefix with any cached prompt, rounded down to
+        the granularity; capped at L0-1 so the suffix path always has a
+        token to run (and so the needed logits get computed).  Pure
+        lookup — stats/LRU update happen in :meth:`_auto_touch` only when
+        the caller actually USES the match (a longer registered prefix
+        may win)."""
+        ids = np.asarray(host_ids, np.int32).reshape(-1)
+        best, best_c = None, 0
+        for e in self._auto_entries:
+            m = min(e["len"], L0 - 1)
+            if m < self._auto_gran:
+                continue
+            neq = np.nonzero(e["ids"][:m] != ids[:m])[0]
+            c = m if neq.size == 0 else int(neq[0])
+            c -= c % self._auto_gran
+            if c > best_c:
+                best, best_c = e, c
+        if best is None or best_c < self._auto_gran:
+            return None
+        return {"k": best["k"][:, :, :best_c],
+                "v": best["v"][:, :, :best_c], "len": best_c,
+                "entry": best}
+
+    def _auto_touch(self, auto: dict) -> None:
+        e = auto.pop("entry")
+        # identity-based removal: list.remove would COMPARE entries, and
+        # dict equality over numpy arrays raises on the first same-length
+        # non-identical entry
+        self._auto_entries[:] = [
+            x for x in self._auto_entries if x is not e
+        ]
+        self._auto_entries.append(e)
+        self.prefix_stats["auto_hits"] += 1
+        self.prefix_stats["auto_tokens_reused"] += auto["len"]
 
     def _match_prefix(self, ids: tuple):
         """Longest registered prefix that ``ids`` starts with, or None."""
@@ -591,7 +670,7 @@ class LLMEngine:
             await self._reserve_capacity(slot, L0, n_new)
             # prefix set is re-checked AFTER slot acquisition: a prefix may
             # have been registered while this request waited in the queue
-            if self._prefixes and host_ids is None:
+            if (self._prefixes or self._auto_budget) and host_ids is None:
                 # device-resident caller: fetch OFF the event loop — a
                 # blocking device→host round trip here would stall every
                 # other handler (same reasoning as the tick-loop fetch)
@@ -603,6 +682,17 @@ class LLMEngine:
                 if self._prefixes
                 else None
             )
+            if self._auto_budget:
+                # automatic entries compete with registered ones on
+                # usable length (registered whole-prompt hits also carry
+                # logits, so prefer them at equal length); stats/LRU
+                # update only when the auto match actually WINS
+                auto = self._match_auto(host_ids, L0)
+                if auto is not None and (
+                    pref is None or auto["len"] > pref["len"]
+                ):
+                    self._auto_touch(auto)
+                    pref = auto
             chunking = self.chunk_prefill and L0 > self.chunk_prefill
             if pref is not None and pref["len"] == L0:
                 # whole prompt is a registered prefix: zero model work
@@ -691,6 +781,8 @@ class LLMEngine:
             # tick loop or a tick could advance a half-admitted slot
             self.cache = self._insert(self.cache, small, slot, true_len=L0)
             self._pos[slot] = L0
+            if self._auto_budget and host_ids is not None:
+                self._auto_store(host_ids, small, L0)
             if d_small is not None:
                 self.draft_cache = self._insert(
                     self.draft_cache, d_small, slot, true_len=L0
@@ -884,6 +976,8 @@ class PagedLLMEngine(LLMEngine):
         max_len: Optional[int] = None,
         chunk_prefill: int = 0,
         use_kernel: Optional[bool] = None,
+        auto_prefix_tokens: int = 0,
+        auto_prefix_granularity: int = 16,
     ):
         from seldon_core_tpu.runtime.paged import (
             PagedConfig,
@@ -899,7 +993,9 @@ class PagedLLMEngine(LLMEngine):
         self.use_kernel = use_kernel
         self._paged_decode_step = paged_decode_step
         super().__init__(params, cfg, max_slots=max_slots, max_len=max_len,
-                         chunk_prefill=chunk_prefill)
+                         chunk_prefill=chunk_prefill,
+                         auto_prefix_tokens=auto_prefix_tokens,
+                         auto_prefix_granularity=auto_prefix_granularity)
         self.max_pp = paged.pages_for(self.max_len)
         if self.max_pp > paged.n_pages - 1:
             # a single max-length request must be admissible

@@ -63,6 +63,10 @@ def main(argv=None) -> int:
                     help="drive the SSE streaming endpoint; payload is the "
                          "raw contract request (LLM contracts use jsonData) "
                          "and the report adds TTFT percentiles + tokens/s")
+    ld.add_argument("--rate", type=float, default=0.0,
+                    help="OPEN-loop mode: Poisson arrivals at this req/s "
+                         "(latency at fixed offered load); 0 = closed-loop "
+                         "with --concurrency workers")
 
     args = ap.parse_args(argv)
     contract = Contract.load(args.contract)
@@ -120,6 +124,7 @@ def main(argv=None) -> int:
         SseStreamDriver,
         oauth_token,
         run_load,
+        run_open_loop,
     )
 
     import numpy as np
@@ -157,13 +162,23 @@ def main(argv=None) -> int:
                 connections=max(args.concurrency, 16),
             )
             proto = "rest"
-        res = await run_load(
-            driver,
-            seconds=args.seconds,
-            concurrency=args.concurrency,
-            warmup_s=args.warmup,
-            protocol=proto,
-        )
+        if args.rate > 0:
+            res = await run_open_loop(
+                driver,
+                rate=args.rate,
+                seconds=args.seconds,
+                warmup_s=args.warmup,
+                seed=args.seed,
+                protocol=proto,
+            )
+        else:
+            res = await run_load(
+                driver,
+                seconds=args.seconds,
+                concurrency=args.concurrency,
+                warmup_s=args.warmup,
+                protocol=proto,
+            )
         return res, driver
 
     result, driver = asyncio.run(_run())

@@ -1456,3 +1456,159 @@ def test_demo_llm_paged_parameter():
 
     d = asyncio.run(run())
     assert len(d["ids"]) == 7 and d["prompt_len"] == 3
+
+
+class TestAutoPrefixCache:
+    """Automatic prefix caching (VERDICT r2 weak #5): shared prompt
+    prefixes hit WITHOUT register_prefix — longest-common-prefix reuse
+    over an LRU token budget, exact outputs."""
+
+    BIG = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq=1024, dtype=jnp.float32,
+    )
+    BIG_PARAMS = init_params(jax.random.PRNGKey(0), BIG)
+
+    def _engine(self, budget=2048, **kw):
+        return LLMEngine(self.BIG_PARAMS, self.BIG, max_slots=4,
+                         max_len=600, auto_prefix_tokens=budget, **kw)
+
+    def test_512_token_shared_prefix_second_request_is_one_suffix_chunk(self):
+        """The VERDICT scenario: two requests share a 512-token prefix;
+        the second's prefill must be ONE suffix extension (auto hit of
+        512 reused tokens), byte-exact."""
+        shared = prompt(512, seed=30)
+        s1 = prompt(8, seed=31)
+        s2 = prompt(8, seed=32)
+        p1 = jnp.concatenate([shared, s1], axis=1)
+        p2 = jnp.concatenate([shared, s2], axis=1)
+
+        async def run():
+            eng = self._engine()
+            a = await eng.generate(np.asarray(p1).reshape(-1), 4)
+            b = await eng.generate(np.asarray(p2).reshape(-1), 4)
+            return a, b, eng.prefix_stats
+
+        a, b, stats = asyncio.run(run())
+        assert stats["auto_hits"] == 1, stats
+        assert stats["auto_tokens_reused"] == 512, stats
+        np.testing.assert_array_equal(
+            np.asarray(a),
+            np.asarray(generate(self.BIG_PARAMS, p1, 4, self.BIG)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(b),
+            np.asarray(generate(self.BIG_PARAMS, p2, 4, self.BIG)),
+        )
+
+    def test_partial_overlap_reuses_common_prefix_only(self):
+        """Entries reuse the longest COMMON prefix, not only whole-entry
+        prefixes: request B shares just the first 32 tokens of cached
+        prompt A."""
+        a_ids = prompt(64, seed=40)
+        b_ids = jnp.concatenate(
+            [a_ids[:, :32], prompt(20, seed=41)], axis=1
+        )
+
+        async def run():
+            eng = self._engine()
+            await eng.generate(np.asarray(a_ids).reshape(-1), 3)
+            out = await eng.generate(np.asarray(b_ids).reshape(-1), 3)
+            return out, eng.prefix_stats
+
+        out, stats = asyncio.run(run())
+        assert stats["auto_hits"] == 1
+        assert stats["auto_tokens_reused"] == 32
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            np.asarray(generate(self.BIG_PARAMS, b_ids, 3, self.BIG)),
+        )
+
+    def test_eviction_bounded_by_token_budget(self):
+        async def run():
+            eng = self._engine(budget=128)
+            for s in range(5):
+                await eng.generate(np.asarray(prompt(48, seed=50 + s)
+                                              ).reshape(-1), 2)
+            return eng
+
+        eng = asyncio.run(run())
+        total = sum(e["len"] for e in eng._auto_entries)
+        assert total <= 128, total
+        assert eng.prefix_stats["auto_evicted"] >= 1
+
+    def test_composes_with_chunked_prefill_and_paged(self):
+        from seldon_core_tpu.runtime.llm import PagedLLMEngine
+        from seldon_core_tpu.runtime.paged import PagedConfig
+
+        shared = prompt(64, seed=60)
+        p1 = jnp.concatenate([shared, prompt(6, seed=61)], axis=1)
+        p2 = jnp.concatenate([shared, prompt(6, seed=62)], axis=1)
+
+        async def run():
+            eng = PagedLLMEngine(
+                self.BIG_PARAMS, self.BIG,
+                PagedConfig(n_pages=65, page_size=8),
+                max_slots=4, max_len=128, chunk_prefill=16,
+                auto_prefix_tokens=512,
+            )
+            a = await eng.generate(np.asarray(p1).reshape(-1), 3)
+            b = await eng.generate(np.asarray(p2).reshape(-1), 3)
+            return a, b, eng.prefix_stats
+
+        a, b, stats = asyncio.run(run())
+        assert stats["auto_hits"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(b),
+            np.asarray(generate(self.BIG_PARAMS, p2, 3, self.BIG)),
+        )
+
+    def test_registered_prefix_still_preferred_at_equal_length(self):
+        """A registered whole-prompt hit (which carries logits -> zero
+        model work) must not be displaced by an auto entry of the same
+        length."""
+        pre = prompt(32, seed=70)
+
+        async def run():
+            eng = self._engine()
+            eng.register_prefix(np.asarray(pre).reshape(-1))
+            # generate with the full prompt == registered prefix + 1 token
+            full = jnp.concatenate([pre, prompt(1, seed=71)], axis=1)
+            out = await eng.generate(np.asarray(full).reshape(-1), 3)
+            return out
+
+        out = asyncio.run(run())
+        full = jnp.concatenate([pre, prompt(1, seed=71)], axis=1)
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            np.asarray(generate(self.BIG_PARAMS, full, 3, self.BIG)),
+        )
+
+
+def test_auto_prefix_lru_touch_with_equal_length_entries():
+    """Regression: LRU touch must remove by IDENTITY — dict equality over
+    numpy entries raises on the first same-length non-identical entry
+    (crashed admission once two equal-length prompts were cached)."""
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+                            d_ff=64, max_seq=128, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    async def run():
+        eng = LLMEngine(params, cfg, max_slots=2, max_len=64,
+                        auto_prefix_tokens=256)
+        a = prompt(32, seed=80)
+        b = prompt(32, seed=81)  # same length, different tokens
+        await eng.generate(np.asarray(a).reshape(-1), 2)
+        await eng.generate(np.asarray(b).reshape(-1), 2)
+        # matches entry B (the second, equal-length one) — the old
+        # list.remove(best) crashed comparing A == B
+        b2 = jnp.concatenate([b, prompt(4, seed=82)], axis=1)
+        out = await eng.generate(np.asarray(b2).reshape(-1), 2)
+        return out, eng.prefix_stats
+
+    out, stats = asyncio.run(run())
+    assert stats["auto_hits"] == 1
+    ref = generate(params,
+                   jnp.concatenate([prompt(32, seed=81),
+                                    prompt(4, seed=82)], axis=1), 2, cfg)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -260,6 +260,55 @@ class TestLoadHarness:
         finally:
             await runner.cleanup()
 
+    async def test_open_loop_poisson(self):
+        """Open-loop mode: arrivals at a fixed offered rate, achieved rate
+        tracks offered when under capacity, and the report carries the
+        open-loop fields."""
+        from seldon_core_tpu.tools.loadtest import run_open_loop
+
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        runner, port = await _start_rest(eng, component=False)
+        try:
+            c = Contract.from_dict(CONTRACT)
+            driver = RestDriver(
+                f"http://127.0.0.1:{port}",
+                c.rest_request(1, rng=np.random.default_rng(0)),
+            )
+            res = await run_open_loop(
+                driver, rate=200.0, seconds=1.0, warmup_s=0.2,
+                protocol="rest",
+            )
+            d = res.to_dict()
+            assert d["mode"] == "open-loop"
+            assert d["offered_rate"] == 200.0
+            assert d["dropped"] == 0
+            assert res.failures == 0
+            # achieved within 40% of offered (1-core scheduling noise)
+            assert 120 <= d["req_per_s"] <= 280, d["req_per_s"]
+        finally:
+            await runner.cleanup()
+
+    async def test_open_loop_overload_reports_drops(self):
+        """Offered load beyond capacity must surface as drops, not hang."""
+        import asyncio as _a
+
+        from seldon_core_tpu.tools.loadtest import run_open_loop
+
+        class Slow:
+            async def __aenter__(self):
+                return self
+
+            async def __aexit__(self, *exc):
+                pass
+
+            async def __call__(self):
+                await _a.sleep(0.5)
+
+        res = await run_open_loop(
+            Slow(), rate=300.0, seconds=1.0, warmup_s=0.1, max_inflight=20
+        )
+        assert res.extra["dropped"] > 0
+
     async def test_grpc_load(self):
         from seldon_core_tpu.serving.grpc_api import (
             GrpcServer,
