@@ -400,9 +400,13 @@ def main(argv: Optional[list] = None) -> None:
                     default=int(os.environ.get("GATEWAY_GRPC_PORT", "5000")))
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--firehose",
-                    choices=["none", "jsonl", "segmented", "memory"],
+                    choices=["none", "jsonl", "segmented", "memory",
+                             "network"],
                     default="none")
     ap.add_argument("--firehose-dir", default="./firehose")
+    ap.add_argument("--firehose-target", default="127.0.0.1:7788",
+                    help="broker host:port for --firehose network "
+                         "(gateway/firehose_net.py)")
     ap.add_argument("--token-spill", default="")
     args = ap.parse_args(argv)
 
@@ -410,7 +414,8 @@ def main(argv: Optional[list] = None) -> None:
     gw = Gateway(
         store,
         firehose=make_firehose(
-            args.firehose if args.firehose != "none" else "", args.firehose_dir
+            args.firehose if args.firehose != "none" else "",
+            args.firehose_dir, target=args.firehose_target,
         ),
         token_spill=args.token_spill or None,
     )

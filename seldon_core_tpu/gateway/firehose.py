@@ -204,11 +204,18 @@ class SegmentedFirehose:
         return out
 
 
-def make_firehose(kind: str = "", base_dir: Optional[str] = None):
+def make_firehose(kind: str = "", base_dir: Optional[str] = None,
+                  target: Optional[str] = None):
     if kind == "jsonl":
         return JsonlFirehose(base_dir or "./firehose")
     if kind == "segmented":
         return SegmentedFirehose(base_dir or "./firehose")
     if kind == "memory":
         return MemoryFirehose()
+    if kind == "network":
+        # shared broker for multi-gateway deployments
+        # (gateway/firehose_net.py; reference: Kafka producer → broker)
+        from seldon_core_tpu.gateway.firehose_net import NetworkFirehose
+
+        return NetworkFirehose(target or "127.0.0.1:7788")
     return NullFirehose()

@@ -5,6 +5,8 @@ Subcommands (reference counterparts in parens):
 - ``contract-test``  standalone component tester (``wrappers/testing/tester.py``)
 - ``api-test``       deployed-graph tester incl. OAuth (``util/api_tester/api-tester.py``)
 - ``load``           socket load harness (``util/loadtester`` locust scripts)
+- ``firehose-tail``  firehose consumer: replay/tail a client's topic by
+  offset (``kafka/tests/src/read_predictions.py``)
 """
 
 from __future__ import annotations
@@ -68,7 +70,57 @@ def main(argv=None) -> int:
                          "(latency at fixed offered load); 0 = closed-loop "
                          "with --concurrency workers")
 
+    ft = sub.add_parser(
+        "firehose-tail",
+        help="replay/tail a client's firehose topic from a broker",
+    )
+    ft.add_argument("client", help="client id (topic)")
+    ft.add_argument("--target", default="127.0.0.1:7788",
+                    help="broker host:port (gateway/firehose_net broker)")
+    ft.add_argument("--from-offset", type=int, default=0,
+                    help="resume offset (replay starts here)")
+    ft.add_argument("--max", type=int, default=1000,
+                    help="max records per poll")
+    ft.add_argument("-f", "--follow", action="store_true",
+                    help="keep polling for new records (tail -f)")
+    ft.add_argument("--poll-interval", type=float, default=1.0)
+    ft.add_argument("--token", default="", help="broker shared secret")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "firehose-tail":
+        import time as _time
+
+        from seldon_core_tpu.gateway.firehose_net import broker_read
+
+        offset = args.from_offset
+        while True:
+            try:
+                records = broker_read(
+                    args.target, args.client, from_offset=offset,
+                    max_records=args.max, token=args.token,
+                )
+            except (ConnectionError, OSError, RuntimeError) as e:
+                # --follow survives broker restarts (like the producer
+                # side); a one-shot read fails cleanly instead of
+                # tracebacking
+                if not args.follow:
+                    print(f"firehose-tail: broker unreachable: {e}",
+                          file=sys.stderr)
+                    return 1
+                print(f"firehose-tail: {e}; retrying", file=sys.stderr)
+                _time.sleep(args.poll_interval)
+                continue
+            for rec in records:
+                print(json.dumps(rec, separators=(",", ":")))
+                offset = rec["offset"] + 1
+            sys.stdout.flush()
+            if records:
+                continue  # drain until caught up before sleeping/exiting
+            if not args.follow:
+                return 0
+            _time.sleep(args.poll_interval)
+
     contract = Contract.load(args.contract)
 
     if args.cmd == "contract-test":
