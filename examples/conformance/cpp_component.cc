@@ -1,0 +1,164 @@
+// A Seldon component in plain C++ — no Python, no frameworks, no JSON lib.
+//
+// Serves the internal microservice REST contract
+// (docs/reference/internal-api.md analog):
+//   POST /predict        SeldonMessage JSON in -> SeldonMessage JSON out
+//   GET  /health/status  liveness
+//
+// Model: "doubler" — every number in data.ndarray is multiplied by 2,
+// structure preserved.  The transform is a character-level rewrite of the
+// ndarray substring (numbers re-emitted via strtod), so nested shapes pass
+// through untouched — the point is the WIRE, not the model.
+//
+// Build:  g++ -O2 -o cpp_component cpp_component.cc
+// Run:    ./cpp_component <port>
+//
+// Reference analog: the Java/R/NodeJS wrappers (wrappers/s2i/java/,
+// docs/wrappers/{r,nodejs}.md) — proof the contract is language-agnostic.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <strings.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <string>
+
+static bool recv_request(int fd, std::string *head, std::string *body) {
+  std::string buf;
+  char tmp[4096];
+  size_t hdr_end = std::string::npos;
+  while (hdr_end == std::string::npos) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) return false;
+    buf.append(tmp, n);
+    hdr_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20)) return false;
+  }
+  *head = buf.substr(0, hdr_end + 4);
+  std::string rest = buf.substr(hdr_end + 4);
+  size_t content_length = 0;
+  size_t cl = head->find("Content-Length:");
+  if (cl == std::string::npos) cl = head->find("content-length:");
+  if (cl != std::string::npos)
+    content_length = strtoul(head->c_str() + cl + 15, nullptr, 10);
+  while (rest.size() < content_length) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) return false;
+    rest.append(tmp, n);
+  }
+  *body = rest.substr(0, content_length);
+  return true;
+}
+
+static void send_response(int fd, int status, const std::string &body,
+                          const char *ctype = "application/json") {
+  char head[256];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
+                   status, status == 200 ? "OK" : "Error", ctype,
+                   body.size());
+  (void)!write(fd, head, n);
+  (void)!write(fd, body.data(), body.size());
+}
+
+// find the balanced [...] substring after "ndarray":
+static bool find_ndarray(const std::string &body, size_t *begin,
+                         size_t *end) {
+  size_t k = body.find("\"ndarray\"");
+  if (k == std::string::npos) return false;
+  size_t open = body.find('[', k);
+  if (open == std::string::npos) return false;
+  int depth = 0;
+  for (size_t i = open; i < body.size(); i++) {
+    if (body[i] == '[') depth++;
+    if (body[i] == ']' && --depth == 0) {
+      *begin = open;
+      *end = i + 1;
+      return true;
+    }
+  }
+  return false;
+}
+
+// rewrite every JSON number in src as 2*value, copying punctuation —
+// structure (nesting, commas) passes through verbatim
+static std::string double_numbers(const std::string &src) {
+  std::string out;
+  const char *p = src.c_str();
+  const char *stop = p + src.size();
+  while (p < stop) {
+    if ((*p >= '0' && *p <= '9') || *p == '-' ||
+        (*p == '+' && p + 1 < stop && p[1] >= '0' && p[1] <= '9')) {
+      char *next = nullptr;
+      double v = strtod(p, &next);
+      if (next != p) {
+        char num[64];
+        snprintf(num, sizeof(num), "%.12g", v * 2.0);
+        out += num;
+        p = next;
+        continue;
+      }
+    }
+    out += *p++;
+  }
+  return out;
+}
+
+int main(int argc, char **argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 9000;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) < 0 ||
+      listen(fd, 16) < 0) {
+    perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr *)&addr, &alen);
+  printf("cpp_component serving on 127.0.0.1:%d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  for (;;) {
+    int cfd = accept(fd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::string head, body;
+    while (recv_request(cfd, &head, &body)) {
+      if (head.rfind("GET /health/status", 0) == 0) {
+        send_response(cfd, 200, "ok", "text/plain");
+        continue;
+      }
+      if (head.rfind("POST /predict", 0) != 0) {
+        send_response(cfd, 404,
+                      "{\"status\":{\"code\":404,\"info\":\"no route\","
+                      "\"status\":\"FAILURE\"}}");
+        continue;
+      }
+      size_t b = 0, e = 0;
+      if (!find_ndarray(body, &b, &e)) {
+        send_response(cfd, 400,
+                      "{\"status\":{\"code\":400,\"info\":\"no ndarray\","
+                      "\"status\":\"FAILURE\"}}");
+        continue;
+      }
+      std::string doubled = double_numbers(body.substr(b, e - b));
+      std::string resp = "{\"data\":{\"names\":[],\"ndarray\":";
+      resp += doubled;
+      resp += "},\"meta\":{}}";
+      send_response(cfd, 200, resp);
+    }
+    close(cfd);
+  }
+}

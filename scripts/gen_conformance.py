@@ -1,0 +1,118 @@
+"""Generate the polyglot conformance kit (examples/conformance/).
+
+The reference supports non-Python components (Java/R/NodeJS wrappers,
+``wrappers/s2i/java/``, docs/wrappers/{r,nodejs}.md) because its internal
+microservice API is a language-agnostic wire contract
+(docs/reference/internal-api.md).  This repo's wire is equally agnostic;
+the conformance kit PROVES it with golden vectors: one canonical
+prediction request/response encoded on every wire tier —
+
+- ``rest_request.json`` / ``rest_response.json``  (REST JSON)
+- ``grpc_request.bin`` / ``grpc_response.bin``    (prediction.proto bytes)
+- ``framed_request.bin`` / ``framed_response.bin``(SELF framed bytes)
+
+plus ``README.md``.  tests/test_conformance.py drift-locks the checked-in
+bytes against this generator and asserts all three decode to the SAME
+canonical message, and a from-scratch C++ component
+(examples/conformance/cpp_component.cc) serves the REST contract with no
+Python in the loop.
+
+Run: ``python scripts/gen_conformance.py`` (rewrites examples/conformance/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "examples", "conformance")
+
+# THE canonical vector: a 2x2 f32 prediction with names + a response with
+# status/meta — values chosen to exercise sign, fraction, and exact floats
+REQUEST = {
+    "data": {"names": ["f0", "f1"], "ndarray": [[1.5, -2.0], [0.25, 4.0]]},
+}
+RESPONSE = {
+    "meta": {"puid": "conformance-0001", "tags": {}, "requestPath": {}},
+    "status": {"code": 200, "info": "", "reason": "", "status": "SUCCESS"},
+    "data": {"names": ["p0", "p1"], "ndarray": [[3.0, -4.0], [0.5, 8.0]]},
+}
+
+
+def main() -> None:
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.native import MSG_PREDICT, MSG_RESPONSE, FrameCodec
+    from seldon_core_tpu.proto.convert import message_to_proto
+    from seldon_core_tpu.serving.framed import encode_message
+
+    os.makedirs(OUT, exist_ok=True)
+
+    def write(name: str, data: bytes) -> None:
+        with open(os.path.join(OUT, name), "wb") as f:
+            f.write(data)
+        print(f"wrote {name} ({len(data)} bytes)")
+
+    # REST JSON: canonical separators + sorted keys so bytes are stable
+    write("rest_request.json",
+          json.dumps(REQUEST, sort_keys=True, indent=1).encode() + b"\n")
+    write("rest_response.json",
+          json.dumps(RESPONSE, sort_keys=True, indent=1).encode() + b"\n")
+
+    # prediction.proto bytes (wire-compatible with reference clients)
+    req_msg = SeldonMessage.from_dict(REQUEST)
+    resp_msg = SeldonMessage.from_dict(RESPONSE)
+    write("grpc_request.bin", message_to_proto(req_msg).SerializeToString())
+    write("grpc_response.bin", message_to_proto(resp_msg).SerializeToString())
+
+    # SELF framed bytes (zero-copy binary tier). float64 tensors: the JSON
+    # numbers are doubles; a fixed dtype keeps the bytes deterministic
+    codec = FrameCodec()
+
+    def as_f64(m: SeldonMessage) -> SeldonMessage:
+        m.data = np.asarray(m.data, np.float64)
+        return m
+
+    write("framed_request.bin",
+          encode_message(codec, as_f64(SeldonMessage.from_dict(REQUEST)),
+                         MSG_PREDICT))
+    write("framed_response.bin",
+          encode_message(codec, as_f64(SeldonMessage.from_dict(RESPONSE)),
+                         MSG_RESPONSE))
+
+    with open(os.path.join(OUT, "README.md"), "w") as f:
+        f.write(README)
+    print("wrote README.md")
+
+
+README = """\
+# Wire conformance kit
+
+One canonical prediction request/response, encoded on every wire tier the
+framework serves.  A component or client in ANY language is wire-compatible
+iff it produces/consumes these bytes:
+
+| File | Wire | Notes |
+|---|---|---|
+| rest_request.json / rest_response.json | REST JSON | the internal microservice API body (`POST /predict`) and external `/api/v0.1/predictions` |
+| grpc_request.bin / grpc_response.bin | protobuf | `SeldonMessage` of proto/prediction.proto (reference-wire-compatible) |
+| framed_request.bin / framed_response.bin | SELF framed | native/framing.cc binary tier (u32-LE length prefix added on the socket) |
+
+All six decode to the SAME canonical message (tests/test_conformance.py
+asserts the cross-wire equivalence and drift-locks these bytes against
+scripts/gen_conformance.py).
+
+`cpp_component.cc` is a from-scratch, dependency-free C++ component that
+serves the REST contract (`POST /predict`, `GET /health/status`) — built
+and driven through the engine + contract tester in the same test file, the
+proof that nothing about a component requires Python.  Reference analog:
+the Java/R/NodeJS wrappers (`wrappers/s2i/java/`, docs/wrappers/).
+"""
+
+
+if __name__ == "__main__":
+    main()

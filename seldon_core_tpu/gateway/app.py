@@ -68,6 +68,13 @@ class Gateway:
         for ch in self._grpc_channels.values():
             await ch.close()
         self._grpc_channels.clear()
+        # drain the firehose sink (NetworkFirehose buffers + batches;
+        # records still queued at shutdown would otherwise vanish)
+        closer = getattr(self.firehose, "close", None)
+        if callable(closer):
+            import asyncio as _a
+
+            await _a.get_running_loop().run_in_executor(None, closer)
 
     # ------------------------------------------------------------------
     # REST app
@@ -434,7 +441,13 @@ def main(argv: Optional[list] = None) -> None:
             print(f"gateway gRPC on {args.host}:{gserver.port}", flush=True)
         print(f"gateway REST on {args.host}:{args.port} "
               f"({len(store.names())} deployments)", flush=True)
-        await gw.watch_loop()
+        try:
+            await gw.watch_loop()
+        finally:
+            # SIGINT/SIGTERM path: drain the firehose sink + close pools
+            # (a buffered NetworkFirehose batch would otherwise vanish on
+            # every rolling restart)
+            await gw.close()
 
     asyncio.run(serve())
 

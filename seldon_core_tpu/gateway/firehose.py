@@ -143,7 +143,12 @@ class SegmentedFirehose:
         return next_off, last, good_bytes
 
     # -- sink protocol --------------------------------------------------
-    def publish(self, client_id: str, request: dict, response: dict) -> None:
+    def publish(self, client_id: str, request: dict, response: dict,
+                ts: Optional[float] = None) -> None:
+        """``ts``: producer-side timestamp — the network broker passes the
+        GATEWAY'S stamp through so backlog drained after an outage keeps
+        request time (and at-least-once duplicates keep an identical
+        (client, ts) dedupe key); None stamps now (in-process sinks)."""
         with self._lock:
             state = self._state.get(client_id)
             if state is None:
@@ -154,7 +159,7 @@ class SegmentedFirehose:
                 size = 0
                 self._gc(client_id)
             line = json.dumps(
-                {"offset": off, "ts": time.time(),
+                {"offset": off, "ts": time.time() if ts is None else ts,
                  "request": request, "response": response},
                 separators=(",", ":"),
             ) + "\n"

@@ -93,6 +93,7 @@ class FirehoseBroker:
                     self.log.publish(
                         rec.get("client", "unknown"),
                         rec.get("request", {}), rec.get("response", {}),
+                        ts=rec.get("ts"),  # producer stamp passes through
                     )
                     n += 1
                 out = {"acked": n}
@@ -272,8 +273,10 @@ class NetworkFirehose:
             while batch:
                 try:
                     if conn is None:
+                        # short connect timeout: a blackholed broker must
+                        # not pin the thread past close()'s join window
                         conn = _BrokerConn(self.host, self.port,
-                                           token=self.token)
+                                           timeout=2.0, token=self.token)
                     conn.request({"op": "publish_batch", "records": batch})
                     self.sent += len(batch)
                     self._settle(len(batch))

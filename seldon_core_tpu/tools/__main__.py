@@ -100,7 +100,12 @@ def main(argv=None) -> int:
                     args.target, args.client, from_offset=offset,
                     max_records=args.max, token=args.token,
                 )
-            except (ConnectionError, OSError, RuntimeError) as e:
+            except RuntimeError as e:
+                # broker-side errors (unauthorized, unknown op) can never
+                # succeed on retry — exit non-zero even under --follow
+                print(f"firehose-tail: {e}", file=sys.stderr)
+                return 1
+            except (ConnectionError, OSError) as e:
                 # --follow survives broker restarts (like the producer
                 # side); a one-shot read fails cleanly instead of
                 # tracebacking

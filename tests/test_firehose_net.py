@@ -203,3 +203,51 @@ class TestNetworkFirehose:
             finally:
                 sink.close()
             assert len(broker_read(target, "c")) == 1
+
+    def test_producer_timestamp_passes_through(self, tmp_path):
+        """The broker must keep the GATEWAY's ts (at-least-once dedupe key
+        + honest request time for backlog drained after an outage)."""
+        from seldon_core_tpu.gateway.firehose_net import (
+            FirehoseBroker,
+            NetworkFirehose,
+            broker_read,
+        )
+
+        with FirehoseBroker(str(tmp_path)) as broker:
+            target = f"127.0.0.1:{broker.port}"
+            gw = NetworkFirehose(target, max_delay_s=0.05)
+            try:
+                t_before = time.time()
+                gw.publish("c", *_rec(1, "gw"))
+                assert gw.flush(10)
+            finally:
+                gw.close()
+            rec = broker_read(target, "c")[0]
+            # stamped at publish() on the producer, within a tight window
+            assert abs(rec["ts"] - t_before) < 2.0
+
+    def test_gateway_close_drains_network_sink(self, tmp_path):
+        """Gateway.close() must flush+close a NetworkFirehose so rolling
+        restarts don't drop the buffered batch."""
+        import asyncio
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.firehose_net import (
+            FirehoseBroker,
+            NetworkFirehose,
+            broker_read,
+        )
+        from seldon_core_tpu.gateway.store import DeploymentStore
+
+        with FirehoseBroker(str(tmp_path / "log")) as broker:
+            target = f"127.0.0.1:{broker.port}"
+            sink = NetworkFirehose(target, max_delay_s=5.0)  # long batch
+            gw = Gateway(DeploymentStore(None), firehose=sink)
+
+            async def run():
+                sink.publish("c", *_rec(1, "gw"))
+                await gw.close()  # must drain despite the 5s batch delay
+
+            asyncio.run(run())
+            assert not sink._thread.is_alive()
+            assert len(broker_read(target, "c")) == 1
