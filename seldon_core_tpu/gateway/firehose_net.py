@@ -143,15 +143,19 @@ class _BrokerConn:
     over serving/framed.py's blocking FramedClient (ONE implementation of
     the wire framing; ``ping_raw`` is the raw round-trip)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 token: str = ""):
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
+                 io_timeout: float = 30.0, token: str = ""):
         from seldon_core_tpu.native import MSG_PREDICT, FrameCodec
         from seldon_core_tpu.serving.framed import FramedClient
 
         self._codec = FrameCodec()
         self._msg = MSG_PREDICT
         self._token = token
-        self._client = FramedClient(host, port, timeout=timeout)
+        # connect bounded tightly (a blackholed broker must not pin the
+        # producer thread); per-op I/O gets its own, longer budget — a big
+        # batch the broker takes seconds to append is NOT a failure
+        self._client = FramedClient(host, port, timeout=connect_timeout)
+        self._client._sock.settimeout(io_timeout)
 
     def request(self, op: dict) -> dict:
         if self._token:
@@ -253,16 +257,19 @@ class NetworkFirehose:
         conn: Optional[_BrokerConn] = None
         batch: list = []
         while True:
-            # gather a batch (bounded wait so flush/stop stay responsive)
+            # gather a batch; waits are CHUNKED (<=0.25s) so stop/close are
+            # noticed promptly even under a long max_delay_s
             deadline = time.monotonic() + self.max_delay_s
             while len(batch) < self.max_batch:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
                     break
                 try:
-                    batch.append(self._q.get(timeout=timeout))
+                    batch.append(
+                        self._q.get(timeout=min(remaining, 0.25))
+                    )
                 except queue.Empty:
-                    break
+                    continue
             if not batch:
                 if self._stop.is_set() and self._q.empty():
                     break
@@ -273,10 +280,9 @@ class NetworkFirehose:
             while batch:
                 try:
                     if conn is None:
-                        # short connect timeout: a blackholed broker must
-                        # not pin the thread past close()'s join window
                         conn = _BrokerConn(self.host, self.port,
-                                           timeout=2.0, token=self.token)
+                                           connect_timeout=2.0,
+                                           token=self.token)
                     conn.request({"op": "publish_batch", "records": batch})
                     self.sent += len(batch)
                     self._settle(len(batch))

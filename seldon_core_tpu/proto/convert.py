@@ -185,9 +185,13 @@ def message_to_proto(
     device_refs: bool = False,
 ) -> pb.SeldonMessage:
     """``device_refs=True`` encodes device-resident payloads as
-    ``DeviceTensorRef`` HBM handles instead of bytes — ONLY for proto hops
+    ``DeviceTensorRef`` handles instead of bytes — ONLY for proto hops
     between co-scheduled endpoints in the same process (in-process gRPC /
-    framed loopback); the registry rejects refs from other processes.  The
+    framed loopback); the registry rejects refs from other processes.
+    ``device_refs="shm"`` exports through POSIX shared memory instead:
+    ANY process on the same host resolves it (split pods on one TPU VM) —
+    the payload never rides the socket or the protobuf, at the cost of the
+    D2H+H2D staging hop (PJRT exposes no cross-process HBM handles).  The
     default downgrades to binTensor, which is always transport-safe."""
     p = out if out is not None else pb.SeldonMessage()
     if msg.status is not None:
@@ -195,14 +199,21 @@ def message_to_proto(
     md = msg.meta
     if md.puid or md.tags or md.routing or md.request_path or md.metrics:
         _meta_to_proto(md, p.meta)
-    if msg.data is not None and device_refs and _is_device_array(msg.data):
+    if msg.data is not None and device_refs and (
+        _is_device_array(msg.data) or device_refs == "shm"
+    ):
         from seldon_core_tpu.runtime.device_registry import registry
 
         arr = msg.data
         p.data.names.extend(msg.names)
-        p.data.device.buffer_uuid = registry.put(arr)
-        p.data.device.dtype = str(arr.dtype)
-        p.data.device.shape.extend(int(s) for s in arr.shape)
+        if device_refs == "shm":
+            p.data.device.buffer_uuid = registry.put_shm(arr)
+        else:
+            p.data.device.buffer_uuid = registry.put(arr)
+        p.data.device.dtype = str(getattr(arr, "dtype", ""))
+        p.data.device.shape.extend(
+            int(s) for s in getattr(arr, "shape", ())
+        )
         sharding = getattr(arr, "sharding", None)
         p.data.device.sharding = str(sharding) if sharding is not None else ""
         return p

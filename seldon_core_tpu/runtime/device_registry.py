@@ -21,6 +21,15 @@ Semantics:
   wire default is always safe.
 - Entries are one-shot by default (``resolve`` consumes), with a bounded
   capacity so a producer whose consumer died cannot leak HBM.
+
+Cross-process, same host (split pods co-scheduled on one TPU VM): PJRT
+exposes no cross-process HBM handles, so a true device-to-device handoff
+is impossible — but the transport can still skip serialization entirely.
+``put_shm`` stages the tensor into POSIX shared memory (one D2H) and
+returns an ``shm:`` ref any process on the host resolves with ONE H2D
+straight out of the mapping (no protobuf byte copy, no socket payload, no
+intermediate host copy).  Consumption unlinks the segment; producer-side
+reaping bounds leaks when a consumer dies.
 """
 
 from __future__ import annotations
@@ -56,6 +65,108 @@ class DeviceBufferRegistry:
         self.ttl_s = ttl_s
         self._entries: "OrderedDict[str, tuple[Any, float]]" = OrderedDict()
         self._lock = threading.Lock()
+        self._shm_exports: "OrderedDict[str, float]" = OrderedDict()
+
+    # -- cross-process (same host): POSIX shared-memory staging ---------
+    def put_shm(self, array: Any) -> str:
+        """Export ``array`` for ANOTHER process on this host: one D2H into
+        a fresh shm segment; returns ``shm:<name>:<dtype>:<shape>``.  The
+        consumer's :meth:`resolve` unlinks the segment (one-shot)."""
+        import numpy as np
+        from multiprocessing import shared_memory
+
+        host = np.asarray(array)  # D2H (the only device hop on this side)
+        if host.dtype == object:
+            raise ValueError(
+                "shm DeviceTensorRef requires a numeric tensor (got object "
+                "dtype; ragged/str payloads must use the byte codecs)"
+            )
+        name = f"seldon_dtr_{uuid.uuid4().hex[:16]}"
+        shm = shared_memory.SharedMemory(
+            create=True, size=max(host.nbytes, 1), name=name
+        )
+        try:
+            view = np.ndarray(host.shape, host.dtype, buffer=shm.buf)
+            view[...] = host
+        except BaseException:
+            # a failed staging copy must not leak the fresh segment
+            shm.close()
+            shm.unlink()
+            raise
+        else:
+            shm.close()  # detach; the segment lives until unlink
+        now = time.monotonic()
+        with self._lock:
+            self._shm_exports[name] = now
+            self._reap_shm(now)
+        shape = ",".join(str(s) for s in host.shape)
+        return f"shm:{name}:{host.dtype.name}:{shape}"
+
+    def _reap_shm(self, now: float) -> None:
+        """Unlink exports whose consumer never came (holding _lock)."""
+        from multiprocessing import shared_memory
+
+        while self._shm_exports:
+            name, t = next(iter(self._shm_exports.items()))
+            if now - t <= self.ttl_s and len(self._shm_exports) <= self.capacity:
+                break
+            self._shm_exports.popitem(last=False)
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass  # consumed
+
+    @staticmethod
+    def _resolve_shm(ref: str) -> Any:
+        """Attach a same-host shm export, H2D straight from the mapping,
+        unlink.  Works from ANY process on the host (that is the point)."""
+        import numpy as np
+        from multiprocessing import shared_memory
+
+        try:
+            _, name, dtype_name, shape_csv = ref.split(":", 3)
+        except ValueError:
+            raise ValueError(f"malformed shm ref {ref!r}")
+        shape = tuple(int(s) for s in shape_csv.split(",")) if shape_csv \
+            else ()
+        try:
+            dtype = np.dtype(dtype_name)
+        except TypeError:
+            # ml_dtypes families (bfloat16, float8_*, int4, ...) are not in
+            # numpy's registry by name
+            import ml_dtypes
+
+            dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            raise KeyError(
+                f"shm DeviceTensorRef {name!r} not found (already consumed, "
+                "reaped, or producer on a different host)"
+            )
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            view = np.ndarray(shape, dtype, buffer=shm.buf)
+            if jax.default_backend() == "cpu":
+                # CPU backend may ALIAS the numpy buffer zero-copy; the
+                # unlink below would unmap it under the live array
+                out = jnp.asarray(np.array(view))
+            else:
+                out = jnp.asarray(view)  # H2D directly from the mapping
+                # the H2D copy is ASYNC and PJRT holds the host buffer by
+                # reference only — it must complete before the munmap below
+                jax.block_until_ready(out)
+        finally:
+            shm.close()
+            try:
+                shm.unlink()  # one-shot consume
+            except FileNotFoundError:
+                pass
+        return out
 
     def put(self, array: Any) -> str:
         """Register ``array``; returns the ref string for the wire."""
@@ -74,6 +185,13 @@ class DeviceBufferRegistry:
         return f"{process_token()}/{key}"
 
     def resolve(self, ref: str, consume: bool = True) -> Any:
+        if ref.startswith("shm:"):
+            if not consume:
+                raise ValueError(
+                    "shm DeviceTensorRefs are one-shot (resolution unlinks "
+                    "the segment); consume=False cannot be honored"
+                )
+            return self._resolve_shm(ref)
         token, _, key = ref.partition("/")
         if token != process_token():
             raise ForeignProcessRef(

@@ -154,13 +154,15 @@ class _ComponentRpc:
         return message_to_proto(out)
 
 
-def _device_refs_enabled() -> bool:
-    """Process-wide DeviceTensorRef opt-in (env SELDON_DEVICE_REFS=1): only
-    for in-process loopback serving — the receiving registry rejects refs
-    from any other process (runtime/device_registry.py)."""
+def _device_refs_enabled():
+    """Process-wide DeviceTensorRef opt-in (env SELDON_DEVICE_REFS):
+    ``1`` = in-process refs (loopback serving only — the receiving registry
+    rejects refs from any other process); ``shm`` = same-host shared-memory
+    staging (split pods on one TPU VM; runtime/device_registry.py)."""
     import os
 
-    return os.environ.get("SELDON_DEVICE_REFS", "") == "1"
+    v = os.environ.get("SELDON_DEVICE_REFS", "")
+    return "shm" if v == "shm" else v == "1"
 
 
 def _unary_handler(rpc: Any, method: str, req_cls, resp_cls):
@@ -399,16 +401,14 @@ class GrpcComponentClient:
             "stream",
         }
         self.timeout = timeout_s
-        # DeviceTensorRef on the request payload: zero-copy HBM handoff when
-        # client and server are co-scheduled in ONE process (the server-side
-        # registry rejects refs from any other process, so this must only be
-        # enabled for true in-process loopback).  Default from env
-        # SELDON_DEVICE_REFS=1 so colocated embedders can switch it on
-        # without code changes.
+        # DeviceTensorRef on the request payload: zero-copy HBM handoff
+        # when client and server are co-scheduled in ONE process, or
+        # shared-memory staging for same-host split pods
+        # (device_refs="shm").  Default from env SELDON_DEVICE_REFS
+        # ("1" | "shm") so colocated deployments switch it on without code
+        # changes.
         if device_refs is None:
-            import os
-
-            device_refs = os.environ.get("SELDON_DEVICE_REFS", "") == "1"
+            device_refs = _device_refs_enabled()
         self.device_refs = device_refs
 
     def _encode(self, msg: SeldonMessage):
