@@ -51,9 +51,26 @@ CHIPS_PER_HOST = 8
 KNOWN_TOPOLOGIES = {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8", 64: "8x8"}
 
 
+def _int_ann(ann: dict, key: str, default: int) -> int:
+    raw = ann.get(key, str(default)) or default
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+        raise DeploymentValidationError(
+            f"annotation {key} must be an integer, got {raw!r}"
+        )
+
+
+def _native_wire(dep: SeldonDeployment, p: PredictorSpec) -> bool:
+    ann = {**dep.annotations, **p.annotations}
+    return ann.get("seldon.io/native-wire", "").lower() == "true"
+
+
 def tpu_chips_for(p: PredictorSpec, dep: SeldonDeployment) -> int:
     ann = {**dep.annotations, **p.annotations}
-    return int(ann.get("seldon.io/tpu-chips", "0") or 0)
+    return _int_ann(ann, "seldon.io/tpu-chips", 0)
 
 
 def tpu_topology_for(chips: int, p: PredictorSpec, dep: SeldonDeployment) -> str:
@@ -94,11 +111,20 @@ def service_name(dep: SeldonDeployment, p: PredictorSpec, unit: str) -> str:
     return f"{dep.name}-{p.name}-{unit}"
 
 
+NATIVE_PORT = 8500       # C++ REST tier (seldon.io/native-wire)
+NATIVE_GRPC_PORT = 5500  # C++ h2c gRPC tier
+
+
 def _engine_env(dep: SeldonDeployment, p: PredictorSpec) -> list[dict]:
     """Graph spec handed to the engine pod as base64 JSON — parity with the
-    reference's ``ENGINE_PREDICTOR`` env (``createEngineContainer:119``)."""
+    reference's ``ENGINE_PREDICTOR`` env (``createEngineContainer:119``).
+    Annotations map to the local-runner flags: ``seldon.io/native-wire``
+    ("true" → serve the C++ REST/gRPC tiers on NATIVE_PORT/NATIVE_GRPC_PORT
+    beside the Python ones) and ``seldon.io/engine-workers`` (N →
+    SO_REUSEPORT worker processes, serving/workers.py)."""
     pred_json = json.dumps(p.to_dict())
-    return [
+    ann = {**dep.annotations, **p.annotations}
+    env = [
         {"name": "ENGINE_PREDICTOR", "value": base64.b64encode(
             pred_json.encode()).decode()},
         {"name": "SELDON_DEPLOYMENT_ID", "value": dep.name},
@@ -106,6 +132,15 @@ def _engine_env(dep: SeldonDeployment, p: PredictorSpec) -> list[dict]:
         {"name": "ENGINE_SERVER_PORT", "value": str(ENGINE_PORT)},
         {"name": "ENGINE_SERVER_GRPC_PORT", "value": str(GRPC_PORT)},
     ]
+    if ann.get("seldon.io/native-wire", "").lower() == "true":
+        env.append({"name": "ENGINE_NATIVE_PORT",
+                    "value": str(NATIVE_PORT)})
+        env.append({"name": "ENGINE_NATIVE_GRPC_PORT",
+                    "value": str(NATIVE_GRPC_PORT)})
+    workers = _int_ann(ann, "seldon.io/engine-workers", 1)
+    if workers > 1:
+        env.append({"name": "ENGINE_WORKERS", "value": str(workers)})
+    return env
 
 
 def _probes() -> dict:
@@ -189,6 +224,12 @@ def _colocated_predictor(
         ],
         **_probes(),
     }
+    if _native_wire(dep, p):
+        # expose the C++ tiers so the Service can map them in-cluster
+        container["ports"].extend([
+            {"containerPort": NATIVE_PORT, "name": "http-native"},
+            {"containerPort": NATIVE_GRPC_PORT, "name": "grpc-native"},
+        ])
     pod_spec: dict[str, Any] = {"containers": [container]}
     # merge user componentSpecs (images for user-code components)
     for cs in p.component_specs:
@@ -444,6 +485,11 @@ def _deployment_service(dep: SeldonDeployment) -> dict:
             "ports": [
                 {"port": ENGINE_PORT, "targetPort": ENGINE_PORT, "name": "http"},
                 {"port": GRPC_PORT, "targetPort": GRPC_PORT, "name": "grpc"},
-            ],
+            ] + ([
+                {"port": NATIVE_PORT, "targetPort": NATIVE_PORT,
+                 "name": "http-native"},
+                {"port": NATIVE_GRPC_PORT, "targetPort": NATIVE_GRPC_PORT,
+                 "name": "grpc-native"},
+            ] if any(_native_wire(dep, p) for p in dep.predictors) else []),
         },
     }

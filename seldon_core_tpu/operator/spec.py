@@ -87,7 +87,11 @@ class SeldonDeployment:
             predictors=[PredictorSpec.from_dict(p) for p in spec.get("predictors", [])],
             oauth_key=spec.get("oauth_key", ""),
             oauth_secret=spec.get("oauth_secret", ""),
-            annotations=dict(spec.get("annotations", {})),
+            # metadata + spec annotations merge (spec wins) — users put
+            # seldon.io/* on either (the examples use metadata; the
+            # reference reads both)
+            annotations={**meta.get("annotations", {}),
+                         **spec.get("annotations", {})},
             labels=dict(meta.get("labels", {})),
             namespace=meta.get("namespace", "default"),
         )

@@ -320,7 +320,8 @@ class LLMEngine:
         self._auto_gran = max(int(auto_prefix_granularity), 1)
         self._auto_entries: list[dict] = []  # LRU order, oldest first
         self.prefix_stats = {"auto_hits": 0, "auto_tokens_reused": 0,
-                             "auto_stored": 0, "auto_evicted": 0}
+                             "auto_stored": 0, "auto_evicted": 0,
+                             "auto_admissions": 0}
 
     def _init_cache(self, cache_len: int):
         return init_cache(self.cfg, self.max_slots, max_len=cache_len,
@@ -687,6 +688,7 @@ class LLMEngine:
                 # usable length (registered whole-prompt hits also carry
                 # logits, so prefer them at equal length); stats/LRU
                 # update only when the auto match actually WINS
+                self.prefix_stats["auto_admissions"] += 1
                 auto = self._match_auto(host_ids, L0)
                 if auto is not None and (
                     pref is None or auto["len"] > pref["len"]
@@ -1218,5 +1220,21 @@ class LLMComponent:
             out.append(
                 Metric("seldon_llm_spec_accept_rate", MetricType.GAUGE,
                        st["accepted"] / st["drafted"])
+            )
+        ps = getattr(self.engine, "prefix_stats", None)
+        if ps and ps.get("auto_admissions"):
+            # hit rate over admissions where auto matching was consulted
+            # (an admission can both hit a shorter prefix AND store its
+            # longer prompt, so hits+stores would double-count)
+            out.append(
+                Metric("seldon_llm_prefix_hit_rate", MetricType.GAUGE,
+                       ps["auto_hits"] / ps["auto_admissions"])
+            )
+        free = getattr(self.engine, "free_pages", None)
+        if free is not None:
+            total = self.engine.paged_cfg.n_pages - 1
+            out.append(
+                Metric("seldon_llm_kv_pages_used_ratio", MetricType.GAUGE,
+                       (total - free) / max(total, 1))
             )
         return out

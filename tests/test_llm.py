@@ -1612,3 +1612,29 @@ def test_auto_prefix_lru_touch_with_equal_length_entries():
                    jnp.concatenate([prompt(32, seed=81),
                                     prompt(4, seed=82)], axis=1), 2, cfg)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_component_metrics_include_prefix_and_paged_stats():
+    """Serving observability: the component's per-request metrics carry
+    auto-prefix hit rate and paged-KV occupancy when those engines run."""
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.models.llm_demo import DemoLLM
+
+    comp = DemoLLM(d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+                   vocab_size=64, max_seq=64, paged_pages=17, page_size=4,
+                   auto_prefix_tokens=256)
+
+    async def run():
+        m1 = await comp.predict(SeldonMessage(
+            json_data={"prompt_ids": list(range(1, 20)), "n_new": 2}))
+        m2 = await comp.predict(SeldonMessage(
+            json_data={"prompt_ids": list(range(1, 20)) + [33], "n_new": 2}))
+        return m1, m2
+
+    m1, m2 = asyncio.run(run())
+    names2 = {m.key for m in m2.meta.metrics}
+    assert "seldon_llm_kv_pages_used_ratio" in names2
+    assert "seldon_llm_prefix_hit_rate" in names2
+    hit = [m for m in m2.meta.metrics
+           if m.key == "seldon_llm_prefix_hit_rate"][0]
+    assert hit.value > 0  # second request hit the first's prefix

@@ -335,3 +335,62 @@ def test_service_type_refinement_reaches_container_env():
     }
     assert envs["outlier-detector"]["SERVICE_TYPE"] == "OUTLIER_DETECTOR"
     assert envs["classifier"]["SERVICE_TYPE"] == "MODEL"
+
+
+def test_native_wire_and_workers_annotations():
+    """seldon.io/native-wire + seldon.io/engine-workers map to the
+    local-runner env contract (ENGINE_NATIVE_PORT / ENGINE_WORKERS)."""
+    from seldon_core_tpu.operator.compile import compile_deployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    dep = SeldonDeployment.from_dict({
+        "metadata": {"name": "d", "annotations": {
+            "seldon.io/native-wire": "true",
+            "seldon.io/engine-workers": "4",
+        }},
+        "spec": {"name": "d", "predictors": [
+            {"name": "p", "graph": {"name": "m",
+                                    "implementation": "SIMPLE_MODEL"}}
+        ]},
+    })
+    manifests = compile_deployment(dep)
+    deploys = [m for m in manifests if m["kind"] == "Deployment"]
+    env = {e["name"]: e.get("value") for e in
+           deploys[0]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["ENGINE_NATIVE_PORT"] == "8500"
+    assert env["ENGINE_NATIVE_GRPC_PORT"] == "5500"
+    assert env["ENGINE_WORKERS"] == "4"
+    # the tiers must be REACHABLE: container ports + Service mappings
+    cports = {p["containerPort"] for p in
+              deploys[0]["spec"]["template"]["spec"]["containers"][0]["ports"]}
+    assert {8500, 5500} <= cports
+    svc = [m for m in manifests if m["kind"] == "Service"][-1]
+    sports = {p["port"] for p in svc["spec"]["ports"]}
+    assert {8500, 5500} <= sports
+    # a non-integer workers annotation is a VALIDATION error, not a crash
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+    bad = SeldonDeployment.from_dict({
+        "metadata": {"name": "b", "annotations": {
+            "seldon.io/engine-workers": "auto"}},
+        "spec": {"name": "b", "predictors": [
+            {"name": "p", "graph": {"name": "m",
+                                    "implementation": "SIMPLE_MODEL"}}
+        ]},
+    })
+    with pytest.raises(DeploymentValidationError, match="engine-workers"):
+        compile_deployment(bad)
+
+    # without the annotations: neither knob appears
+    dep2 = SeldonDeployment.from_dict({
+        "metadata": {"name": "d2"},
+        "spec": {"name": "d2", "predictors": [
+            {"name": "p", "graph": {"name": "m",
+                                    "implementation": "SIMPLE_MODEL"}}
+        ]},
+    })
+    deploys2 = [m for m in compile_deployment(dep2)
+                if m["kind"] == "Deployment"]
+    env2 = {e["name"] for e in
+            deploys2[0]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "ENGINE_NATIVE_PORT" not in env2
+    assert "ENGINE_WORKERS" not in env2
