@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any, Awaitable, Callable, Optional
 
 from seldon_core_tpu.messages import Feedback, SeldonMessage, Status
@@ -70,13 +71,24 @@ class _AsyncBridge:
         self._loop.call_soon_threadsafe(self._spawn, token, method, path, body)
 
     def _spawn(self, token: int, method: str, path: str, body: bytes) -> None:
-        t = self._loop.create_task(self._run(token, method, path, body))
-        self._tasks.add(t)
-        t.add_done_callback(self._tasks.discard)
+        # EAGER task start (3.12 stdlib): the handler runs synchronously
+        # inside real task context until its first true suspension, so
+        # non-suspending handlers (in-process engines) skip the
+        # schedule/wakeup round trip.  A hand-rolled inline coro.send
+        # fast path measured ~+27% gRPC throughput but breaks
+        # current_task()-dependent handler code (asyncio.timeout /
+        # wait_for raise outside a task on 3.12) — eager tasks keep the
+        # semantics; the measured win is within run-to-run noise, the
+        # Task allocation dominating what remains.
+        t = asyncio.Task(
+            self._run(token, method, path, body),
+            loop=self._loop, eager_start=True,
+        )
+        if not t.done():
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
 
     async def _run(self, token, method, path, body) -> None:
-        import time
-
         t0 = time.perf_counter()
         try:
             status, out, msg = await self._router(method, path, body)

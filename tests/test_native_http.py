@@ -208,6 +208,57 @@ class TestNativeGrpcServer:
         asyncio.run(run())
 
 
+class TestBridgeSuspension:
+    """The bridge's inline fast path must coexist with handlers that
+    GENUINELY suspend (await pending futures) — the _resume trampoline
+    path — including exceptions raised after the suspension."""
+
+    def test_suspending_and_failing_handlers(self):
+        import aiohttp
+
+        class SlowEngine:
+            async def predict(self, msg):
+                await asyncio.sleep(0.05)  # real suspension -> _resume path
+                import numpy as np
+
+                d = np.asarray(msg.host_data())
+                if float(d.ravel()[0]) < 0:
+                    raise RuntimeError("negative after suspend")
+                return SeldonMessage(data=d + 1)
+
+            async def send_feedback(self, fb):
+                return SeldonMessage()
+
+        async def run():
+            srv = NativeRestServer(engine=SlowEngine(), bind="127.0.0.1")
+            port = await srv.start()
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # concurrent suspending requests interleave correctly
+                    async def one(v):
+                        async with s.post(
+                            f"{base}/api/v0.1/predictions",
+                            json={"data": {"ndarray": [[v]]}},
+                        ) as r:
+                            return r.status, await r.json()
+
+                    results = await asyncio.gather(
+                        one(1.0), one(2.0), one(-1.0)
+                    )
+            finally:
+                await srv.stop()
+            return results
+
+        results = asyncio.run(run())
+        ok = {r[1]["data"]["ndarray"][0][0]
+              for r in results if r[0] == 200 and "data" in r[1]}
+        assert ok == {2.0, 3.0}
+        errs = [r for r in results if r[0] == 500]
+        assert len(errs) == 1
+        assert "negative after suspend" in errs[0][1]["status"]["info"]
+
+
 class TestNativeRestServer:
     def test_aiohttp_client_roundtrip(self):
         import aiohttp
