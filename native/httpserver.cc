@@ -53,8 +53,25 @@ namespace {
 
 using namespace snh2;
 
-constexpr size_t kMaxBody = 256u << 20;    /* request body cap */
+constexpr size_t kMaxBody = 256u << 20;    /* h1 request body cap */
 constexpr size_t kMaxBuffered = 64u << 20; /* per-conn response backlog cap */
+/* h2 per-stream body cap.  Unlike h1 (body consumed as one request), an h2
+ * stream's body is held until END_STREAM; it must stay well below the
+ * read-pause budgets or a single stream could wedge the connection. */
+constexpr size_t kMaxStreamBody = 32u << 20;
+/* un-dispatched (no END_STREAM yet) request-body budget per conn.  Must be
+ * strictly below kMaxBuffered: read_paused() trips at kMaxBuffered, so if
+ * un-dispatched bodies alone could reach it the conn would wedge — paused
+ * reads mean END_STREAM can never arrive and nothing ever drains.  Keeping
+ * this below guarantees any pause includes dispatched bodies, which free on
+ * response. */
+constexpr size_t kMaxUndispatched = 48u << 20;
+/* h2 header-block cap, matching the h1 64 KiB header-flood limit: without
+ * it a client can grow header_block without bound via CONTINUATION frames */
+constexpr size_t kMaxHeaderBlock = 64u << 10;
+/* advertised AND enforced MAX_CONCURRENT_STREAMS: bounds H2Stream objects
+ * a client can accumulate with HEADERS-only (no END_STREAM) streams */
+constexpr size_t kMaxLiveStreams = 1024;
 constexpr size_t kMaxPipeline = 1u << 20;  /* h1 read-ahead while in flight */
 constexpr uint32_t kOurMaxFrame = 1u << 20;
 constexpr int32_t kOurInitialWindow = 1 << 20;
@@ -93,6 +110,7 @@ struct Conn {
   bool preface_done = false;
   snhpack::Decoder hpack;
   size_t buffered_bodies = 0; /* un-responded request-body bytes, all streams */
+  size_t undispatched_bodies = 0; /* subset owned by not-yet-dispatched streams */
   std::unordered_map<int32_t, H2Stream> streams;
   int64_t send_window = 65535; /* connection-level, their receive budget */
   int64_t peer_initial_window = 65535;
@@ -199,6 +217,9 @@ void erase_stream(Conn *c, int32_t id) {
   if (it == c->streams.end()) return;
   size_t b = it->second.body.size();
   c->buffered_bodies -= b > c->buffered_bodies ? c->buffered_bodies : b;
+  if (!it->second.dispatched)
+    c->undispatched_bodies -=
+        b > c->undispatched_bodies ? c->undispatched_bodies : b;
   c->streams.erase(it);
 }
 
@@ -211,7 +232,7 @@ void emit_settings(std::string *out) {
     payload.push_back((char)id);
     put_u32(&payload, v);
   };
-  setting(3, 1u << 20);                    /* MAX_CONCURRENT_STREAMS */
+  setting(3, (uint32_t)kMaxLiveStreams);   /* MAX_CONCURRENT_STREAMS */
   setting(4, (uint32_t)kOurInitialWindow); /* INITIAL_WINDOW_SIZE */
   setting(5, kOurMaxFrame);                /* MAX_FRAME_SIZE */
   frame_header(out, payload.size(), F_SETTINGS, 0, 0);
@@ -416,6 +437,9 @@ void dispatch_h1(sn_http_server *s, Conn *c, const std::string &method,
 void dispatch_h2(sn_http_server *s, Conn *c, int32_t id, H2Stream *st) {
   s->n_requests++;
   st->dispatched = true;
+  size_t b = st->body.size();
+  c->undispatched_bodies -=
+      b > c->undispatched_bodies ? c->undispatched_bodies : b;
   /* unary gRPC: exactly one length-prefixed message */
   if (st->body.size() < 5) {
     respond_grpc(s, c, id, st, 13, "malformed gRPC body", nullptr, 0);
@@ -461,6 +485,13 @@ bool h2_on_headers_complete(sn_http_server *s, Conn *c, int32_t id,
   }
   c->header_block.clear();
   if (c->closing) return true; /* GOAWAY sent: ignore new streams */
+  if (c->streams.find(id) == c->streams.end() &&
+      c->streams.size() >= kMaxLiveStreams) {
+    /* client exceeded the MAX_CONCURRENT_STREAMS we advertised */
+    emit_goaway(&c->wbuf, id, 11 /* ENHANCE_YOUR_CALM */);
+    c->closing = true;
+    return true;
+  }
   H2Stream &st = c->streams[id];
   st.send_window = c->peer_initial_window;
   for (auto &h : headers) {
@@ -481,6 +512,7 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
   switch (type) {
     case F_HEADERS: {
       if (!strip_headers_prologue(p, len, flags)) goto proto_err;
+      if (c->header_block.size() + len > kMaxHeaderBlock) goto calm_err;
       c->header_block.append((const char *)p, len);
       if (flags & FLAG_END_HEADERS)
         return h2_on_headers_complete(s, c, stream_id, flags);
@@ -490,6 +522,7 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
     }
     case F_CONTINUATION: {
       if (stream_id != c->cont_stream) goto proto_err;
+      if (c->header_block.size() + len > kMaxHeaderBlock) goto calm_err;
       c->header_block.append((const char *)p, len);
       if (flags & FLAG_END_HEADERS) {
         c->cont_stream = -1;
@@ -515,13 +548,24 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
       }
       if (it == c->streams.end()) return true; /* reset/unknown stream */
       H2Stream &st = it->second;
-      if (st.body.size() + payload > kMaxBody) {
+      if (st.end_stream || st.dispatched) {
+        /* DATA after END_STREAM is a protocol violation (RFC 7540 s5.1);
+         * counting it into undispatched_bodies would also leak the budget
+         * (dispatch already subtracted this stream's bytes) */
+        if (st.token) s->pending.erase(st.token);
+        emit_rst(&c->wbuf, stream_id, 5 /* STREAM_CLOSED */);
+        erase_stream(c, stream_id);
+        return true;
+      }
+      if (st.body.size() + payload > kMaxStreamBody ||
+          c->undispatched_bodies + payload > kMaxUndispatched) {
         emit_rst(&c->wbuf, stream_id, 11 /* ENHANCE_YOUR_CALM */);
         erase_stream(c, stream_id);
         return true;
       }
       st.body.append((const char *)p + off, payload);
       c->buffered_bodies += payload;
+      c->undispatched_bodies += payload;
       if (flags & FLAG_END_STREAM) {
         st.end_stream = true;
         if (!st.dispatched) dispatch_h2(s, c, stream_id, &st);
@@ -586,6 +630,10 @@ bool h2_frame(sn_http_server *s, Conn *c, uint8_t type, uint8_t flags,
   }
 proto_err:
   emit_goaway(&c->wbuf, stream_id, 1 /* PROTOCOL_ERROR */);
+  c->closing = true;
+  return true;
+calm_err:
+  emit_goaway(&c->wbuf, stream_id, 11 /* ENHANCE_YOUR_CALM */);
   c->closing = true;
   return true;
 }

@@ -28,6 +28,7 @@ network half so MULTIPLE gateways share ONE durable firehose:
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import queue
@@ -82,7 +83,12 @@ class FirehoseBroker:
         try:
             frame = self._codec.decode(payload)
             op = json.loads(frame.meta or b"{}")
-            if self.token and op.get("auth") != self.token:
+            # constant-time compare; note the token itself travels in
+            # cleartext on the framed protocol — a non-loopback broker bind
+            # needs a TLS tunnel / mTLS in front (docs/production.md)
+            if self.token and not hmac.compare_digest(
+                str(op.get("auth", "")).encode(), self.token.encode()
+            ):
                 return _encode_op(
                     self._codec, self._msg_error, {"error": "unauthorized"}
                 )

@@ -563,3 +563,151 @@ class TestNativeLoadgen:
             assert res["errors"] == res["requests"]
         finally:
             srv.stop()
+
+
+# --------------------------------------------------------------- hardening
+
+_H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+_F_HEADERS, _F_RST, _F_SETTINGS, _F_GOAWAY, _F_CONT = 1, 3, 4, 7, 9
+
+
+def _h2_frame(ftype: int, flags: int, sid: int, payload: bytes) -> bytes:
+    return (
+        len(payload).to_bytes(3, "big")
+        + bytes([ftype, flags])
+        + sid.to_bytes(4, "big")
+        + payload
+    )
+
+
+def _hpack_lit(name: bytes, value: bytes) -> bytes:
+    """Literal header field without indexing, new name, no Huffman."""
+    return bytes([0x00, len(name)]) + name + bytes([len(value)]) + value
+
+
+def _drain(sock, budget: float = 2.0) -> bytes:
+    """Read until EOF or timeout; returns everything received."""
+    import socket as _socket
+    import time as _time
+
+    sock.settimeout(0.2)
+    buf = b""
+    deadline = _time.monotonic() + budget
+    while _time.monotonic() < deadline:
+        try:
+            chunk = sock.recv(65536)
+        except _socket.timeout:
+            continue
+        except OSError:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def _find_frames(buf: bytes, ftype: int):
+    """Yield (flags, sid, payload) for every well-formed frame of ftype."""
+    off = 0
+    while off + 9 <= len(buf):
+        ln = int.from_bytes(buf[off : off + 3], "big")
+        ft = buf[off + 3]
+        flags = buf[off + 4]
+        sid = int.from_bytes(buf[off + 5 : off + 9], "big") & 0x7FFFFFFF
+        payload = buf[off + 9 : off + 9 + ln]
+        if ft == ftype and len(payload) == ln:
+            yield flags, sid, payload
+        off += 9 + ln
+
+
+class TestH2Hardening:
+    """Abuse-resistance of the native h2 server: unbounded CONTINUATION
+    header blocks, HEADERS-only stream floods, and oversized bodies must be
+    rejected (GOAWAY/RST ENHANCE_YOUR_CALM), never buffered without bound or
+    wedged behind the read-pause (ADVICE r3)."""
+
+    def _connect(self):
+        import socket
+
+        srv = NativeHttpServer(submit=None, http2=True).start()
+        srv.set_static_response(0, b"")
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(_H2_PREFACE + _h2_frame(_F_SETTINGS, 0, 0, b""))
+        return srv, s
+
+    def test_continuation_flood_gets_goaway(self):
+        srv, s = self._connect()
+        try:
+            junk = b"\x00" * 16384
+            # HEADERS without END_HEADERS, then CONTINUATIONs past 64 KiB
+            s.sendall(_h2_frame(_F_HEADERS, 0, 1, junk))
+            for _ in range(8):
+                try:
+                    s.sendall(_h2_frame(_F_CONT, 0, 1, junk))
+                except OSError:
+                    break  # server already closed on us — also a pass
+            buf = _drain(s)
+            goaways = list(_find_frames(buf, _F_GOAWAY))
+            assert goaways, "expected GOAWAY on header-block flood"
+            code = int.from_bytes(goaways[-1][2][4:8], "big")
+            assert code == 11  # ENHANCE_YOUR_CALM
+        finally:
+            s.close()
+            srv.stop()
+
+    def test_headers_only_stream_flood_gets_goaway(self):
+        srv, s = self._connect()
+        try:
+            block = _hpack_lit(b":path", b"/x")
+            sid = 1
+            # open streams with END_HEADERS but no END_STREAM: each parks an
+            # H2Stream; past MAX_CONCURRENT_STREAMS the server must bail
+            for _ in range(1200):
+                try:
+                    s.sendall(_h2_frame(_F_HEADERS, 0x4, sid, block))
+                except OSError:
+                    break
+                sid += 2
+            buf = _drain(s)
+            goaways = list(_find_frames(buf, _F_GOAWAY))
+            assert goaways, "expected GOAWAY on stream flood"
+            code = int.from_bytes(goaways[-1][2][4:8], "big")
+            assert code == 11
+        finally:
+            s.close()
+            srv.stop()
+
+    def test_oversized_body_rst_not_deadlock(self):
+        """A single never-finished body past the per-stream cap must be
+        RST_STREAM'd promptly — before the fix it pinned the conn's read
+        budget forever (END_STREAM could no longer arrive)."""
+        srv, s = self._connect()
+        try:
+            block = _hpack_lit(b":path", b"/x")
+            s.sendall(_h2_frame(_F_HEADERS, 0x4, 1, block))  # END_HEADERS only
+            chunk = b"\x00" * 16384
+            rst_seen = False
+            buf = b""
+            s.settimeout(0.05)
+            # 33 MiB > 32 MiB per-stream cap
+            for _ in range(2112):
+                try:
+                    s.sendall(_h2_frame(0, 0, 1, chunk))
+                except OSError:
+                    break
+                try:
+                    buf += s.recv(65536)
+                except OSError:
+                    pass
+                if any(True for _ in _find_frames(buf, _F_RST)):
+                    rst_seen = True
+                    break
+            if not rst_seen:
+                buf += _drain(s)
+            rsts = list(_find_frames(buf, _F_RST))
+            assert rsts, "expected RST_STREAM on oversized body"
+            code = int.from_bytes(rsts[-1][2][:4], "big")
+            assert code == 11
+        finally:
+            s.close()
+            srv.stop()
