@@ -91,10 +91,8 @@ class DemoLLM(LLMComponent):
             auto_prefix_tokens = 4 * max_seq
         if paged_pages > 0:
             # paged KV serving (runtime/paged.py): HBM ~ tokens in flight;
-            # single-chip (see PagedLLMEngine docstring for why tp/spec
-            # stay on the slab engine)
-            if mesh is not None:
-                raise ValueError("paged_pages composes with tp=1 only")
+            # composes with tp (page pool shards its KV-head axis over
+            # "tp") and with speculation (PagedLLMEngine docstring)
             from seldon_core_tpu.runtime.llm import PagedLLMEngine
             from seldon_core_tpu.runtime.paged import PagedConfig
 
@@ -102,7 +100,7 @@ class DemoLLM(LLMComponent):
                 params, cfg,
                 PagedConfig(n_pages=paged_pages, page_size=page_size),
                 max_slots=max_slots, chunk_prefill=chunk_prefill,
-                auto_prefix_tokens=auto_prefix_tokens,
+                auto_prefix_tokens=auto_prefix_tokens, mesh=mesh,
             )
         else:
             engine = LLMEngine(params, cfg, max_slots=max_slots,
