@@ -21,7 +21,7 @@ import aiohttp
 from aiohttp import web
 
 from seldon_core_tpu.gateway.firehose import NullFirehose, make_firehose
-from seldon_core_tpu.gateway.oauth import OAuthProvider, TokenStore
+from seldon_core_tpu.gateway.oauth import OAuthProvider, default_token_store
 from seldon_core_tpu.gateway.store import DeploymentStore
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 
@@ -41,7 +41,10 @@ class Gateway:
         retry_backoff_s: float = 0.05,
     ):
         self.store = store
-        self.oauth = OAuthProvider(store, TokenStore(token_spill))
+        # SELDON_TOKEN_SIGNING_KEY (chart Secret) selects stateless signed
+        # tokens so any gateway replica honors any replica's tokens; the
+        # spill file remains the single-replica restart-persistence knob
+        self.oauth = OAuthProvider(store, default_token_store(token_spill))
         self.firehose = firehose or NullFirehose()
         self.registry = registry or MetricsRegistry()
         # connection-failure retries on the engine forward (reference apife

@@ -124,13 +124,88 @@ class TokenStore:
             self._save(force=True)
 
 
+class SignedTokenStore:
+    """STATELESS tokens: HMAC-SHA256-signed ``v1.<payload>.<sig>`` — any
+    gateway replica holding the shared signing key validates any replica's
+    tokens with zero shared storage, closing the multi-replica gap the
+    reference solves with a Redis token store
+    (``api-frontend/.../config/RedisConfig.java``,
+    ``AuthorizationServerConfiguration.java``).
+
+    Key distribution is the chart's job (one Secret mounted into every
+    gateway replica → env ``SELDON_TOKEN_SIGNING_KEY``).  Trade-off vs the
+    stateful store: individual tokens cannot be revoked before expiry —
+    ``revoke_client`` is a documented no-op; rotate the signing key to
+    invalidate everything at once (same lever as a Redis FLUSH).
+    """
+
+    def __init__(self, key: str):
+        if not key:
+            raise ValueError("signing key must be non-empty")
+        self._key = key.encode()
+
+    def _sign(self, payload: bytes) -> str:
+        mac = hmac.new(self._key, payload, "sha256").digest()
+        return base64.urlsafe_b64encode(mac).rstrip(b"=").decode()
+
+    def issue(self, client_id: str,
+              ttl_s: Optional[float] = None) -> tuple[str, float]:
+        if ttl_s is None:
+            ttl_s = _token_ttl_s()
+        payload = base64.urlsafe_b64encode(json.dumps(
+            {"c": client_id, "e": round(time.time() + ttl_s, 3)},
+            separators=(",", ":"),
+        ).encode()).rstrip(b"=").decode()
+        return f"v1.{payload}.{self._sign(payload.encode())}", ttl_s
+
+    def principal(self, token: str) -> Optional[str]:
+        parts = token.split(".")
+        if len(parts) != 3 or parts[0] != "v1":
+            return None
+        payload, sig = parts[1], parts[2]
+        if not hmac.compare_digest(self._sign(payload.encode()), sig):
+            return None
+        try:
+            data = json.loads(base64.urlsafe_b64decode(
+                payload + "=" * (-len(payload) % 4)
+            ))
+        except (ValueError, TypeError):
+            return None
+        if float(data.get("e", 0)) < time.time():
+            return None
+        cid = data.get("c")
+        return cid if isinstance(cid, str) else None
+
+    def revoke_client(self, client_id: str) -> None:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "revoke_client(%s) is a no-op with stateless signed tokens; "
+            "rotate SELDON_TOKEN_SIGNING_KEY to invalidate outstanding "
+            "tokens", client_id,
+        )
+
+    def flush(self) -> None:
+        pass  # nothing to persist
+
+
+def default_token_store(spill_path: Optional[str] = None):
+    """The deployment-selected token backend: stateless signed tokens when
+    ``SELDON_TOKEN_SIGNING_KEY`` is set (multi-replica gateways), else the
+    in-memory store with optional JSON spill (single replica)."""
+    key = os.environ.get("SELDON_TOKEN_SIGNING_KEY", "")
+    if key:
+        return SignedTokenStore(key)
+    return TokenStore(spill_path)
+
+
 class OAuthProvider:
     """Validates client credentials against the deployment store and mints
     bearer tokens."""
 
     def __init__(self, store, tokens: Optional[TokenStore] = None):
         self.store = store  # DeploymentStore: client_id → record w/ secret
-        self.tokens = tokens or TokenStore()
+        self.tokens = tokens or default_token_store()
 
     # -- token endpoint --------------------------------------------------
     def token_request(

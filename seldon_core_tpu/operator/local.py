@@ -250,6 +250,12 @@ def engine_main(argv: Optional[list] = None) -> None:
                     default=int(os.environ.get("ENGINE_WORKERS", "1")),
                     help="SO_REUSEPORT worker processes (all tiers); each "
                          "worker runs an independent engine")
+    ap.add_argument("--max-lifetime-s", type=float,
+                    default=float(os.environ.get("ENGINE_MAX_LIFETIME_S",
+                                                 "0")),
+                    help="self-reap after this many seconds (0 = forever); "
+                         "set for ad-hoc/backgrounded runs so a forgotten "
+                         "server can't idle for hours")
     args = ap.parse_args(argv)
     # fork BEFORE jax/threads initialize (serving/workers.py contract)
     reuse_port = args.workers > 1
@@ -282,8 +288,11 @@ def engine_main(argv: Optional[list] = None) -> None:
     async def serve():
         from seldon_core_tpu.serving.rest import build_app, start_server
 
+        stoppers: list = []
         app = build_app(engine=local, metrics=local.metrics)
-        await start_server(app, args.host, args.port, reuse_port=reuse_port)
+        runner = await start_server(app, args.host, args.port,
+                                    reuse_port=reuse_port)
+        stoppers.append(runner)  # aiohttp runner: stop() aliased below
         if args.grpc_port:
             from seldon_core_tpu.serving.grpc_api import (
                 GrpcServer,
@@ -295,6 +304,7 @@ def engine_main(argv: Optional[list] = None) -> None:
                 host=args.host,
             )
             await gserver.start()
+            stoppers.append(gserver)
             print(f"gRPC Seldon service on {args.host}:{gserver.port}",
                   flush=True)
         if args.native_port:
@@ -305,6 +315,7 @@ def engine_main(argv: Optional[list] = None) -> None:
                 bind=args.host, reuseport=reuse_port,
             )
             await nrest.start()
+            stoppers.append(nrest)
             print(f"native REST tier on {args.host}:{nrest.port}", flush=True)
         if args.native_grpc_port:
             from seldon_core_tpu.serving.native_http import NativeGrpcServer
@@ -314,10 +325,35 @@ def engine_main(argv: Optional[list] = None) -> None:
                 bind=args.host, reuseport=reuse_port,
             )
             await ngrpc.start()
+            stoppers.append(ngrpc)
             print(f"native gRPC tier on {args.host}:{ngrpc.port}", flush=True)
         print(f"serving deployment {dep.name!r} on {args.host}:{args.port}",
               flush=True)
-        await asyncio.Event().wait()
+        # graceful self-reap: SIGTERM/SIGINT stop the servers cleanly
+        # (native tiers join their IO threads) instead of dying mid-write;
+        # --max-lifetime-s bounds forgotten background runs (an orphaned
+        # local server once idled 5.4 h on a 1-core bench host)
+        import signal as _signal
+
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for _sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(_sig, stop_ev.set)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-main thread / exotic loop: default handling
+        if args.max_lifetime_s > 0:
+            loop.call_later(args.max_lifetime_s, stop_ev.set)
+        await stop_ev.wait()
+        print("shutting down", flush=True)
+        for srv in stoppers:
+            try:
+                stop = getattr(srv, "stop", None) or srv.cleanup
+                res = stop()
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                pass
 
     asyncio.run(serve())
 

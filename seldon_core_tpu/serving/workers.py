@@ -83,53 +83,60 @@ def fork_workers(n: int) -> int:
     sys.exit(0)
 
 
-class WorkerPool:
-    """Programmatic fork-based pool: runs ``boot(worker_index)`` (a blocking
-    callable) in each of ``n`` child processes.
+def _boot_child(boot: Callable[[int], None], i: int) -> None:
+    """Spawn-context child entry (must be module-level for pickling)."""
+    try:
+        boot(i)
+    except KeyboardInterrupt:
+        pass
 
-    Use from tests/tools; servers inside ``boot`` should bind a fixed port
-    with ``reuseport=True`` (see ``pick_free_port``).  The parent process
+
+class WorkerPool:
+    """Programmatic SPAWN-based pool: runs ``boot(worker_index)`` (a
+    blocking, picklable callable) in each of ``n`` child processes.
+
+    Spawn, not fork: the callers of this pool (tests, tools) have live
+    JAX/XLA thread pools, and forking a multithreaded CPython process is
+    undefined behavior (the interpreter itself warns "may lead to
+    deadlocks") — each child gets a fresh interpreter instead.  The
+    pre-thread ``fork_workers`` above remains the entrypoint path, where
+    forking is still safe and cheap.
+
+    Servers inside ``boot`` should bind a fixed port with
+    ``reuseport=True`` (see ``pick_free_port``).  The parent process
     stays interactive (unlike :func:`fork_workers`).
     """
 
     def __init__(self, boot: Callable[[int], None], n: int):
         self.boot = boot
         self.n = n
-        self.pids: list[int] = []
+        self.procs: list = []
+
+    @property
+    def pids(self) -> list[int]:
+        return [p.pid for p in self.procs if p.pid is not None]
 
     def start(self) -> "WorkerPool":
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
         for i in range(self.n):
-            pid = os.fork()
-            if pid == 0:
-                try:
-                    self.boot(i)
-                except KeyboardInterrupt:
-                    pass
-                finally:
-                    os._exit(0)
-            self.pids.append(pid)
+            p = ctx.Process(target=_boot_child, args=(self.boot, i))
+            p.start()
+            self.procs.append(p)
         return self
 
     def stop(self, timeout_s: float = 5.0) -> None:
-        for p in self.pids:
-            try:
-                os.kill(p, signal.SIGTERM)
-            except ProcessLookupError:
-                pass
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
         deadline = time.monotonic() + timeout_s
-        for p in self.pids:
-            while time.monotonic() < deadline:
-                done, _ = os.waitpid(p, os.WNOHANG)
-                if done:
-                    break
-                time.sleep(0.02)
-            else:
-                try:
-                    os.kill(p, signal.SIGKILL)
-                    os.waitpid(p, 0)
-                except (ProcessLookupError, ChildProcessError):
-                    pass
-        self.pids.clear()
+        for p in self.procs:
+            p.join(max(deadline - time.monotonic(), 0.05))
+            if p.is_alive():
+                p.kill()
+                p.join(timeout_s)
+        self.procs.clear()
 
     def __enter__(self) -> "WorkerPool":
         return self.start()
@@ -141,11 +148,4 @@ class WorkerPool:
 def alive(pool: Optional["WorkerPool"]) -> int:
     if pool is None:
         return 0
-    n = 0
-    for p in pool.pids:
-        try:
-            os.kill(p, 0)
-            n += 1
-        except ProcessLookupError:
-            pass
-    return n
+    return sum(1 for p in pool.procs if p.is_alive())

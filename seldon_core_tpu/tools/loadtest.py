@@ -156,6 +156,9 @@ class SseStreamDriver(RestDriver):
     def __init__(self, base_url, payload, path="/api/v0.1/stream", **kw):
         super().__init__(base_url, payload, path=path, **kw)
         self.ttfts_ms: List[float] = []
+        # per-stream time-per-output-token: (duration - ttft) / (n - 1),
+        # the steady-state decode cadence the TTFT number excludes
+        self.tpots_ms: List[float] = []
         self.tokens = 0
         self.streams_completed = 0
 
@@ -196,6 +199,9 @@ class SseStreamDriver(RestDriver):
         # quantity
         if ttft_ms is not None:
             self.ttfts_ms.append(ttft_ms)
+            if n > 1:
+                total_ms = (time.perf_counter() - t0) * 1000.0
+                self.tpots_ms.append((total_ms - ttft_ms) / (n - 1))
         self.tokens += n
         self.streams_completed += 1
 
@@ -212,6 +218,13 @@ class SseStreamDriver(RestDriver):
         if self.ttfts_ms:
             arr = np.asarray(self.ttfts_ms)
             out["ttft_ms"] = {
+                "p50": round(float(np.percentile(arr, 50)), 3),
+                "p90": round(float(np.percentile(arr, 90)), 3),
+                "p99": round(float(np.percentile(arr, 99)), 3),
+            }
+        if self.tpots_ms:
+            arr = np.asarray(self.tpots_ms)
+            out["tpot_ms"] = {
                 "p50": round(float(np.percentile(arr, 50)), 3),
                 "p90": round(float(np.percentile(arr, 90)), 3),
                 "p99": round(float(np.percentile(arr, 99)), 3),

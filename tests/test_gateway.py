@@ -521,3 +521,69 @@ class TestStreamingProxy:
         finally:
             await client.close()
             await engine.close()
+
+
+class TestSignedTokens:
+    """Stateless HMAC-signed tokens (SELDON_TOKEN_SIGNING_KEY): any gateway
+    replica validates any replica's tokens with zero shared storage — the
+    multi-replica gap the reference closes with a Redis token store
+    (api-frontend/.../config/RedisConfig.java)."""
+
+    async def test_token_issued_by_replica_a_accepted_by_replica_b(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("SELDON_TOKEN_SIGNING_KEY", "shared-chart-secret")
+        engine = TestClient(TestServer(await fake_engine_app()))
+        await engine.start_server()
+        url = f"http://127.0.0.1:{engine.port}"
+        # two REPLICAS: independent Gateway instances, same signing key,
+        # same deployment records (both watch the same CRDs)
+        gw_a, client_a, _ = await make_gateway(engine_url=url)
+        gw_b, client_b, _ = await make_gateway(engine_url=url)
+        try:
+            token = await get_token(client_a)
+            assert token.startswith("v1.")
+            resp = await client_b.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[3.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert resp.status == 200
+            assert (await resp.json())["data"]["ndarray"] == [[3.0]]
+        finally:
+            await client_a.close()
+            await client_b.close()
+            await engine.close()
+            await gw_a.close()
+            await gw_b.close()
+
+    async def test_tampered_wrong_key_and_expired_rejected(self, monkeypatch):
+        from seldon_core_tpu.gateway.oauth import SignedTokenStore
+
+        a = SignedTokenStore("key-one")
+        token, _ = a.issue("client-x")
+        assert a.principal(token) == "client-x"
+        # tampered payload
+        head, payload, sig = token.split(".")
+        bad = f"{head}.{payload[:-2]}AA.{sig}"
+        assert a.principal(bad) is None
+        # different replica key (mis-deployed secret) must reject
+        assert SignedTokenStore("key-two").principal(token) is None
+        # expired
+        tok2, _ = a.issue("client-x", ttl_s=-1.0)
+        assert a.principal(tok2) is None
+        # garbage shapes
+        assert a.principal("") is None
+        assert a.principal("v1.onlytwo") is None
+
+    async def test_env_selects_signed_store(self, monkeypatch):
+        from seldon_core_tpu.gateway.oauth import (
+            SignedTokenStore,
+            TokenStore,
+            default_token_store,
+        )
+
+        monkeypatch.delenv("SELDON_TOKEN_SIGNING_KEY", raising=False)
+        assert isinstance(default_token_store(), TokenStore)
+        monkeypatch.setenv("SELDON_TOKEN_SIGNING_KEY", "k")
+        assert isinstance(default_token_store(), SignedTokenStore)

@@ -459,9 +459,10 @@ class TestWorkerPool:
             import aiohttp
 
             pids = set()
-            # wait for workers to bind
-            deadline = asyncio.get_running_loop().time() + 10
-            for _ in range(24):
+            # wait for workers to bind (spawn children re-import the test
+            # module incl. jax — tens of seconds on a contended 1-core host)
+            deadline = asyncio.get_running_loop().time() + 90
+            for _ in range(160):
                 try:
                     async with aiohttp.ClientSession() as s:
                         async with s.post(
@@ -474,6 +475,8 @@ class TestWorkerPool:
                     if asyncio.get_running_loop().time() > deadline:
                         raise
                     await asyncio.sleep(0.25)
+                if len(pids) == 2:
+                    break
             return pids
 
         with pool:
