@@ -52,6 +52,7 @@ class DemoLLM(LLMComponent):
         paged_pages: int = 0,
         page_size: int = 16,
         auto_prefix_tokens: int = -1,
+        ring_prefill: int = 0,
     ):
         cfg = TransformerConfig(
             vocab_size=vocab_size,
@@ -101,11 +102,13 @@ class DemoLLM(LLMComponent):
                 PagedConfig(n_pages=paged_pages, page_size=page_size),
                 max_slots=max_slots, chunk_prefill=chunk_prefill,
                 auto_prefix_tokens=auto_prefix_tokens, mesh=mesh,
+                ring_prefill=ring_prefill,
             )
         else:
             engine = LLMEngine(params, cfg, max_slots=max_slots,
                                chunk_prefill=chunk_prefill, mesh=mesh,
-                               auto_prefix_tokens=auto_prefix_tokens)
+                               auto_prefix_tokens=auto_prefix_tokens,
+                               ring_prefill=ring_prefill)
         super().__init__(engine, n_new=n_new)
         self.name = "llm"
 

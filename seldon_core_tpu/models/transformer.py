@@ -445,11 +445,14 @@ def attention_block(p, x, positions, cfg: TransformerConfig, mesh=None,
     k = _attn_proj(h, p["wk"], cfg.kv_heads, cfg.d_head, x.dtype)
     v = _attn_proj(h, p["wv"], cfg.kv_heads, cfg.d_head, x.dtype)
     q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
-    kv_cache = (k, v)  # pre-expansion: the KV cache stores kv_heads only
+    # pre-expansion: the KV cache stores kv_heads only.  Under ring the
+    # returned K/V is logically whole-sequence but SHARDED over "tp" on
+    # the length axis — callers inserting it into a head-sharded serving
+    # cache get the seq->head reshard from GSPMD (one all-to-all), the
+    # Ulysses-style transition that makes sequence-parallel prefill feed
+    # an ordinary tp decode (runtime/llm.py ring_prefill)
+    kv_cache = (k, v)
     k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
-    if return_kv and cfg.attention == "ring":
-        raise ValueError("return_kv is unsupported with ring attention "
-                         "(sequence-sharded K/V has no whole-sequence cache)")
     if cfg.attention == "ring" and mesh is not None and mesh.shape.get("tp", 1) > 1:
         # un-expand for the ring: rotating compact [B,L,Hk,D] blocks moves
         # g-times fewer bytes per ppermute and holds g-times smaller blocks

@@ -244,6 +244,7 @@ class LLMEngine:
         mesh=None,
         auto_prefix_tokens: int = 0,
         auto_prefix_granularity: int = 16,
+        ring_prefill: int = 0,
     ):
         """``mesh``: serve TENSOR-PARALLEL over a jax.sharding.Mesh with a
         "tp" axis.  Params must be placed to match (``shard_params`` for
@@ -264,6 +265,15 @@ class LLMEngine:
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.k_draft = k_draft
+        # LONG-CONTEXT serving (SURVEY §7 layer 9): prompt buckets >= this
+        # many tokens prefill SEQUENCE-PARALLEL — ring attention over the
+        # mesh's "tp" axis shards the sequence, so per-device prefill
+        # memory is L/tp and a prompt longer than one chip's flash budget
+        # still serves.  The returned K/V (seq-sharded) reshards into the
+        # head-sharded serving cache via one GSPMD all-to-all at insert;
+        # decode proceeds as ordinary tensor parallelism.  0 = off; needs
+        # mesh with tp > 1 (harmless dense prefill otherwise).
+        self.ring_prefill = int(ring_prefill)
         # Sarathi-style chunked prefill: admissions longer than this many
         # tokens extend their cache chunk-by-chunk (each chunk one K-token
         # decode program) with an event-loop yield between chunks, so
@@ -587,13 +597,32 @@ class LLMEngine:
         return logits, small
 
     # -- device programs -------------------------------------------------
+    def _ring_eligible(self, bucket: int) -> bool:
+        if not self.ring_prefill or bucket < self.ring_prefill:
+            return False
+        if self.mesh is None:
+            return False
+        tp = self.mesh.shape.get("tp", 1)
+        # ring shards the sequence evenly over "tp" (manual shard_map)
+        return tp > 1 and bucket % tp == 0
+
     def _prefill_for(self, bucket: int, draft: bool = False):
         memo = self._draft_prefills if draft else self._prefills
         fn = memo.get(bucket)
         if fn is None:
+            import dataclasses
+
+            cfg = self.draft_cfg if draft else self.cfg
+            if self._ring_eligible(bucket):
+                # sequence-parallel prefill program for long buckets:
+                # same params, ring attention over "tp" (flash is a
+                # per-device whole-sequence kernel — exactly what long
+                # prompts must avoid)
+                cfg = dataclasses.replace(
+                    cfg, attention="ring", use_flash=False
+                )
             fn = memo[bucket] = jax.jit(
-                partial(prefill, cfg=self.draft_cfg if draft else self.cfg,
-                        max_len=bucket, mesh=self.mesh)
+                partial(prefill, cfg=cfg, max_len=bucket, mesh=self.mesh)
             )
         return fn
 
@@ -1024,6 +1053,7 @@ class PagedLLMEngine(LLMEngine):
         draft_params: Optional[dict] = None,
         draft_cfg: Optional[TransformerConfig] = None,
         k_draft: int = 4,
+        ring_prefill: int = 0,
     ):
         from seldon_core_tpu.runtime.paged import (
             PagedConfig,
@@ -1045,7 +1075,8 @@ class PagedLLMEngine(LLMEngine):
                          auto_prefix_tokens=auto_prefix_tokens,
                          auto_prefix_granularity=auto_prefix_granularity,
                          mesh=mesh, draft_params=draft_params,
-                         draft_cfg=draft_cfg, k_draft=k_draft)
+                         draft_cfg=draft_cfg, k_draft=k_draft,
+                         ring_prefill=ring_prefill)
         # speculative verification transiently writes up to k_draft+1 page
         # rows past a slot's final position before the rewind — the same
         # headroom the slab engine adds to cache_len, paid here per

@@ -141,15 +141,14 @@ def run_multihost_dryrun(n_hosts: int = 2, devices_per_host: int = 4,
     not just env parsing.
     """
     import json
-    import socket
     import subprocess
     import sys
+    import time
+
+    from seldon_core_tpu.serving.workers import pick_free_port
 
     _statefulset_env_names(n_hosts)
-    # a real free port, released just before the workers bind it
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = pick_free_port()
 
     procs = []
     for i in range(n_hosts):
@@ -183,9 +182,14 @@ def run_multihost_dryrun(n_hosts: int = 2, devices_per_host: int = 4,
                 os.path.abspath(__file__)))),
         ))
     outs = []
+    # ONE shared deadline: both workers wedging must not serialize into
+    # n_hosts x timeout of wall clock
+    deadline = time.monotonic() + timeout
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=timeout)
+            out, _ = p.communicate(
+                timeout=max(deadline - time.monotonic(), 1.0)
+            )
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()

@@ -1803,3 +1803,110 @@ class TestPagedComposition:
         out = asyncio.run(run())
         ref = generate(self.GQA_PARAMS, full, 5, self.GQA)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestRingPrefill:
+    """Long-context serving (SURVEY §7 layer 9, VERDICT r3 weak #4):
+    prompt buckets >= ring_prefill tokens prefill SEQUENCE-PARALLEL (ring
+    attention over "tp", per-device memory L/tp) and the seq-sharded K/V
+    reshards into the head-sharded serving cache — so a prompt longer
+    than one chip's flash budget serves, byte-identical to the dense
+    single-chip reference."""
+
+    GQA = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=128, dtype=jnp.float32,
+    )
+    GQA_PARAMS = init_params(jax.random.PRNGKey(0), GQA)
+    DRAFT = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=32, max_seq=128, dtype=jnp.float32,
+    )
+    DRAFT_PARAMS = init_params(jax.random.PRNGKey(9), DRAFT)
+
+    def _mesh(self, tp=2):
+        from seldon_core_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(n_devices=tp, tp=tp, pp=1)
+
+    def _engine(self, **kw):
+        from seldon_core_tpu.models.transformer import shard_params
+
+        mesh = self._mesh()
+        sp = shard_params(self.GQA_PARAMS, mesh, self.GQA)
+        kw.setdefault("max_slots", 2)
+        kw.setdefault("max_len", 80)
+        kw.setdefault("ring_prefill", 32)
+        return LLMEngine(sp, self.GQA, mesh=mesh, **kw)
+
+    def test_long_prompt_ring_prefill_exact(self):
+        """48-token prompt -> bucket 64, 2x the ring threshold: the
+        sequence-parallel program serves it byte-identical to the dense
+        single-chip decode."""
+        pr = prompt(48, seed=21)
+        eng = self._engine()
+        assert eng._ring_eligible(64)
+
+        async def run():
+            return await eng.generate(pr, 6)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, pr, 6, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_short_prompt_stays_dense(self):
+        eng = self._engine()
+        assert not eng._ring_eligible(8)
+
+        async def run():
+            return await eng.generate(prompt(5, seed=22), 4)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, prompt(5, seed=22), 4, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_ring_composes_with_prefix_cache(self):
+        """register_prefix on a long prefix runs the ring program; the
+        suffix extends dense against the resharded cache — still exact."""
+        pre = prompt(40, seed=23)
+        suf = prompt(6, seed=24)
+        full = jnp.concatenate([pre, suf], axis=1)
+        eng = self._engine()
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+
+        async def run():
+            return await eng.generate(np.asarray(full).reshape(-1), 5)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, full, 5, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_all_four_compose_paged_tp_spec_ring(self):
+        """The complete production engine: paged KV pool sharded over tp,
+        speculative decoding against pages, AND sequence-parallel ring
+        prefill for the long prompt — one engine, byte-identical to the
+        plain dense decode."""
+        from seldon_core_tpu.models.transformer import shard_params
+        from seldon_core_tpu.runtime.llm import PagedLLMEngine
+        from seldon_core_tpu.runtime.paged import PagedConfig
+
+        mesh = self._mesh()
+        pr = prompt(48, seed=25)
+        eng = PagedLLMEngine(
+            shard_params(self.GQA_PARAMS, mesh, self.GQA), self.GQA,
+            PagedConfig(n_pages=33, page_size=4), max_slots=2, max_len=64,
+            mesh=mesh, ring_prefill=32,
+            draft_params=shard_params(self.DRAFT_PARAMS, mesh, self.DRAFT),
+            draft_cfg=self.DRAFT, k_draft=3,
+        )
+        assert eng._ring_eligible(64)
+
+        async def run():
+            out = await eng.generate(pr, 6)
+            return out, eng.spec_stats, eng.free_pages
+
+        out, stats, free = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, pr, 6, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert stats["rounds"] >= 1
+        assert free == 32

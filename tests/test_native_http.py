@@ -459,10 +459,12 @@ class TestWorkerPool:
             import aiohttp
 
             pids = set()
-            # wait for workers to bind (spawn children re-import the test
-            # module incl. jax — tens of seconds on a contended 1-core host)
+            # wait for BOTH workers (spawn children re-import the test
+            # module incl. jax — tens of seconds on a contended 1-core
+            # host); deadline-gated so one fast worker can't exhaust a
+            # fixed poll count while the slow one is still importing
             deadline = asyncio.get_running_loop().time() + 90
-            for _ in range(160):
+            while asyncio.get_running_loop().time() < deadline:
                 try:
                     async with aiohttp.ClientSession() as s:
                         async with s.post(
@@ -472,11 +474,10 @@ class TestWorkerPool:
                             if r.status == 200:
                                 pids.add((await r.json())["jsonData"]["pid"])
                 except aiohttp.ClientError:
-                    if asyncio.get_running_loop().time() > deadline:
-                        raise
-                    await asyncio.sleep(0.25)
+                    pass
                 if len(pids) == 2:
                     break
+                await asyncio.sleep(0.25)
             return pids
 
         with pool:
