@@ -996,6 +996,32 @@ def bench_llm_stream_open_loop(seconds: float = 8.0) -> dict:
                 }
         finally:
             await runner.cleanup()
+        # NATIVE wire tier: the same SSE stream through the C++ h1 server
+        # (chunked transfer-encoding, one Python crossing per event) — the
+        # streamed-tokens/s point for the native tier
+        try:
+            from seldon_core_tpu.serving.native_http import NativeRestServer
+
+            nsrv = NativeRestServer(component=comp, bind="127.0.0.1")
+            nport = await nsrv.start()
+            try:
+                drv = SseStreamDriver(f"http://127.0.0.1:{nport}", payload,
+                                      path="/stream", connections=32)
+                res = await run_open_loop(
+                    drv, rate=2.0, seconds=seconds, warmup_s=1.0,
+                    protocol="sse-native",
+                )
+                d = res.to_dict()
+                out["native"] = {
+                    "achieved_req_per_s": d["req_per_s"],
+                    "dropped": d["dropped"],
+                    "failures": d["failures"],
+                    **drv.stream_stats(d["req_per_s"]),
+                }
+            finally:
+                await nsrv.stop()
+        except Exception as e:
+            out["native_error"] = f"{type(e).__name__}: {e}"
         return out
 
     out = asyncio.run(run())

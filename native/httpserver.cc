@@ -92,6 +92,12 @@ struct H2Stream {
   std::string pending_data;      /* response DATA blocked on flow control */
   std::string pending_trailers;  /* serialized trailers frame, sent last */
   bool responded = false;
+  /* server-streaming state (sn_http_stream_chunk/_end): headers go out
+   * with the first chunk; trailers only after _end — flush must not
+   * finish the stream while more chunks may come */
+  bool headers_sent = false;
+  bool stream_done = true;   /* false between first chunk and _end */
+  bool flow_listed = false;  /* already in c->flow_blocked */
 };
 
 struct Conn {
@@ -105,6 +111,7 @@ struct Conn {
 
   /* h1 state: nothing beyond the parse loop (requests are independent) */
   bool h1_keepalive = true;
+  bool h1_streaming = false; /* chunked (SSE) response in progress */
 
   /* h2 state */
   bool preface_done = false;
@@ -126,6 +133,7 @@ struct Completion {
   int status;
   std::string message;
   std::string body;
+  int kind = 0; /* 0 unary, 1 stream chunk, 2 stream end */
 };
 
 struct Pending {
@@ -317,7 +325,7 @@ bool flush_stream_data(Conn *c, int32_t id, H2Stream *st) {
     c->send_window -= (int64_t)n;
     st->send_window -= (int64_t)n;
   }
-  if (st->pending_data.empty()) {
+  if (st->pending_data.empty() && st->stream_done) {
     c->wbuf.append(st->pending_trailers);
     return true;
   }
@@ -811,6 +819,119 @@ bool do_read(sn_http_server *s, Conn *c) {
   }
 }
 
+/* one streamed chunk: h2 => one gRPC length-prefixed DATA message
+ * (headers emitted with the first chunk), h1 => one chunked-TE piece of a
+ * text/event-stream response.  The pending entry STAYS until stream_end.
+ * Returns false if the conn died. */
+bool handle_stream_chunk(sn_http_server *s, Conn *c, int32_t sid,
+                         Completion &comp) {
+  if (c->is_h2) {
+    auto sit = c->streams.find(sid);
+    if (sit == c->streams.end()) return true;
+    H2Stream *st = &sit->second;
+    if (st->pending_data.size() + comp.body.size() > kMaxBuffered) {
+      /* slow consumer: shed the stream rather than buffer unboundedly */
+      if (st->token) s->pending.erase(st->token);
+      emit_rst(&c->wbuf, sid, 11 /* ENHANCE_YOUR_CALM */);
+      erase_stream(c, sid);
+      return do_write(s, c);
+    }
+    if (!st->headers_sent) {
+      emit_response_headers(&c->wbuf, sid);
+      st->headers_sent = true;
+      st->stream_done = false;
+      st->responded = true;
+    }
+    st->pending_data.push_back('\0'); /* uncompressed gRPC message */
+    uint64_t n = comp.body.size();
+    char len4[4] = {(char)(n >> 24), (char)(n >> 16), (char)(n >> 8),
+                    (char)n};
+    st->pending_data.append(len4, 4);
+    st->pending_data.append(comp.body);
+    if (!flush_stream_data(c, sid, st) && !st->flow_listed) {
+      c->flow_blocked.push_back(sid);
+      st->flow_listed = true;
+    }
+  } else {
+    if (comp.body.empty()) return true; /* '0\r\n\r\n' would be the chunked
+                                         * TERMINATOR — never emit it here */
+    if (c->wbuf.size() - c->woff + comp.body.size() > kMaxBuffered) {
+      close_conn(s, c); /* slow SSE consumer */
+      return false;
+    }
+    if (!c->h1_streaming) {
+      char head[160];
+      int n = snprintf(head, sizeof(head),
+                       "HTTP/1.1 200 OK\r\n"
+                       "Content-Type: text/event-stream\r\n"
+                       "Cache-Control: no-cache\r\n"
+                       "Transfer-Encoding: chunked\r\n"
+                       "Connection: %s\r\n\r\n",
+                       c->h1_keepalive ? "keep-alive" : "close");
+      c->wbuf.append(head, n);
+      c->h1_streaming = true;
+    }
+    char sz[16];
+    int n = snprintf(sz, sizeof(sz), "%zx\r\n", comp.body.size());
+    c->wbuf.append(sz, n);
+    c->wbuf.append(comp.body);
+    c->wbuf.append("\r\n", 2);
+  }
+  return do_write(s, c);
+}
+
+/* stream end: h2 => trailers (grpc-status), h1 => chunked terminator.
+ * Returns false if the conn died. */
+bool handle_stream_end(sn_http_server *s, Conn *c, int32_t sid,
+                       Completion &comp) {
+  if (c->is_h2) {
+    auto sit = c->streams.find(sid);
+    if (sit == c->streams.end()) return true;
+    H2Stream *st = &sit->second;
+    st->token = 0;
+    if (!st->headers_sent) {
+      /* ended before any chunk: trailers-only response (an error status
+       * or an empty stream) */
+      respond_grpc(s, c, sid, st, comp.status, comp.message, nullptr, 0);
+    } else {
+      st->pending_trailers =
+          grpc_trailers_frame(sid, comp.status, comp.message);
+      st->stream_done = true;
+      if (flush_stream_data(c, sid, st)) erase_stream(c, sid);
+      else if (!st->flow_listed) {
+        c->flow_blocked.push_back(sid);
+        st->flow_listed = true;
+      }
+    }
+  } else {
+    if (c->h1_streaming) {
+      c->wbuf.append("0\r\n\r\n", 5);
+      c->h1_streaming = false;
+      if (!c->h1_keepalive) c->closing = true; /* honor Connection: close */
+    } else if (comp.status == 0 || comp.status == 200) {
+      /* ended before any chunk with OK status: an EMPTY event stream
+       * (headers + terminator), matching the aiohttp tier */
+      char head[160];
+      int n = snprintf(head, sizeof(head),
+                       "HTTP/1.1 200 OK\r\n"
+                       "Content-Type: text/event-stream\r\n"
+                       "Cache-Control: no-cache\r\n"
+                       "Transfer-Encoding: chunked\r\n"
+                       "Connection: %s\r\n\r\n0\r\n\r\n",
+                       c->h1_keepalive ? "keep-alive" : "close");
+      c->wbuf.append(head, n);
+      if (!c->h1_keepalive) c->closing = true;
+    } else {
+      /* ended before any chunk with an error: plain response */
+      respond_h1(c, comp.status,
+                 (const uint8_t *)comp.body.data(), comp.body.size());
+    }
+    erase_stream(c, 0);
+    if (!h1_consume(s, c)) return false; /* pipelined request */
+  }
+  return do_write(s, c);
+}
+
 void drain_completions(sn_http_server *s) {
   std::vector<Completion> done;
   pthread_mutex_lock(&s->mu);
@@ -821,7 +942,15 @@ void drain_completions(sn_http_server *s) {
     if (it == s->pending.end()) continue; /* conn closed / stream reset */
     Conn *c = it->second.conn;
     int32_t sid = it->second.stream_id;
+    if (comp.kind == 1) {
+      handle_stream_chunk(s, c, sid, comp);
+      continue; /* pending entry stays until stream_end */
+    }
     s->pending.erase(it);
+    if (comp.kind == 2) {
+      handle_stream_end(s, c, sid, comp);
+      continue;
+    }
     if (c->is_h2) {
       auto sit = c->streams.find(sid);
       if (sit == c->streams.end()) continue;
@@ -994,6 +1123,15 @@ void sn_http_server_destroy(sn_http_server *s) {
   delete s;
 }
 
+static void push_completion(sn_http_server *s, Completion &&comp) {
+  pthread_mutex_lock(&s->mu);
+  s->completions.push_back(std::move(comp));
+  pthread_mutex_unlock(&s->mu);
+  uint64_t one = 1;
+  ssize_t r = write(s->wake_fd, &one, 8);
+  (void)r;
+}
+
 void sn_http_complete(sn_http_server *s, uint64_t token, int status,
                       const char *message, const uint8_t *body,
                       uint64_t body_len) {
@@ -1003,12 +1141,29 @@ void sn_http_complete(sn_http_server *s, uint64_t token, int status,
   comp.status = status;
   if (message) comp.message = message;
   if (body && body_len) comp.body.assign((const char *)body, body_len);
-  pthread_mutex_lock(&s->mu);
-  s->completions.push_back(std::move(comp));
-  pthread_mutex_unlock(&s->mu);
-  uint64_t one = 1;
-  ssize_t r = write(s->wake_fd, &one, 8);
-  (void)r;
+  push_completion(s, std::move(comp));
+}
+
+void sn_http_stream_chunk(sn_http_server *s, uint64_t token,
+                          const uint8_t *data, uint64_t len) {
+  if (!s) return;
+  Completion comp;
+  comp.token = token;
+  comp.status = 0;
+  comp.kind = 1;
+  if (data && len) comp.body.assign((const char *)data, len);
+  push_completion(s, std::move(comp));
+}
+
+void sn_http_stream_end(sn_http_server *s, uint64_t token, int status,
+                        const char *message) {
+  if (!s) return;
+  Completion comp;
+  comp.token = token;
+  comp.status = status;
+  comp.kind = 2;
+  if (message) comp.message = message;
+  push_completion(s, std::move(comp));
 }
 
 void sn_http_set_static_response(sn_http_server *s, int status,

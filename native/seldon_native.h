@@ -204,6 +204,21 @@ void sn_http_complete(sn_http_server *s, uint64_t token, int status,
                       const char *message, const uint8_t *body,
                       uint64_t body_len);
 
+/* Server streaming (any thread).  Instead of sn_http_complete, call
+ * sn_http_stream_chunk 0+ times then sn_http_stream_end exactly once.
+ * HTTP/2: each chunk becomes one length-prefixed gRPC message (response
+ * headers go out with the first chunk); end sends the trailers
+ * (grpc-status/-message).  HTTP/1.1: the response is a chunked
+ * Transfer-Encoding text/event-stream — each chunk is raw SSE bytes; end
+ * sends the terminator (or, when no chunk was ever sent, a plain
+ * response with the given status).  Chunks for a closed/reset stream are
+ * dropped silently.  Slow consumers are shed (RST / close) once their
+ * backlog exceeds the per-conn response budget. */
+void sn_http_stream_chunk(sn_http_server *s, uint64_t token,
+                          const uint8_t *data, uint64_t len);
+void sn_http_stream_end(sn_http_server *s, uint64_t token, int status,
+                        const char *message);
+
 /* Canned response for static mode (h2: status is the grpc-status). */
 void sn_http_set_static_response(sn_http_server *s, int status,
                                  const uint8_t *body, uint64_t body_len);

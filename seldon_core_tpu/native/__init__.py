@@ -289,6 +289,12 @@ def _bind(lib: C.CDLL) -> None:
     lib.sn_http_complete.argtypes = [
         C.c_void_p, C.c_uint64, C.c_int, C.c_char_p, u8p, C.c_uint64,
     ]
+    lib.sn_http_stream_chunk.argtypes = [
+        C.c_void_p, C.c_uint64, u8p, C.c_uint64,
+    ]
+    lib.sn_http_stream_end.argtypes = [
+        C.c_void_p, C.c_uint64, C.c_int, C.c_char_p,
+    ]
     lib.sn_http_set_static_response.argtypes = [
         C.c_void_p, C.c_int, u8p, C.c_uint64,
     ]
@@ -608,6 +614,19 @@ class NativeHttpServer:
         self._lib.sn_http_complete(
             self._h, token, status,
             message.encode() if message else None, buf, len(body),
+        )
+
+    def stream_chunk(self, token: int, data: bytes) -> None:
+        """One server-streaming chunk: a gRPC message (h2) or raw SSE
+        bytes (h1).  Call stream_end exactly once when done."""
+        buf = (C.c_uint8 * len(data)).from_buffer_copy(data) if data else None
+        self._lib.sn_http_stream_chunk(self._h, token, buf, len(data))
+
+    def stream_end(
+        self, token: int, status: int = 0, message: Optional[str] = None
+    ) -> None:
+        self._lib.sn_http_stream_end(
+            self._h, token, status, message.encode() if message else None
         )
 
     def start(self) -> "NativeHttpServer":

@@ -14,10 +14,13 @@ Two routers share one bridge mechanism:
 
 - :class:`NativeGrpcServer` — the external ``Seldon`` service and the
   per-role component services (unary methods of serving/grpc_api.py's
-  SERVICE_METHODS), wire-compatible with reference grpc clients.  Server
-  streaming (``Stream`` RPC) stays on the grpc.aio tier.
+  SERVICE_METHODS) plus the server-streaming ``Stream`` RPC
+  (Model/Generic), wire-compatible with reference grpc clients.
 - :class:`NativeRestServer` — the external prediction API + internal
-  microservice API routes of serving/rest.py, JSON-compatible.
+  microservice API routes of serving/rest.py plus SSE token streaming
+  (``/api/v0.1/stream`` engine route, ``/stream`` component route) over
+  chunked Transfer-Encoding, JSON/event-compatible with the aiohttp
+  tier.
 
 Both run all handler work on the caller's asyncio loop, so engines,
 components, metrics, and the dynamic batcher behave identically to the
@@ -42,6 +45,19 @@ __all__ = ["NativeGrpcServer", "NativeRestServer"]
 # router result: (status, body_bytes, message) — status is the grpc-status
 # for h2 and the HTTP status for h1
 _Result = "tuple[int, bytes, Optional[str]]"
+
+
+class _StreamReply:
+    """A route's SERVER-STREAMING result: ``chunks`` is an async generator
+    of wire bytes (one gRPC message per chunk on h2, raw SSE bytes on h1);
+    the bridge pumps it through sn_http_stream_chunk/_end.  ``on_done(code,
+    elapsed_s)`` fires once with the terminal status (0/200 ok, 500 error,
+    499 cancelled) for metrics parity with the Python tiers."""
+
+    def __init__(self, chunks, on_done=None, err_code: int = 500):
+        self.chunks = chunks
+        self.on_done = on_done
+        self.err_code = err_code  # tier's error status (500 h1, 13 h2)
 
 
 class _AsyncBridge:
@@ -91,13 +107,47 @@ class _AsyncBridge:
     async def _run(self, token, method, path, body) -> None:
         t0 = time.perf_counter()
         try:
-            status, out, msg = await self._router(method, path, body)
+            result = await self._router(method, path, body)
         except Exception as e:  # router bug: fail the request, keep serving
             logger.exception("native bridge handler failed (%s)", path)
-            status, out, msg = self._error_result(
-                e, time.perf_counter() - t0
-            )
+            result = self._error_result(e, time.perf_counter() - t0)
+        if isinstance(result, _StreamReply):
+            await self._pump_stream(token, result, t0)
+            return
+        status, out, msg = result
         self.server.complete(token, status, out, msg)
+
+    async def _pump_stream(self, token, reply: _StreamReply, t0) -> None:
+        """Drain a streaming route into the native server.  Chunks for a
+        stream the client reset are dropped by the C side; a bounded
+        generator (LLM n_new) caps the wasted work."""
+        code = 0
+        try:
+            async for chunk in reply.chunks:
+                self.server.stream_chunk(token, chunk)
+            self.server.stream_end(token, 0, None)
+        except asyncio.CancelledError:
+            code = 499
+            self.server.stream_end(token, 0, None)
+            raise
+        except Exception as e:
+            logger.exception("native stream failed")
+            code = reply.err_code
+            # mid-stream: headers may be on the wire already, so the
+            # status carried here only matters for never-started streams
+            self.server.stream_end(
+                token, reply.err_code, f"{type(e).__name__}: {e}"
+            )
+        finally:
+            agen = reply.chunks
+            aclose = getattr(agen, "aclose", None)
+            if callable(aclose):
+                try:
+                    await aclose()
+                except Exception:
+                    pass
+            if reply.on_done is not None:
+                reply.on_done(code, time.perf_counter() - t0)
 
     async def start(self) -> int:
         self._loop = asyncio.get_running_loop()
@@ -153,6 +203,7 @@ class NativeGrpcServer:
 
         self._pb = pb
         self._routes: dict[str, Callable[[bytes], Awaitable[bytes]]] = {}
+        self._stream_routes: dict[str, Callable[[bytes], Any]] = {}
 
         if deployment is not None:
 
@@ -182,6 +233,49 @@ class NativeGrpcServer:
                         return out.SerializeToString()
 
                     self._routes[f"/{_PKG}.{svc}/{method}"] = _call
+            if callable(getattr(component, "stream", None)):
+                # server-streaming Stream RPC (grpc_api STREAM_METHODS
+                # twin): each event is a jsonData SeldonMessage; errors
+                # mid-stream become a FAILURE message event, matching the
+                # grpc.aio tier's _stream_handler
+                from seldon_core_tpu.messages import (
+                    SeldonMessage as _SM,
+                    Status as _St,
+                )
+
+                def _stream_route(data: bytes):
+                    req = message_from_proto(pb.SeldonMessage.FromString(data))
+
+                    async def chunks():
+                        agen = component.stream(req)
+                        try:
+                            async for event in agen:
+                                yield message_to_proto(
+                                    _SM(json_data=event)
+                                ).SerializeToString()
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as e:
+                            # swallow after emitting a FAILURE message and
+                            # end with OK trailers — grpc.aio tier parity
+                            # (_stream_handler returns normally); the gRPC
+                            # component server wires no metrics registry,
+                            # so no request-code observation is lost here
+                            logger.exception("native gRPC stream failed")
+                            code = getattr(e, "status_code", 500)
+                            yield message_to_proto(_SM(
+                                status=_St.failure(
+                                    code, f"{type(e).__name__}: {e}",
+                                    "INTERNAL",
+                                )
+                            )).SerializeToString()
+                        finally:
+                            await agen.aclose()
+
+                    return chunks()
+
+                self._stream_routes[f"/{_PKG}.Model/Stream"] = _stream_route
+                self._stream_routes[f"/{_PKG}.Generic/Stream"] = _stream_route
 
         self._bridge = _AsyncBridge(
             self._route, http2=True, port=port, bind=bind,
@@ -193,6 +287,12 @@ class NativeGrpcServer:
         return (13, b"", f"{type(e).__name__}: {e}")  # INTERNAL
 
     async def _route(self, method: str, path: str, body: bytes):
+        sfn = self._stream_routes.get(path)
+        if sfn is not None:
+            try:
+                return _StreamReply(sfn(body), err_code=13)
+            except Exception as e:
+                return (13, b"", f"{type(e).__name__}: {e}")
         fn = self._routes.get(path)
         if fn is None:
             return (12, b"", f"unknown method {path}")  # UNIMPLEMENTED
@@ -229,10 +329,10 @@ def _fail_json(code: int, info: str, reason: str = "") -> bytes:
 
 
 class NativeRestServer:
-    """External prediction API (+ internal microservice API) over the native
-    HTTP/1.1 server.  JSON wire format identical to serving/rest.py; the
-    aiohttp tier remains for SSE streaming, form-encoded bodies, OpenAPI,
-    and trace endpoints."""
+    """External prediction API (+ internal microservice API + SSE token
+    streaming) over the native HTTP/1.1 server.  JSON wire format
+    identical to serving/rest.py; the aiohttp tier remains for
+    form-encoded bodies, OpenAPI, and trace endpoints."""
 
     def __init__(
         self,
@@ -251,11 +351,16 @@ class NativeRestServer:
         self._routes: dict[
             tuple[str, str], Callable[[bytes], Awaitable[Any]]
         ] = {}
+        self._stream_fns: dict[str, Any] = {}
         if engine is not None:
             self._routes[("POST", "/api/v0.1/predictions")] = self._predict
             self._routes[("POST", "/api/v1.0/predictions")] = self._predict
             self._routes[("POST", "/api/v0.1/feedback")] = self._feedback
+            if callable(getattr(engine, "stream", None)):
+                self._stream_fns["/api/v0.1/stream"] = engine.stream
         if component is not None:
+            if callable(getattr(component, "stream", None)):
+                self._stream_fns["/stream"] = component.stream
             self._routes[("POST", "/predict")] = self._c_predict
             self._routes[("POST", "/transform-input")] = self._c_transform_in
             self._routes[("POST", "/transform-output")] = self._c_transform_out
@@ -294,6 +399,8 @@ class NativeRestServer:
                 return (200, self.metrics.render().encode(), None)
             self._observe(t0, 404)
             return (404, _fail_json(404, f"no route {path}"), None)
+        if method == "POST" and path in self._stream_fns:
+            return await self._sse(path, body, t0)
         fn = self._routes.get((method, path))
         if fn is None:
             self._observe(t0, 404)
@@ -308,6 +415,82 @@ class NativeRestServer:
             code = msg.status.code if 400 <= msg.status.code < 600 else 500
         self._observe(t0, code)
         return (code, msg.to_json().encode(), None)
+
+    async def _sse(self, path: str, body: bytes, t0: float):
+        """SSE streaming over the native h1 server (chunked
+        Transfer-Encoding) — serving/rest.py's _sse_stream semantics: the
+        FIRST event is pulled before committing to a stream, so
+        validation errors raised lazily in the generator map to real JSON
+        error responses instead of an HTTP 200 with an error event;
+        mid-stream errors become an ``error`` event; stream-event
+        ``metrics`` keys merge into the Prometheus registry."""
+        from seldon_core_tpu.runtime.component import (
+            SeldonComponentError,
+            validate_metrics,
+        )
+
+        stream_fn = self._stream_fns[path]
+        try:
+            msg = _parse_msg(body)
+            agen = stream_fn(msg)
+            first = await agen.__anext__()
+        except _BadRequest as e:
+            self._observe(t0, 400)
+            return (400, _fail_json(400, str(e)), None)
+        except StopAsyncIteration:
+            first = None
+            agen = None
+        except SeldonComponentError as e:
+            self._observe(t0, e.status_code)
+            return (
+                e.status_code if 400 <= e.status_code < 600 else 500,
+                _fail_json(e.status_code, str(e), e.reason), None,
+            )
+        except Exception as e:
+            logger.exception("native stream failed before first event")
+            self._observe(t0, 500)
+            return (500, _fail_json(500, f"{type(e).__name__}: {e}"), None)
+
+        def _sse_bytes(event) -> bytes:
+            if isinstance(event, dict) and event.get("metrics") \
+                    and self.metrics is not None:
+                try:
+                    self.metrics.merge_custom(
+                        self.name, validate_metrics(event["metrics"])
+                    )
+                except Exception:
+                    logger.warning("ignoring malformed stream-event metrics")
+            return b"data: " + json.dumps(event).encode() + b"\n\n"
+
+        async def chunks():
+            if first is not None:
+                yield _sse_bytes(first)
+            if agen is None:
+                return
+            try:
+                async for event in agen:
+                    yield _sse_bytes(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.exception("native stream failed mid-stream")
+                yield (b"data: " + json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}
+                ).encode() + b"\n\n")
+                # re-raise so the bridge records the request as a 500
+                # (aiohttp-tier parity); the terminator still goes out —
+                # h1 stream_end ignores the status once headers are on
+                # the wire
+                raise
+            finally:
+                await agen.aclose()
+
+        return _StreamReply(
+            chunks(),
+            on_done=lambda code, el: self._observe_s(
+                el, code if code else 200
+            ),
+        )
 
     # -- engine routes --------------------------------------------------
     async def _predict(self, body: bytes) -> SeldonMessage:
