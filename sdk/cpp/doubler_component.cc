@@ -1,0 +1,37 @@
+// Example component on the C++ SDK: doubles every value, tags the model
+// name, and counts predict calls through the custom-metrics passthrough.
+//
+// Build:  g++ -O2 -pthread -o doubler doubler_component.cc
+// Run:    ./doubler --port 9000 [--framed-port 9001]
+//
+// Drive it with the standard tooling:
+//   python -m seldon_core_tpu.tools api-test CONTRACT.json \
+//       --host 127.0.0.1 --port 9000 --transport rest
+// or deploy it as a graph child (endpoint type REST) — see sdk/cpp/README.md.
+
+#include "seldon_component.hpp"
+
+struct Doubler : seldon::Component {
+  long calls = 0;
+
+  seldon::Matrix predict(const seldon::Matrix &in) override {
+    calls++;
+    seldon::Matrix out = in;
+    for (auto &row : out.rows)
+      for (double &v : row) v *= 2.0;
+    return out;
+  }
+
+  std::map<std::string, std::string> tags() override {
+    return {{"model", "sdk-doubler"}, {"lang", "c++"}};
+  }
+
+  std::vector<seldon::Metric> metrics() override {
+    return {{"sdk_predict_calls_total", "COUNTER", 1.0}};
+  }
+};
+
+int main(int argc, char **argv) {
+  Doubler d;
+  return seldon::run(d, argc, argv);
+}

@@ -1,0 +1,686 @@
+// seldon_component.hpp — single-header C++ component SDK.
+//
+// Build a Seldon graph component in plain C++ with no dependencies beyond
+// the standard library and POSIX sockets: subclass seldon::Component,
+// override the methods your service type implements, and call
+// seldon::run().  The SDK serves
+//
+//   - the internal microservice REST API (POST /predict, /transform-input,
+//     /transform-output, /route, /aggregate, /send-feedback, plus
+//     GET /health/status, /health/ping) with SeldonMessage JSON bodies, and
+//   - optionally the framed binary protocol (u32 length prefix + "SELF"
+//     frames, the low-overhead transport of native/framing.cc — layout
+//     locked by examples/conformance/framed_*.bin golden vectors),
+//
+// and emits your tags() into response meta.tags and metrics() into
+// meta.metrics, so custom COUNTER/GAUGE/TIMER metrics flow through the
+// engine's passthrough into Prometheus exactly like a Python component's.
+//
+// Reference analog: the Java s2i wrapper + R/NodeJS wrappers
+// (reference wrappers/s2i/java/, docs/wrappers/{r,nodejs}.md) — the proof
+// that the wire contract is language-agnostic, promoted here from the
+// one-off conformance fixture (examples/conformance/cpp_component.cc) to a
+// reusable surface.
+//
+// Quick start (see sdk/cpp/doubler_component.cc + sdk/cpp/README.md):
+//
+//   #include "seldon_component.hpp"
+//   struct Doubler : seldon::Component {
+//     seldon::Matrix predict(const seldon::Matrix &in) override { ... }
+//   };
+//   int main(int argc, char **argv) {
+//     Doubler d;
+//     return seldon::run(d, argc, argv);   // --port P [--framed-port Q]
+//   }
+//
+// Scope: values travel as double (the reference's Tensor is double-only;
+// framed tensors of f32/f64/i32/i64 are widened on decode, responses are
+// f64).  Bodies are capped at 1 MiB.  Connections are served
+// thread-per-connection, so your Component overrides MAY RUN CONCURRENTLY
+// — guard mutable state with your own synchronization (same contract as
+// any multithreaded server framework).
+
+#ifndef SELDON_COMPONENT_HPP_
+#define SELDON_COMPONENT_HPP_
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace seldon {
+
+// ------------------------------------------------------------------ data
+
+struct Matrix {
+  std::vector<std::vector<double>> rows;
+  std::vector<std::string> names;  // column names (optional)
+};
+
+struct Metric {
+  std::string key;
+  std::string type;  // "COUNTER" | "GAUGE" | "TIMER"
+  double value;
+};
+
+class Component {
+ public:
+  virtual ~Component() = default;
+  // MODEL / default: echo
+  virtual Matrix predict(const Matrix &in) { return in; }
+  // TRANSFORMER / OUTPUT_TRANSFORMER: identity
+  virtual Matrix transform_input(const Matrix &in) { return in; }
+  virtual Matrix transform_output(const Matrix &in) { return in; }
+  // ROUTER: branch index (-1 = broadcast)
+  virtual int route(const Matrix &in) { (void)in; return 0; }
+  // COMBINER: first child wins by default
+  virtual Matrix aggregate(const std::vector<Matrix> &ins) {
+    return ins.empty() ? Matrix{} : ins[0];
+  }
+  // reward feedback (routers/learning components)
+  virtual void send_feedback(double reward) { (void)reward; }
+  // response meta enrichment (engine merges into meta.tags/meta.metrics)
+  virtual std::map<std::string, std::string> tags() { return {}; }
+  virtual std::vector<Metric> metrics() { return {}; }
+};
+
+// ------------------------------------------------------- JSON (subset)
+
+namespace detail {
+
+// find the balanced [...] region following "key"
+inline bool find_array(const std::string &body, const char *key,
+                       size_t *begin, size_t *end) {
+  size_t k = body.find(std::string("\"") + key + "\"");
+  if (k == std::string::npos) return false;
+  size_t open = body.find('[', k);
+  if (open == std::string::npos) return false;
+  int depth = 0;
+  bool in_str = false;
+  for (size_t i = open; i < body.size(); i++) {
+    char ch = body[i];
+    if (in_str) {
+      if (ch == '\\') i++;
+      else if (ch == '"') in_str = false;
+      continue;
+    }
+    if (ch == '"') in_str = true;
+    if (ch == '[') depth++;
+    if (ch == ']' && --depth == 0) { *begin = open; *end = i + 1; return true; }
+  }
+  return false;
+}
+
+// parse a 1-D or 2-D JSON number array into rows
+inline bool parse_ndarray(const std::string &src, Matrix *out) {
+  out->rows.clear();
+  int depth = 0;
+  std::vector<double> row;
+  bool saw_inner = false;
+  const char *p = src.c_str(), *stop = p + src.size();
+  while (p < stop) {
+    char ch = *p;
+    if (ch == '[') { depth++; if (depth == 2) { saw_inner = true; row.clear(); } p++; continue; }
+    if (ch == ']') {
+      if (depth == 2) out->rows.push_back(row);
+      depth--; p++; continue;
+    }
+    if ((ch >= '0' && ch <= '9') || ch == '-' || ch == '+') {
+      char *next = nullptr;
+      double v = strtod(p, &next);
+      if (next == p) return false;
+      if (depth >= 2) row.push_back(v);
+      else if (depth == 1) {
+        if (out->rows.empty()) out->rows.emplace_back();
+        out->rows[0].push_back(v);
+      }
+      p = next; continue;
+    }
+    p++;
+  }
+  (void)saw_inner;
+  return true;
+}
+
+inline bool parse_names(const std::string &body, std::vector<std::string> *out) {
+  size_t b = 0, e = 0;
+  if (!find_array(body, "names", &b, &e)) return false;
+  out->clear();
+  const std::string src = body.substr(b, e - b);
+  size_t i = 0;
+  while ((i = src.find('"', i)) != std::string::npos) {
+    size_t j = src.find('"', i + 1);
+    if (j == std::string::npos) break;
+    out->push_back(src.substr(i + 1, j - i - 1));
+    i = j + 1;
+  }
+  return true;
+}
+
+inline std::string json_escape(const std::string &s) {
+  std::string out;
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') { out += '\\'; out += ch; }
+    else if ((unsigned char)ch < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", ch);
+      out += buf;
+    } else out += ch;
+  }
+  return out;
+}
+
+inline void append_num(std::string *out, double v) {
+  char num[64];
+  snprintf(num, sizeof(num), "%.12g", v);
+  *out += num;
+}
+
+inline std::string meta_json(Component &c) {
+  std::string out = "{";
+  auto t = c.tags();
+  if (!t.empty()) {
+    out += "\"tags\":{";
+    bool first = true;
+    for (auto &kv : t) {
+      if (!first) out += ',';
+      first = false;
+      out += "\"" + json_escape(kv.first) + "\":\"" +
+             json_escape(kv.second) + "\"";
+    }
+    out += "}";
+  }
+  auto ms = c.metrics();
+  if (!ms.empty()) {
+    if (out.size() > 1) out += ',';
+    out += "\"metrics\":[";
+    for (size_t i = 0; i < ms.size(); i++) {
+      if (i) out += ',';
+      out += "{\"key\":\"" + json_escape(ms[i].key) + "\",\"type\":\"" +
+             json_escape(ms[i].type) + "\",\"value\":";
+      append_num(&out, ms[i].value);
+      out += "}";
+    }
+    out += "]";
+  }
+  out += "}";
+  return out;
+}
+
+inline std::string message_json(Component &c, const Matrix &m) {
+  std::string out = "{\"data\":{\"names\":[";
+  for (size_t i = 0; i < m.names.size(); i++) {
+    if (i) out += ',';
+    out += "\"" + json_escape(m.names[i]) + "\"";
+  }
+  out += "],\"ndarray\":[";
+  for (size_t r = 0; r < m.rows.size(); r++) {
+    if (r) out += ',';
+    out += '[';
+    for (size_t j = 0; j < m.rows[r].size(); j++) {
+      if (j) out += ',';
+      append_num(&out, m.rows[r][j]);
+    }
+    out += ']';
+  }
+  out += "]},\"meta\":" + meta_json(c) + "}";
+  return out;
+}
+
+inline std::string fail_json(int code, const std::string &info) {
+  char head[64];
+  snprintf(head, sizeof(head), "{\"status\":{\"code\":%d,\"info\":\"", code);
+  return std::string(head) + json_escape(info) +
+         "\",\"status\":\"FAILURE\"}}";
+}
+
+// --------------------------------------------------------- HTTP plumbing
+
+// ``carry`` holds surplus bytes read past the previous request's body —
+// without it, a keep-alive client whose next request arrives in the same
+// TCP segment would lose it and desync the connection
+inline bool recv_http(int fd, std::string *head, std::string *body,
+                      std::string *carry) {
+  std::string buf;
+  buf.swap(*carry);
+  char tmp[4096];
+  size_t hdr_end = buf.find("\r\n\r\n");
+  while (hdr_end == std::string::npos) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) return false;
+    buf.append(tmp, n);
+    hdr_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20)) return false;
+  }
+  *head = buf.substr(0, hdr_end + 4);
+  std::string rest = buf.substr(hdr_end + 4);
+  size_t content_length = 0;
+  size_t cl = head->find("Content-Length:");
+  if (cl == std::string::npos) cl = head->find("content-length:");
+  if (cl != std::string::npos)
+    content_length = strtoul(head->c_str() + cl + 15, nullptr, 10);
+  if (content_length > (1u << 20)) return false;
+  while (rest.size() < content_length) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) return false;
+    rest.append(tmp, n);
+  }
+  *body = rest.substr(0, content_length);
+  *carry = rest.substr(content_length);  // pipelined next request
+  return true;
+}
+
+inline void send_http(int fd, int status, const std::string &body,
+                      const char *ctype = "application/json") {
+  char head[256];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
+                   status, status == 200 ? "OK" : "Error", ctype,
+                   body.size());
+  (void)!write(fd, head, n);
+  (void)!write(fd, body.data(), body.size());
+}
+
+inline int listen_on(uint16_t port, uint16_t *bound) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) < 0 ||
+      listen(fd, 64) < 0) {
+    perror("bind");
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr *)&addr, &alen);
+  *bound = ntohs(addr.sin_port);
+  return fd;
+}
+
+inline bool parse_body_matrix(const std::string &body, Matrix *m,
+                              std::string *err) {
+  size_t b = 0, e = 0;
+  if (!find_array(body, "ndarray", &b, &e)) {
+    *err = "no data.ndarray (the SDK speaks the ndarray encoding)";
+    return false;
+  }
+  if (!parse_ndarray(body.substr(b, e - b), m)) {
+    *err = "malformed ndarray";
+    return false;
+  }
+  parse_names(body, &m->names);
+  return true;
+}
+
+inline std::string dispatch_rest(Component &c, const std::string &head,
+                                 const std::string &body, int *status) {
+  *status = 200;
+  auto is = [&head](const char *route) {
+    return head.rfind(std::string("POST ") + route, 0) == 0;
+  };
+  Matrix in;
+  std::string err;
+  if (is("/predict") || is("/transform-input") || is("/transform-output")) {
+    if (!parse_body_matrix(body, &in, &err)) { *status = 400; return fail_json(400, err); }
+    Matrix out = is("/predict") ? c.predict(in)
+                 : is("/transform-input") ? c.transform_input(in)
+                                          : c.transform_output(in);
+    return message_json(c, out);
+  }
+  if (is("/route")) {
+    if (!parse_body_matrix(body, &in, &err)) { *status = 400; return fail_json(400, err); }
+    int branch = c.route(in);
+    std::string out = "{\"data\":{\"names\":[],\"ndarray\":[[";
+    append_num(&out, (double)branch);
+    out += "]]},\"meta\":" + meta_json(c) + "}";
+    return out;
+  }
+  if (is("/aggregate")) {
+    // {"seldonMessages": [msg, msg, ...]} — split on each message's
+    // ndarray region
+    std::vector<Matrix> ins;
+    size_t pos = 0;
+    while (true) {
+      size_t k = body.find("\"ndarray\"", pos);
+      if (k == std::string::npos) break;
+      size_t b = body.find('[', k);
+      if (b == std::string::npos) break;  // key without an array value
+      size_t e2 = std::string::npos;
+      int depth = 0;
+      for (size_t i = b; i < body.size(); i++) {
+        if (body[i] == '[') depth++;
+        if (body[i] == ']' && --depth == 0) { e2 = i + 1; break; }
+      }
+      if (e2 == std::string::npos) break;  // unbalanced
+      Matrix m;
+      if (!parse_ndarray(body.substr(b, e2 - b), &m)) break;
+      ins.push_back(m);
+      pos = e2;
+    }
+    if (ins.empty()) { *status = 400; return fail_json(400, "no seldonMessages"); }
+    return message_json(c, c.aggregate(ins));
+  }
+  if (is("/send-feedback")) {
+    double reward = 0.0;
+    size_t k = body.find("\"reward\"");
+    if (k != std::string::npos) {
+      size_t colon = body.find(':', k);
+      if (colon != std::string::npos)
+        reward = strtod(body.c_str() + colon + 1, nullptr);
+    }
+    c.send_feedback(reward);
+    return "{\"meta\":" + meta_json(c) + "}";
+  }
+  *status = 404;
+  return fail_json(404, "no route");
+}
+
+// ------------------------------------------------- framed binary protocol
+
+// SELF frame layout (native/framing.cc, locked by the conformance golden
+// vectors): fixed 24-byte header, 24-byte tensor headers, i64 dims, meta
+// JSON, 64-byte-aligned payloads.  Wire = u32 LE length prefix + frame.
+constexpr uint32_t kMagic = 0x464C4553u;  // "SELF"
+constexpr uint8_t kVersion = 1;
+constexpr uint8_t kMsgPredict = 1, kMsgResponse = 2, kMsgFeedback = 3,
+                  kMsgError = 4, kMsgPing = 5;
+constexpr uint8_t kDtF32 = 0, kDtF64 = 1, kDtI32 = 6, kDtI64 = 7;
+constexpr size_t kAlign = 64;
+
+inline uint64_t align64(uint64_t x) { return (x + 63) & ~UINT64_C(63); }
+
+inline bool read_exact(int fd, void *buf, size_t n) {
+  uint8_t *p = (uint8_t *)buf;
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+// decode the FIRST tensor (widened to double) + meta JSON out of a frame.
+// Every header field is bounds-checked before use — the framed port is a
+// serving surface; a corrupt or hostile frame must yield false, never an
+// out-of-bounds read or a terminating allocation.
+inline bool frame_decode(const std::string &f, uint8_t *msg_type,
+                         std::string *meta, Matrix *m) {
+  if (f.size() < 24) return false;
+  const uint8_t *p = (const uint8_t *)f.data();
+  uint32_t magic;
+  memcpy(&magic, p, 4);
+  if (magic != kMagic || p[4] != kVersion) return false;
+  *msg_type = p[5];
+  uint32_t meta_len;
+  uint16_t n_tensors;
+  memcpy(&meta_len, p + 8, 4);
+  memcpy(&n_tensors, p + 12, 2);
+  if (n_tensors > 64) return false;  // sanity: SDK components take 1 tensor
+  uint64_t off = 24 + (uint64_t)n_tensors * 24;
+  if (off > f.size()) return false;  // tensor headers must fit BEFORE the
+                                     // ndim reads below touch them
+  uint64_t dim_off = off;
+  for (uint16_t i = 0; i < n_tensors; i++) {
+    uint8_t ndim = p[24 + i * 24 + 1];
+    dim_off += (uint64_t)ndim * 8;
+  }
+  if (dim_off > f.size() || meta_len > f.size() - dim_off) return false;
+  meta->assign(f, dim_off, meta_len);
+  m->rows.clear();
+  if (n_tensors == 0) return true;
+  uint8_t dtype = p[24 + 0];
+  uint8_t ndim = p[24 + 1];
+  uint64_t nbytes, payload_off;
+  memcpy(&nbytes, p + 24 + 8, 8);
+  memcpy(&payload_off, p + 24 + 16, 8);
+  if ((uint64_t)ndim * 8 > f.size() - off) return false;
+  std::vector<int64_t> dims(ndim);
+  for (uint8_t d = 0; d < ndim; d++)
+    memcpy(&dims[d], p + off + d * 8, 8);
+  if (payload_off > f.size() || nbytes > f.size() - payload_off)
+    return false;
+  uint64_t rows = 1, cols = 1;
+  if (ndim >= 1) {
+    if (dims[0] < 0) return false;
+    rows = (uint64_t)dims[0];
+  }
+  for (uint8_t d = 1; d < ndim; d++) {
+    if (dims[d] < 0) return false;
+    // overflow-safe product: bail once cols exceeds any possible payload
+    if (dims[d] != 0 && cols > nbytes / (uint64_t)dims[d]) return false;
+    cols *= (uint64_t)dims[d];
+  }
+  uint64_t isz = (dtype == kDtF64 || dtype == kDtI64) ? 8 : 4;
+  // rows * cols * isz must fit in nbytes — division form, cannot wrap
+  if (rows != 0 && cols != 0 && rows > (nbytes / isz) / cols) return false;
+  const uint8_t *pay = p + payload_off;
+  auto at = [&](uint64_t i) -> double {
+    switch (dtype) {
+      case kDtF32: { float v; memcpy(&v, pay + i * 4, 4); return v; }
+      case kDtF64: { double v; memcpy(&v, pay + i * 8, 8); return v; }
+      case kDtI32: { int32_t v; memcpy(&v, pay + i * 4, 4); return v; }
+      case kDtI64: { int64_t v; memcpy(&v, pay + i * 8, 8); return (double)v; }
+      default: return 0.0;
+    }
+  };
+  for (uint64_t r = 0; r < rows; r++) {
+    std::vector<double> row((size_t)cols);
+    for (uint64_t j = 0; j < cols; j++) row[(size_t)j] = at(r * cols + j);
+    m->rows.push_back(std::move(row));
+  }
+  return true;
+}
+
+// one f64 tensor + meta JSON -> full frame bytes
+inline std::string frame_encode(uint8_t msg_type, const std::string &meta,
+                                const Matrix &m) {
+  uint16_t n_tensors = m.rows.empty() ? 0 : 1;
+  uint64_t rows = m.rows.size();
+  uint64_t cols = rows ? m.rows[0].size() : 0;
+  uint64_t nbytes = rows * cols * 8;
+  uint64_t hdr = 24 + (uint64_t)n_tensors * 24 + (n_tensors ? 16 : 0) +
+                 meta.size();
+  uint64_t payload_off = n_tensors ? align64(hdr) : hdr;
+  uint64_t total = payload_off + nbytes;
+  std::string f(total, '\0');
+  uint8_t *p = (uint8_t *)&f[0];
+  memcpy(p, &kMagic, 4);
+  p[4] = kVersion;
+  p[5] = msg_type;
+  uint32_t meta_len = (uint32_t)meta.size();
+  memcpy(p + 8, &meta_len, 4);
+  memcpy(p + 12, &n_tensors, 2);
+  memcpy(p + 16, &total, 8);
+  uint64_t dim_off = 24 + (uint64_t)n_tensors * 24;
+  if (n_tensors) {
+    p[24] = kDtF64;
+    p[25] = 2;  // ndim
+    memcpy(p + 24 + 8, &nbytes, 8);
+    memcpy(p + 24 + 16, &payload_off, 8);
+    int64_t d0 = (int64_t)rows, d1 = (int64_t)cols;
+    memcpy(p + dim_off, &d0, 8);
+    memcpy(p + dim_off + 8, &d1, 8);
+    dim_off += 16;
+  }
+  memcpy(p + dim_off, meta.data(), meta.size());
+  if (n_tensors) {
+    uint8_t *pay = p + payload_off;
+    for (uint64_t r = 0; r < rows; r++)
+      for (uint64_t j = 0; j < cols; j++) {
+        double v = m.rows[(size_t)r][(size_t)j];
+        memcpy(pay + (r * cols + j) * 8, &v, 8);
+      }
+  }
+  return f;
+}
+
+inline void framed_conn(Component &c, int cfd) {
+  int one = 1;
+  setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint32_t len;
+    if (!read_exact(cfd, &len, 4)) break;
+    if (len > (64u << 20)) break;
+    std::string frame(len, '\0');
+    if (!read_exact(cfd, &frame[0], len)) break;
+    uint8_t msg_type = 0;
+    std::string meta;
+    Matrix in, out_m;
+    std::string out;
+    if (!frame_decode(frame, &msg_type, &meta, &in)) {
+      out = frame_encode(kMsgError, fail_json(400, "bad frame"), Matrix{});
+    } else if (msg_type == kMsgPing) {
+      out = frame_encode(kMsgResponse, "{}", Matrix{});
+    } else if (msg_type == kMsgFeedback) {
+      double reward = 0.0;
+      size_t k = meta.find("\"reward\"");
+      if (k != std::string::npos) {
+        size_t colon = meta.find(':', k);
+        if (colon != std::string::npos)
+          reward = strtod(meta.c_str() + colon + 1, nullptr);
+      }
+      c.send_feedback(reward);
+      out = frame_encode(kMsgResponse, "{\"meta\":" + meta_json(c) + "}",
+                         Matrix{});
+    } else {
+      out_m = c.predict(in);
+      std::string blob = "{\"names\":[";
+      for (size_t i = 0; i < out_m.names.size(); i++) {
+        if (i) blob += ',';
+        blob += "\"" + json_escape(out_m.names[i]) + "\"";
+      }
+      blob += "],\"meta\":" + meta_json(c) + "}";
+      out = frame_encode(kMsgResponse, blob, out_m);
+    }
+    uint32_t out_len = (uint32_t)out.size();
+    (void)!write(cfd, &out_len, 4);
+    (void)!write(cfd, out.data(), out.size());
+  }
+  close(cfd);
+}
+
+struct ConnArgs {
+  Component *c;
+  int cfd;
+};
+
+inline void rest_conn(Component &c, int cfd) {
+  int one = 1;
+  setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string head, body, carry;
+  while (recv_http(cfd, &head, &body, &carry)) {
+    if (head.rfind("GET /health/status", 0) == 0 ||
+        head.rfind("GET /health/ping", 0) == 0 ||
+        head.rfind("GET /ready", 0) == 0) {
+      send_http(cfd, 200, "ok", "text/plain");
+      continue;
+    }
+    int status = 200;
+    std::string resp = dispatch_rest(c, head, body, &status);
+    send_http(cfd, status, resp);
+  }
+  close(cfd);
+}
+
+inline void *rest_conn_thread(void *arg) {
+  ConnArgs *a = (ConnArgs *)arg;
+  rest_conn(*a->c, a->cfd);
+  delete a;
+  return nullptr;
+}
+
+inline void *framed_conn_thread(void *arg) {
+  ConnArgs *a = (ConnArgs *)arg;
+  framed_conn(*a->c, a->cfd);
+  delete a;
+  return nullptr;
+}
+
+// thread-per-connection accept loop: keep-alive clients (an engine, a
+// prober, the contract tester) connect CONCURRENTLY — a single-threaded
+// loop would wedge behind whichever idle connection arrived first
+inline void accept_loop(Component &c, int fd,
+                        void *(*conn_thread)(void *)) {
+  for (;;) {
+    int cfd = accept(fd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    pthread_t t{};
+    ConnArgs *a = new ConnArgs{&c, cfd};
+    if (pthread_create(&t, nullptr, conn_thread, a) != 0) {
+      delete a;
+      close(cfd);
+      continue;
+    }
+    pthread_detach(t);
+  }
+}
+
+struct LoopArgs {
+  Component *c;
+  int fd;
+  void *(*conn_thread)(void *);
+};
+
+inline void *accept_loop_thread(void *arg) {
+  LoopArgs *la = (LoopArgs *)arg;
+  accept_loop(*la->c, la->fd, la->conn_thread);
+  return nullptr;
+}
+
+}  // namespace detail
+
+// --------------------------------------------------------------- runner
+
+// Serve REST on --port (default 9000) and, when --framed-port is given,
+// the framed protocol on a second listener.  Blocks forever.
+inline int run(Component &c, int argc, char **argv) {
+  uint16_t port = 9000, framed_port = 0;
+  bool want_framed = false;
+  for (int i = 1; i < argc; i++) {
+    if (!strcmp(argv[i], "--port") && i + 1 < argc)
+      port = (uint16_t)atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--framed-port") && i + 1 < argc) {
+      framed_port = (uint16_t)atoi(argv[++i]);
+      want_framed = true;
+    } else if (argv[i][0] != '-') {
+      port = (uint16_t)atoi(argv[i]);  // bare positional = REST port
+    }
+  }
+  uint16_t bound = 0, fbound = 0;
+  int fd = detail::listen_on(port, &bound);
+  if (fd < 0) return 1;
+  pthread_t ft{};
+  detail::LoopArgs fla{&c, -1, detail::framed_conn_thread};
+  if (want_framed) {
+    fla.fd = detail::listen_on(framed_port, &fbound);
+    if (fla.fd < 0) return 1;
+    pthread_create(&ft, nullptr, detail::accept_loop_thread, &fla);
+  }
+  printf("seldon component: REST on 0.0.0.0:%u", bound);
+  if (want_framed) printf(", framed on 0.0.0.0:%u", fbound);
+  printf("\n");
+  fflush(stdout);
+  detail::accept_loop(c, fd, detail::rest_conn_thread);
+  return 0;
+}
+
+}  // namespace seldon
+
+#endif  // SELDON_COMPONENT_HPP_

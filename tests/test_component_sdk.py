@@ -1,0 +1,256 @@
+"""C++ component SDK (sdk/cpp/seldon_component.hpp): a reusable non-Python
+component surface (VERDICT r3 missing #1 / next #8).
+
+Reference analog: the Java s2i wrapper + documented R/NodeJS wrappers
+(wrappers/s2i/java/, docs/wrappers/{r,nodejs}.md).  The example doubler is
+built with g++, then driven (a) by the contract tester, (b) as a REMOTE
+CHILD of a GraphEngine with tags + custom metrics flowing through the
+passthrough, and (c) over the framed binary protocol with the Python
+framed client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SDK = os.path.join(REPO, "sdk", "cpp")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def sdk_server(tmp_path_factory):
+    from seldon_core_tpu.serving.workers import pick_free_port
+
+    exe = tmp_path_factory.mktemp("sdk") / "doubler"
+    subprocess.run(
+        ["g++", "-O2", "-pthread", "-o", str(exe),
+         os.path.join(SDK, "doubler_component.cc")],
+        check=True, capture_output=True,
+    )
+    port, fport = pick_free_port(), pick_free_port()
+    proc = subprocess.Popen(
+        [str(exe), "--port", str(port), "--framed-port", str(fport)],
+        stdout=subprocess.PIPE,
+    )
+    try:
+        import socket as _s
+
+        deadline = time.monotonic() + 10
+        for p in (port, fport):
+            while True:
+                try:
+                    _s.create_connection(("127.0.0.1", p), 0.5).close()
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("sdk component never listened")
+                    time.sleep(0.05)
+        yield port, fport
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+class TestSdkRest:
+    def test_predict_tags_and_metrics_in_meta(self, sdk_server):
+        import aiohttp
+
+        port, _ = sdk_server
+
+        async def run():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/predict",
+                    json={"data": {"names": ["a", "b"],
+                                   "ndarray": [[1.5, -2.0], [0.25, 4.0]]}},
+                ) as r:
+                    assert r.status == 200
+                    return await r.json()
+
+        d = asyncio.run(run())
+        np.testing.assert_allclose(
+            np.asarray(d["data"]["ndarray"]), [[3.0, -4.0], [0.5, 8.0]]
+        )
+        assert d["data"]["names"] == ["a", "b"]
+        assert d["meta"]["tags"]["model"] == "sdk-doubler"
+        ms = {m["key"]: m for m in d["meta"]["metrics"]}
+        assert ms["sdk_predict_calls_total"]["type"] == "COUNTER"
+
+    def test_contract_tester_drives_sdk_component(self, sdk_server):
+        from seldon_core_tpu.tools.contract import Contract
+        from seldon_core_tpu.tools.tester import test_component
+
+        port, _ = sdk_server
+        contract = Contract.from_dict({
+            "features": [
+                {"name": "x", "dtype": "FLOAT", "ftype": "continuous",
+                 "range": [-5, 5], "repeat": 3},
+            ],
+            "targets": [
+                {"name": "y", "dtype": "FLOAT", "ftype": "continuous",
+                 "repeat": 3},
+            ],
+        })
+        report = asyncio.run(
+            test_component(
+                contract, host="127.0.0.1", port=port,
+                transport="rest", n_requests=3, batch_size=2, seed=1,
+                tensor=False,
+            )
+        )
+        assert report.ok, report.to_dict()
+
+    def test_transformer_route_aggregate_feedback(self, sdk_server):
+        """The non-overridden methods serve their defaults through the
+        same wire: identity transforms, branch 0, first-child aggregate,
+        200 feedback."""
+        import aiohttp
+
+        port, _ = sdk_server
+
+        async def run():
+            out = {}
+            async with aiohttp.ClientSession() as s:
+                body = {"data": {"names": [], "ndarray": [[7.0, 8.0]]}}
+                async with s.post(
+                    f"http://127.0.0.1:{port}/transform-input", json=body
+                ) as r:
+                    out["ti"] = await r.json()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/route", json=body
+                ) as r:
+                    out["route"] = await r.json()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/aggregate",
+                    json={"seldonMessages": [
+                        {"data": {"ndarray": [[1.0]]}},
+                        {"data": {"ndarray": [[2.0]]}},
+                    ]},
+                ) as r:
+                    out["agg"] = await r.json()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/send-feedback",
+                    json={"reward": 1.0},
+                ) as r:
+                    out["fb_status"] = r.status
+            return out
+
+        out = asyncio.run(run())
+        assert out["ti"]["data"]["ndarray"] == [[7.0, 8.0]]  # identity
+        assert out["route"]["data"]["ndarray"] == [[0.0]]
+        assert out["agg"]["data"]["ndarray"] == [[1.0]]  # first child
+        assert out["fb_status"] == 200
+
+    def test_engine_graph_with_sdk_child_metrics_passthrough(
+        self, sdk_server
+    ):
+        """The SDK component as a REMOTE graph child: engine predict
+        end-to-end, tags merged into response meta, custom metrics landing
+        in the ENGINE's Prometheus registry (the reference
+        CustomMetricsManager passthrough)."""
+        from seldon_core_tpu.graph.engine import GraphEngine
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.serving.client import RemoteComponent
+        from seldon_core_tpu.utils.metrics import EngineMetrics
+
+        port, _ = sdk_server
+        metrics = EngineMetrics()
+        eng = GraphEngine(
+            {"name": "cpp", "type": "MODEL",
+             "endpoint": {"service_host": "127.0.0.1",
+                          "service_port": port, "type": "REST"}},
+            resolver=lambda u: RemoteComponent(
+                f"http://127.0.0.1:{port}", name=u.name
+            ),
+            metrics_sink=metrics,
+        )
+
+        async def run():
+            return await eng.predict(
+                SeldonMessage.from_ndarray(np.asarray([[2.0, 3.0]]))
+            )
+
+        out = asyncio.run(run())
+        np.testing.assert_allclose(
+            np.asarray(out.host_data()), [[4.0, 6.0]]
+        )
+        assert out.meta.tags["model"] == "sdk-doubler"
+        assert "sdk_predict_calls_total" in metrics.render()
+
+    def test_bad_body_is_400(self, sdk_server):
+        import aiohttp
+
+        port, _ = sdk_server
+
+        async def run():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/predict",
+                    json={"strData": "not a tensor"},
+                ) as r:
+                    return r.status, await r.json()
+
+        status, body = asyncio.run(run())
+        assert status == 400
+        assert body["status"]["status"] == "FAILURE"
+
+
+class TestSdkFramed:
+    def test_framed_predict_roundtrip(self, sdk_server):
+        """The Python framed client against the C++ SDK's framed listener:
+        encode → SELF frame → doubled f64 tensor + meta back."""
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.serving.framed import AsyncFramedClient
+
+        _, fport = sdk_server
+
+        async def run():
+            client = await AsyncFramedClient().connect("127.0.0.1", fport)
+            try:
+                out = await client.predict(
+                    SeldonMessage(
+                        data=np.asarray([[1.0, 2.5], [-3.0, 0.5]]),
+                        encoding="ndarray",
+                    )
+                )
+            finally:
+                client.close()
+            return out
+
+        out = asyncio.run(run())
+        np.testing.assert_allclose(
+            np.asarray(out.host_data()), [[2.0, 5.0], [-6.0, 1.0]]
+        )
+        assert out.meta.tags["model"] == "sdk-doubler"
+
+    def test_framed_f32_request_widens(self, sdk_server):
+        from seldon_core_tpu.messages import SeldonMessage
+        from seldon_core_tpu.serving.framed import AsyncFramedClient
+
+        _, fport = sdk_server
+
+        async def run():
+            client = await AsyncFramedClient().connect("127.0.0.1", fport)
+            try:
+                return await client.predict(
+                    SeldonMessage(
+                        data=np.asarray([[1.5, -2.0]], np.float32),
+                        encoding="ndarray",
+                    )
+                )
+            finally:
+                client.close()
+
+        out = asyncio.run(run())
+        np.testing.assert_allclose(np.asarray(out.host_data()), [[3.0, -4.0]])
