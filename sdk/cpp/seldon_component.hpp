@@ -524,7 +524,11 @@ inline std::string frame_encode(uint8_t msg_type, const std::string &meta,
     uint8_t *pay = p + payload_off;
     for (uint64_t r = 0; r < rows; r++)
       for (uint64_t j = 0; j < cols; j++) {
-        double v = m.rows[(size_t)r][(size_t)j];
+        // ragged user output must not read past a short row (the REST
+        // serializer tolerates ragged rows; the tensor wire cannot) —
+        // missing cells go out as 0.0
+        const std::vector<double> &row = m.rows[(size_t)r];
+        double v = j < row.size() ? row[(size_t)j] : 0.0;
         memcpy(pay + (r * cols + j) * 8, &v, 8);
       }
   }

@@ -411,12 +411,15 @@ def main(argv: Optional[list] = None) -> None:
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--firehose",
                     choices=["none", "jsonl", "segmented", "memory",
-                             "network"],
+                             "network", "kafka"],
                     default="none")
     ap.add_argument("--firehose-dir", default="./firehose")
-    ap.add_argument("--firehose-target", default="127.0.0.1:7788",
+    ap.add_argument("--firehose-target", default="",
                     help="broker host:port for --firehose network "
-                         "(gateway/firehose_net.py)")
+                         "(default 127.0.0.1:7788, gateway/firehose_net.py)"
+                         " or kafka bootstrap for --firehose kafka "
+                         "(default 127.0.0.1:9092, "
+                         "gateway/firehose_kafka.py)")
     ap.add_argument("--token-spill", default="")
     args = ap.parse_args(argv)
 

@@ -223,4 +223,11 @@ def make_firehose(kind: str = "", base_dir: Optional[str] = None,
         from seldon_core_tpu.gateway.firehose_net import NetworkFirehose
 
         return NetworkFirehose(target or "127.0.0.1:7788")
+    if kind == "kafka":
+        # REAL Kafka wire protocol (topic = client id), so existing Kafka
+        # consumer pipelines ingest the firehose directly — reference
+        # KafkaRequestResponseProducer parity (gateway/firehose_kafka.py)
+        from seldon_core_tpu.gateway.firehose_kafka import KafkaFirehose
+
+        return KafkaFirehose(bootstrap=target or "127.0.0.1:9092")
     return NullFirehose()

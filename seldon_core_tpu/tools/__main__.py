@@ -28,7 +28,9 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("-v", "--verbose", action="store_true", help="print responses")
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The CLI surface, exposed for drift-locking (packaging templates
+    embed these flags; tests parse them against this parser)."""
     ap = argparse.ArgumentParser(prog="seldon-tools")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -85,8 +87,11 @@ def main(argv=None) -> int:
                     help="keep polling for new records (tail -f)")
     ft.add_argument("--poll-interval", type=float, default=1.0)
     ft.add_argument("--token", default="", help="broker shared secret")
+    return ap
 
-    args = ap.parse_args(argv)
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
 
     if args.cmd == "firehose-tail":
         import time as _time
