@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Optional
 
+from seldon_core_tpu.gateway.firehose import _safe_client_id
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["KafkaFirehose", "crc32c"]
@@ -227,8 +229,6 @@ class KafkaFirehose:
     # -- sink protocol ---------------------------------------------------
     def publish(self, client_id: str, request: dict,
                 response: dict) -> None:
-        from seldon_core_tpu.gateway.firehose import _safe_client_id
-
         rec = json.dumps({
             "client": client_id, "request": request, "response": response,
             "ts": time.time(),
@@ -269,19 +269,28 @@ class KafkaFirehose:
                     by_topic.setdefault(topic, []).append(rec)
                 except queue.Empty:
                     break
-            try:
-                for topic, recs in by_topic.items():
+            failed = False
+            for topic, recs in by_topic.items():
+                if failed:
+                    # connection already torn down this window: this
+                    # topic's records are dropped, not re-tried (fire and
+                    # forget; the bus must never build unbounded state)
+                    self.stats["dropped"] += len(recs)
+                    continue
+                try:
                     self._produce(topic, recs)
-                backoff = 0.2
-            except (OSError, struct.error) as e:
-                self.stats["errors"] += 1
-                self.stats["dropped"] += sum(
-                    len(v) for v in by_topic.values()
-                )
-                logger.warning("kafka firehose produce failed: %s", e)
-                self._disconnect()
+                except (OSError, struct.error) as e:
+                    failed = True
+                    self.stats["errors"] += 1
+                    self.stats["dropped"] += len(recs)  # per-topic: earlier
+                    # topics in this window already counted as published
+                    logger.warning("kafka firehose produce failed: %s", e)
+                    self._disconnect()
+            if failed:
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 5.0)
+            else:
+                backoff = 0.2
 
     def _disconnect(self) -> None:
         if self._sock is not None:
@@ -290,6 +299,9 @@ class KafkaFirehose:
             except OSError:
                 pass
             self._sock = None
+        # a reconnect may be talking to a restarted broker with wiped
+        # state: re-prime Metadata (and topic auto-creation) per topic
+        self._known_topics.clear()
 
     def _roundtrip(self, payload: bytes) -> bytes:
         if self._sock is None:
@@ -332,6 +344,11 @@ class KafkaFirehose:
         err = parse_produce_response(frame)
         if err != 0:
             self.stats["errors"] += 1
+            self.stats["dropped"] += len(values)
+            # forget the topic so the next batch re-primes Metadata —
+            # UNKNOWN_TOPIC_OR_PARTITION after a broker state wipe heals
+            # via re-triggered auto-creation instead of failing forever
+            self._known_topics.discard(topic)
             logger.warning(
                 "kafka produce to %s returned error code %d", topic, err
             )

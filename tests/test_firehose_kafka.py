@@ -31,10 +31,10 @@ class FakeKafkaBroker:
     deviation raises, recorded in ``self.errors`` and failed by the test.
     Collects decoded record values per topic in ``self.topics``."""
 
-    def __init__(self):
+    def __init__(self, port: int = 0):
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("127.0.0.1", 0))
+        self.sock.bind(("127.0.0.1", port))
         self.sock.listen(8)
         self.port = self.sock.getsockname()[1]
         self.topics: dict[str, list] = {}
@@ -278,17 +278,7 @@ class TestKafkaWire:
             # the sink must reconnect... to a NEW broker on the same port
             port = broker.port
             broker.close()
-            b2 = FakeKafkaBroker.__new__(FakeKafkaBroker)
-            b2.sock = socket.socket()
-            b2.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            b2.sock.bind(("127.0.0.1", port))
-            b2.sock.listen(8)
-            b2.port = port
-            b2.topics, b2.metadata_topics, b2.errors = {}, [], []
-            b2._conns = []
-            b2._stop = False
-            b2._thread = threading.Thread(target=b2._serve, daemon=True)
-            b2._thread.start()
+            b2 = FakeKafkaBroker(port=port)
             try:
                 deadline = time.monotonic() + 8
                 while not b2.topics and time.monotonic() < deadline:
