@@ -848,7 +848,12 @@ bool handle_stream_chunk(sn_http_server *s, Conn *c, int32_t sid,
                     (char)n};
     st->pending_data.append(len4, 4);
     st->pending_data.append(comp.body);
-    if (!flush_stream_data(c, sid, st) && !st->flow_listed) {
+    /* park on flow_blocked only when bytes are actually BLOCKED —
+     * flush also returns false for a fully-drained mid-stream (not
+     * finished), and parking every live stream would make each
+     * WINDOW_UPDATE walk all of them for nothing */
+    if (!flush_stream_data(c, sid, st) && !st->flow_listed &&
+        !st->pending_data.empty()) {
       c->flow_blocked.push_back(sid);
       st->flow_listed = true;
     }
@@ -922,9 +927,21 @@ bool handle_stream_end(sn_http_server *s, Conn *c, int32_t sid,
       c->wbuf.append(head, n);
       if (!c->h1_keepalive) c->closing = true;
     } else {
-      /* ended before any chunk with an error: plain response */
-      respond_h1(c, comp.status,
-                 (const uint8_t *)comp.body.data(), comp.body.size());
+      /* ended before any chunk with an error: carry the message as a
+       * JSON error body (the tier's JSON-error contract; stream_end has
+       * no body parameter, so synthesize one) */
+      std::string info;
+      for (char ch : comp.message) {
+        if (ch == '"' || ch == '\\') { info += '\\'; info += ch; }
+        else if ((unsigned char)ch < 0x20) info += ' ';
+        else info += ch;
+      }
+      char headb[64];
+      snprintf(headb, sizeof(headb), "{\"status\":{\"code\":%d,\"info\":\"",
+               comp.status);
+      std::string body = std::string(headb) + info +
+                         "\",\"status\":\"FAILURE\"}}";
+      respond_h1(c, comp.status, (const uint8_t *)body.data(), body.size());
     }
     erase_stream(c, 0);
     if (!h1_consume(s, c)) return false; /* pipelined request */

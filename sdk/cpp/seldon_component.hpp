@@ -328,12 +328,25 @@ inline bool parse_body_matrix(const std::string &body, Matrix *m,
   return true;
 }
 
+inline std::string request_path(const std::string &head) {
+  // "METHOD SP path SP HTTP/1.1": exact path token, query stripped
+  size_t sp1 = head.find(' ');
+  if (sp1 == std::string::npos) return "";
+  size_t sp2 = head.find(' ', sp1 + 1);
+  if (sp2 == std::string::npos) return "";
+  std::string path = head.substr(sp1 + 1, sp2 - sp1 - 1);
+  size_t q = path.find('?');
+  return q == std::string::npos ? path : path.substr(0, q);
+}
+
 inline std::string dispatch_rest(Component &c, const std::string &head,
                                  const std::string &body, int *status) {
   *status = 200;
-  auto is = [&head](const char *route) {
-    return head.rfind(std::string("POST ") + route, 0) == 0;
-  };
+  // EXACT path match: prefix matching would route /predictions (an easy
+  // external-API misconfiguration) into predict() instead of 404
+  const std::string path = request_path(head);
+  const bool is_post = head.rfind("POST ", 0) == 0;
+  auto is = [&](const char *route) { return is_post && path == route; };
   Matrix in;
   std::string err;
   if (is("/predict") || is("/transform-input") || is("/transform-output")) {
@@ -590,9 +603,10 @@ inline void rest_conn(Component &c, int cfd) {
   setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::string head, body, carry;
   while (recv_http(cfd, &head, &body, &carry)) {
-    if (head.rfind("GET /health/status", 0) == 0 ||
-        head.rfind("GET /health/ping", 0) == 0 ||
-        head.rfind("GET /ready", 0) == 0) {
+    const std::string gp = request_path(head);
+    if (head.rfind("GET ", 0) == 0 &&
+        (gp == "/health/status" || gp == "/health/ping" ||
+         gp == "/ready")) {
       send_http(cfd, 200, "ok", "text/plain");
       continue;
     }

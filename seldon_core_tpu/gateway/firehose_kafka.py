@@ -210,7 +210,10 @@ class KafkaFirehose:
                  client_id: str = "seldon-gateway",
                  topic_prefix: str = "", max_queue: int = 10000,
                  flush_interval_s: float = 0.05):
-        host, _, port = bootstrap.rpartition(":")
+        if ":" in bootstrap:
+            host, _, port = bootstrap.rpartition(":")
+        else:
+            host, port = bootstrap, ""  # host-only: default port
         self._addr = (host or "127.0.0.1", int(port or 9092))
         self._client_id = client_id
         self._prefix = topic_prefix

@@ -749,7 +749,15 @@ class LLMEngine:
                 ):
                     self._auto_touch(auto)
                     pref = auto
-            chunking = self.chunk_prefill and L0 > self.chunk_prefill
+            # ring takes precedence over chunking for ring-eligible
+            # buckets: chunked prefill exists to bound per-program work on
+            # ONE chip, but a ring-eligible prompt prefills
+            # sequence-parallel (per-device work L/tp) — chunking it into
+            # small dense buckets would silently disable the
+            # sequence-parallel path the operator asked for
+            use_ring = self._ring_eligible(_bucket(L0))
+            chunking = (self.chunk_prefill and L0 > self.chunk_prefill
+                        and not use_ring)
             if pref is not None and pref["len"] == L0:
                 # whole prompt is a registered prefix: zero model work
                 logits = pref["logits"]

@@ -416,6 +416,21 @@ class NativeRestServer:
         self._observe(t0, code)
         return (code, msg.to_json().encode(), None)
 
+    def _sse_bytes(self, event) -> bytes:
+        """One SSE event: merge a ``metrics`` key into the registry
+        (aiohttp-tier semantics) and serialize."""
+        from seldon_core_tpu.runtime.component import validate_metrics
+
+        if isinstance(event, dict) and event.get("metrics") \
+                and self.metrics is not None:
+            try:
+                self.metrics.merge_custom(
+                    self.name, validate_metrics(event["metrics"])
+                )
+            except Exception:
+                logger.warning("ignoring malformed stream-event metrics")
+        return b"data: " + json.dumps(event).encode() + b"\n\n"
+
     async def _sse(self, path: str, body: bytes, t0: float):
         """SSE streaming over the native h1 server (chunked
         Transfer-Encoding) — serving/rest.py's _sse_stream semantics: the
@@ -424,24 +439,34 @@ class NativeRestServer:
         error responses instead of an HTTP 200 with an error event;
         mid-stream errors become an ``error`` event; stream-event
         ``metrics`` keys merge into the Prometheus registry."""
-        from seldon_core_tpu.runtime.component import (
-            SeldonComponentError,
-            validate_metrics,
-        )
+        from seldon_core_tpu.runtime.component import SeldonComponentError
 
+        _EMPTY = object()  # a first event of literal None must still emit
         stream_fn = self._stream_fns[path]
+        agen = None
         try:
             msg = _parse_msg(body)
             agen = stream_fn(msg)
-            first = await agen.__anext__()
+            try:
+                first = await agen.__anext__()
+            except StopAsyncIteration:
+                first = _EMPTY
+            # serialize the first event INSIDE this scope: a failure here
+            # (unserializable event) must aclose the generator — engine
+            # slots are released by aclose, not GC — and map to a real
+            # JSON error, since no headers are on the wire yet
+            first_bytes = (
+                b"" if first is _EMPTY else self._sse_bytes(first)
+            )
         except _BadRequest as e:
             self._observe(t0, 400)
+            if agen is not None:
+                await agen.aclose()
             return (400, _fail_json(400, str(e)), None)
-        except StopAsyncIteration:
-            first = None
-            agen = None
         except SeldonComponentError as e:
             self._observe(t0, e.status_code)
+            if agen is not None:
+                await agen.aclose()
             return (
                 e.status_code if 400 <= e.status_code < 600 else 500,
                 _fail_json(e.status_code, str(e), e.reason), None,
@@ -449,27 +474,18 @@ class NativeRestServer:
         except Exception as e:
             logger.exception("native stream failed before first event")
             self._observe(t0, 500)
+            if agen is not None:
+                await agen.aclose()
             return (500, _fail_json(500, f"{type(e).__name__}: {e}"), None)
 
-        def _sse_bytes(event) -> bytes:
-            if isinstance(event, dict) and event.get("metrics") \
-                    and self.metrics is not None:
-                try:
-                    self.metrics.merge_custom(
-                        self.name, validate_metrics(event["metrics"])
-                    )
-                except Exception:
-                    logger.warning("ignoring malformed stream-event metrics")
-            return b"data: " + json.dumps(event).encode() + b"\n\n"
-
         async def chunks():
-            if first is not None:
-                yield _sse_bytes(first)
-            if agen is None:
-                return
+            if first_bytes:
+                yield first_bytes
             try:
+                if first is _EMPTY:
+                    return
                 async for event in agen:
-                    yield _sse_bytes(event)
+                    yield self._sse_bytes(event)
             except asyncio.CancelledError:
                 raise
             except Exception as e:

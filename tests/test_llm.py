@@ -1910,3 +1910,21 @@ class TestRingPrefill:
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
         assert stats["rounds"] >= 1
         assert free == 32
+
+    def test_ring_takes_precedence_over_chunked_prefill(self):
+        """chunk_prefill must not silently disable the sequence-parallel
+        path: a ring-eligible prompt prefills ring (one seq-sharded
+        program), not in small dense chunks."""
+        pr = prompt(48, seed=26)
+        eng = self._engine(chunk_prefill=8)
+
+        async def run():
+            return await eng.generate(pr, 5)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, pr, 5, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        # the ring program for bucket 64 was built; no 8-token chunk
+        # extend programs were
+        assert 64 in eng._prefills
+        assert not eng._extends
