@@ -943,3 +943,34 @@ class TestNativeStreaming:
         assert events[0] == {"token": 1, "i": 0}
         assert "decode exploded" in events[1]["error"]
         assert 'code="500"' in reg.render()
+
+    def test_h1_stream_end_error_before_chunks_carries_json_body(self):
+        """stream_end with an error status before any chunk must answer a
+        JSON error body (the tier's error contract), not an empty 500 —
+        driven at the C API level since the Python router maps first-event
+        failures to unary responses."""
+        import aiohttp
+
+        srv_box = {}
+
+        def submit(token, method, path, body):
+            # answer as a stream that dies before its first chunk
+            srv_box["srv"].stream_end(token, 500, 'boom "quoted"')
+
+        srv = NativeHttpServer(submit=submit, http2=False).start()
+        srv_box["srv"] = srv
+
+        async def run():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{srv.port}/anything", json={}
+                ) as r:
+                    return r.status, await r.json()
+
+        try:
+            status, body = asyncio.run(run())
+        finally:
+            srv.stop()
+        assert status == 500
+        assert body["status"]["status"] == "FAILURE"
+        assert 'boom "quoted"' in body["status"]["info"]
