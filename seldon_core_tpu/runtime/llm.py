@@ -28,6 +28,7 @@ No reference counterpart (Seldon Core predates LLM serving; SURVEY.md §5.7
 from __future__ import annotations
 
 import asyncio
+import logging
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional
@@ -44,6 +45,8 @@ from seldon_core_tpu.models.transformer import (
 )
 
 __all__ = ["LLMEngine", "PagedLLMEngine", "LLMComponent"]
+
+logger = logging.getLogger(__name__)
 
 
 def _bucket(n: int) -> int:
@@ -720,11 +723,11 @@ class LLMEngine:
             return
         slot = await self._acquire_slot()
         try:
-            # capacity hook (no-op here): PagedLLMEngine reserves KV pages
-            # for the request's worst case, waiting if the pool is empty
-            await self._reserve_capacity(slot, L0, n_new)
             # prefix set is re-checked AFTER slot acquisition: a prefix may
-            # have been registered while this request waited in the queue
+            # have been registered while this request waited in the queue.
+            # Resolution happens BEFORE the capacity reservation so the
+            # paged engine can reserve only the post-alias need — a shared
+            # prefix must reduce page demand AT ADMISSION, not after.
             if (self._prefixes or self._auto_budget) and host_ids is None:
                 # device-resident caller: fetch OFF the event loop — a
                 # blocking device→host round trip here would stall every
@@ -749,6 +752,15 @@ class LLMEngine:
                 ):
                     self._auto_touch(auto)
                     pref = auto
+            # alias hook (no-op here): the paged engine pins the prefix's
+            # SHARED pages for this admission (refcount taken NOW, before
+            # any await — a concurrent clear_prefixes must not recycle
+            # pages this admission is about to alias)
+            self._note_prefix(slot, pref)
+            # capacity hook (no-op here): PagedLLMEngine reserves KV pages
+            # for the request's worst case MINUS the aliased prefix pages,
+            # waiting if the pool is empty
+            await self._reserve_capacity(slot, L0, n_new)
             # ring takes precedence over chunking for ring-eligible
             # buckets: chunked prefill exists to bound per-program work on
             # ONE chip, but a ring-eligible prompt prefills
@@ -881,6 +893,10 @@ class LLMEngine:
     async def _reserve_capacity(self, slot: int, L0: int, n_new: int) -> None:
         """Capacity admission hook — the slab engine's capacity IS the slot
         (max_slots x max_len rows preallocated), so nothing to do."""
+
+    def _note_prefix(self, slot: int, pref) -> None:
+        """Prefix-aliasing hook — the slab engine always copies prefix KV
+        into the slot, so nothing to do (PagedLLMEngine overrides)."""
 
     async def _acquire_slot(self) -> int:
         """FIFO slot admission — waiters are woken in arrival order by
@@ -1104,9 +1120,17 @@ class PagedLLMEngine(LLMEngine):
         self._reserved: dict[int, list] = {}
         self._step_paged = jax.jit(self._paged_step_impl)
         self._insert_rows = jax.jit(
-            insert_rows, static_argnames=("true_len",)
+            insert_rows, static_argnames=("true_len", "start")
         )
         self._insert = self._paged_insert
+        # shared-prefix aliasing (vLLM prefix-caching design): a
+        # registered prefix's full pages are held ONCE in the pool and
+        # every admission that hits it points its page table at them —
+        # per-slot state while an aliased request is active (refcount
+        # taken at note time, released with the slot):
+        self._alias_used: dict[int, dict] = {}  # slot -> entry
+        self._retired_prefixes: list[dict] = []
+        self._pinned_pages = 0  # total pages held by shared prefixes
 
     # -- cache plumbing overrides ---------------------------------------
     def _init_cache(self, cache_len: int):
@@ -1158,21 +1182,107 @@ class PagedLLMEngine(LLMEngine):
 
     def _paged_insert(self, cache, small, slot, true_len: int):
         ps = self.paged_cfg.page_size
-        idx = np.arange(true_len)
+        start = self._apply_alias(slot, true_len)
+        idx = np.arange(start, true_len)
         rows = self._tables[slot][idx // ps] * ps + idx % ps
         return self._insert_rows(
-            cache, small, jnp.asarray(rows, jnp.int32), true_len=true_len
+            cache, small, jnp.asarray(rows, jnp.int32), true_len=true_len,
+            start=start,
         )
 
-    # -- page accounting -------------------------------------------------
-    @property
-    def free_pages(self) -> int:
-        return len(self._free_pages)
+    # -- shared-prefix page aliasing -------------------------------------
+    def register_prefix(self, prefix_ids) -> None:
+        """Paged upgrade of prefix registration: besides the slab entry
+        (still needed — the suffix-extend program attends over a 1-row
+        slab), the prefix's FULL pages are materialized ONCE in the pool;
+        admissions that hit the prefix alias their page tables onto them
+        instead of copying (`_apply_alias`) — prefix KV costs page memory
+        once regardless of how many requests share it, and the per-
+        admission insert copies only the suffix rows.  Byte-exact: an
+        aliased page holds the identical bytes a copy would."""
+        ids = tuple(int(t) for t in np.asarray(prefix_ids).reshape(-1))
+        old = self._prefixes.get(ids)
+        super().register_prefix(prefix_ids)
+        if old is not None and old.get("shared_pages"):
+            # re-registration replaced the entry: the OLD pinned pages
+            # must not leak — free now, or retire if admissions still
+            # attend over them
+            if old.get("refs", 0) > 0:
+                self._retired_prefixes.append(old)
+            else:
+                self._free_pages.extend(old["shared_pages"])
+                self._pinned_pages -= len(old["shared_pages"])
+                old["shared_pages"] = []
+        entry = self._prefixes[ids]
+        ps = self.paged_cfg.page_size
+        full = entry["len"] // ps
+        if full == 0:
+            return  # shorter than a page: nothing shareable
+        usable = self.paged_cfg.n_pages - 1
+        if (
+            len(self._free_pages) < full
+            or self._page_waiters  # never jump the FIFO reservation queue
+            # pinning must preserve the init-time invariant that one
+            # max-length request stays admissible — otherwise a waiter
+            # needing max_pp pages can NEVER be satisfied and the strict
+            # FIFO queue wedges behind it forever
+            or usable - (self._pinned_pages + full) < self.max_pp
+        ):
+            logger.warning(
+                "prefix of %d tokens needs %d pages to share; pool cannot "
+                "pin them without starving admissions — falling back to "
+                "per-request copies",
+                entry["len"], full,
+            )
+            return
+        pages = [self._free_pages.pop() for _ in range(full)]
+        self._pinned_pages += full
+        idx = np.arange(full * ps)
+        rows = np.asarray(pages, np.int64)[idx // ps] * ps + idx % ps
+        self.cache = self._insert_rows(
+            self.cache, {"k": entry["k"], "v": entry["v"]},
+            jnp.asarray(rows, jnp.int32), true_len=full * ps,
+        )
+        entry["shared_pages"] = pages
+        entry["refs"] = 0
+
+    def clear_prefixes(self) -> None:
+        """Paged upgrade: shared pages return to the pool — immediately
+        when idle, or when the last in-flight aliased request releases
+        (refcounted retirement; recycling a page mid-attention would
+        corrupt another request's context)."""
+        for entry in self._prefixes.values():
+            pages = entry.get("shared_pages")
+            if not pages:
+                continue
+            if entry.get("refs", 0) > 0:
+                self._retired_prefixes.append(entry)
+            else:
+                self._free_pages.extend(pages)
+                self._pinned_pages -= len(pages)
+                entry["shared_pages"] = []
+        super().clear_prefixes()
+        self._wake_page_waiters()
+
+    def _note_prefix(self, slot: int, pref) -> None:
+        """Pin the winning shared-page prefix for this admission: the
+        refcount is taken NOW — before the capacity reservation awaits —
+        so a concurrent clear_prefixes retires (defers) instead of
+        recycling pages this admission is about to alias."""
+        if pref is not None and pref.get("shared_pages"):
+            pref["refs"] = pref.get("refs", 0) + 1
+            self._alias_used[slot] = pref
 
     async def _reserve_capacity(self, slot: int, L0: int, n_new: int) -> None:
+        """Aliased admissions reserve only the POST-alias need: the
+        prefix's pages are already pinned, so a shared prefix reduces
+        page demand at admission, not just after the insert."""
+        entry = self._alias_used.get(slot)
+        shared = len(entry["shared_pages"]) if entry is not None else 0
         need = self.paged_cfg.pages_for(L0 + n_new + self._headroom)
-        # (stream() bounds L0+n_new <= max_len; init guarantees the pool
-        # holds max_len + speculative headroom)
+        # at least the rows beyond the shared region need owned pages
+        # (L0 >= shared*ps and n_new >= 1 guarantee need > shared)
+        need -= min(shared, need)
         if not self._page_waiters and len(self._free_pages) >= need:
             pages = [self._free_pages.pop() for _ in range(need)]
         else:
@@ -1197,7 +1307,36 @@ class PagedLLMEngine(LLMEngine):
                 raise
         self._reserved[slot] = pages
         self._tables[slot, :] = 0
-        self._tables[slot, :need] = pages
+        # owned pages at their FINAL positions (after the shared region);
+        # the shared pages themselves are mapped only at INSERT time
+        # (_apply_alias, inside the no-await section): between reserve and
+        # insert, decode ticks still step this slot at pos 0, and with the
+        # table's slot 0 unmapped that write lands in the trash page — a
+        # reserve-time shared mapping would let it scribble the shared
+        # prefix page's first row for EVERY user of the prefix
+        self._tables[slot, shared:shared + need] = pages
+
+    def _apply_alias(self, slot: int, true_len: int) -> int:
+        """Map the aliased prefix's shared pages into the slot's table and
+        return the row offset the insert starts at (rows below it live in
+        the shared pages).  Runs inside the insert's no-await section —
+        the very next tick dispatch sees the full mapping together with
+        pos = L0.  0 when not aliased."""
+        entry = self._alias_used.get(slot)
+        if entry is None or not entry.get("shared_pages"):
+            return 0
+        full = min(
+            len(entry["shared_pages"]), true_len // self.paged_cfg.page_size
+        )
+        if full == 0:
+            return 0
+        self._tables[slot, :full] = entry["shared_pages"][:full]
+        return full * self.paged_cfg.page_size
+
+    # -- page accounting -------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
 
     def _wake_page_waiters(self) -> None:
         while self._page_waiters:
@@ -1213,14 +1352,28 @@ class PagedLLMEngine(LLMEngine):
 
     def _release_slot(self, slot: int) -> None:
         pages = self._reserved.pop(slot, None)
+        # always unmap: an aliased slot's table points at SHARED pages
+        # even when its owned list is empty
+        self._tables[slot, :] = 0
         if pages:
-            self._tables[slot, :] = 0
             self._free_pages.extend(pages)
+        entry = self._alias_used.pop(slot, None)
+        if entry is not None:
+            entry["refs"] -= 1
+            # identity-based membership: dict equality over the entry's
+            # jnp arrays would raise (same hazard as the auto-prefix LRU)
+            retired = any(e is entry for e in self._retired_prefixes)
+            if entry["refs"] == 0 and retired:
+                self._retired_prefixes[:] = [
+                    e for e in self._retired_prefixes if e is not entry
+                ]
+                self._free_pages.extend(entry["shared_pages"])
+                self._pinned_pages -= len(entry["shared_pages"])
+                entry["shared_pages"] = []
         # inactive slots' ticks write to the trash page at offset 0
         self._pos[slot] = 0
         super()._release_slot(slot)
-        if pages:
-            self._wake_page_waiters()
+        self._wake_page_waiters()
 
 
 class LLMComponent:

@@ -331,18 +331,25 @@ def paged_chunk_step(params, cache, tables, pos, toks,
     return logits, cache
 
 
-def insert_rows(cache, small, rows, true_len: int):
-    """Scatter a 1-row prefill cache's first ``true_len`` K/V rows into the
-    paged cache at flat rows ``rows`` ((true_len,) int32, page*ps+offset).
-    ``small`` k/v: (layers, 1, bucket, H, Dh) from prefill/extend."""
+def insert_rows(cache, small, rows, true_len: int, start: int = 0):
+    """Scatter a 1-row prefill cache's K/V rows ``start..true_len`` into
+    the paged cache at flat rows ``rows`` ((true_len - start,) int32,
+    page*ps+offset).  ``small`` k/v: (layers, 1, bucket, H, Dh) from
+    prefill/extend.  ``start > 0`` is the SHARED-PREFIX alias path: rows
+    below ``start`` live in shared prefix pages the slot's table points
+    at, so only the suffix is copied."""
     L, Hkv = cache["k"].shape[0], cache["k"].shape[1]
     Dh = cache["k"].shape[4]
     n_pages, ps = cache["k"].shape[2], cache["k"].shape[3]
     kf = cache["k"].reshape(L, Hkv, n_pages * ps, Dh)
     vf = cache["v"].reshape(L, Hkv, n_pages * ps, Dh)
-    # (layers, 1, bucket, H, Dh) -> (layers, H, true_len, Dh)
-    ks = small["k"][:, 0, :true_len].transpose(0, 2, 1, 3).astype(kf.dtype)
-    vs = small["v"][:, 0, :true_len].transpose(0, 2, 1, 3).astype(vf.dtype)
+    # (layers, 1, bucket, H, Dh) -> (layers, H, true_len - start, Dh)
+    ks = small["k"][:, 0, start:true_len].transpose(0, 2, 1, 3).astype(
+        kf.dtype
+    )
+    vs = small["v"][:, 0, start:true_len].transpose(0, 2, 1, 3).astype(
+        vf.dtype
+    )
     kf = kf.at[:, :, rows, :].set(ks)
     vf = vf.at[:, :, rows, :].set(vs)
     return {

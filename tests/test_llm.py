@@ -1928,3 +1928,206 @@ class TestRingPrefill:
         # extend programs were
         assert 64 in eng._prefills
         assert not eng._extends
+
+
+class TestPagedSharedPrefix:
+    """Shared-prefix PAGE ALIASING (vLLM prefix-caching design): a
+    registered prefix's full pages live ONCE in the pool and every
+    admission that hits it points its page table at them — prefix KV
+    costs page memory once regardless of concurrency, inserts copy only
+    suffix rows, and outputs stay byte-identical (aliased pages hold the
+    bytes a copy would)."""
+
+    GQA = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=64, dtype=jnp.float32,
+    )
+    GQA_PARAMS = init_params(jax.random.PRNGKey(0), GQA)
+
+    def _paged(self, n_pages=33, page_size=4, **kw):
+        from seldon_core_tpu.runtime.llm import PagedLLMEngine
+        from seldon_core_tpu.runtime.paged import PagedConfig
+
+        kw.setdefault("max_slots", 4)
+        kw.setdefault("max_len", 32)
+        return PagedLLMEngine(
+            self.GQA_PARAMS, self.GQA,
+            PagedConfig(n_pages=n_pages, page_size=page_size), **kw
+        )
+
+    def test_aliased_requests_share_pages_and_stay_exact(self):
+        pre = prompt(16, seed=11)  # 4 full pages at page_size 4
+        suf = prompt(5, seed=12)
+        full = jnp.concatenate([pre, suf], axis=1)
+        eng = self._paged()
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+        assert eng.free_pages == base - 4  # prefix pinned ONCE
+
+        async def run():
+            return await asyncio.gather(*[
+                eng.generate(np.asarray(full).reshape(-1), 5)
+                for _ in range(3)
+            ])
+
+        outs = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, full, 5, self.GQA)
+        for o in outs:
+            np.testing.assert_array_equal(np.asarray(o), np.asarray(ref))
+        # all owned pages returned; shared pages still pinned
+        assert eng.free_pages == base - 4
+        eng.clear_prefixes()
+        assert eng.free_pages == base
+
+    def test_partial_page_boundary_copies_remainder(self):
+        """A prefix that doesn't end on a page boundary shares only its
+        full pages; the remainder rows copy into slot-owned pages — still
+        exact."""
+        pre = prompt(18, seed=13)  # 4 full pages + 2 remainder rows
+        suf = prompt(3, seed=14)
+        full = jnp.concatenate([pre, suf], axis=1)
+        eng = self._paged()
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+        assert eng.free_pages == base - 4  # only FULL pages pinned
+
+        async def run():
+            return await eng.generate(np.asarray(full).reshape(-1), 4)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, full, 4, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert eng.free_pages == base - 4
+
+    def test_clear_prefixes_mid_flight_defers_until_release(self):
+        """Retiring a prefix while an aliased request is in flight must
+        not recycle its pages under the request's attention — pages free
+        when the last user releases."""
+        pre = prompt(16, seed=15)
+        suf = prompt(4, seed=16)
+        full = jnp.concatenate([pre, suf], axis=1)
+        eng = self._paged()
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+
+        async def run():
+            agen = eng.stream(np.asarray(full).reshape(-1), 6)
+            toks = [await agen.__anext__()]
+            eng.clear_prefixes()  # mid-flight: refs > 0 -> deferred
+            # shared 4 pages still pinned AND the in-flight request holds
+            # its owned tail: need = ceil((20+6)/4) = 7 minus 4 aliased
+            assert eng.free_pages == base - 4 - 3
+            async for t in agen:
+                toks.append(t)
+            return toks
+
+        toks = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, full, 6, self.GQA)
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(ref)[0, full.shape[1]:]
+        )
+        assert eng.free_pages == base  # freed at release
+
+    def test_composes_with_speculation(self):
+        DRAFT = TransformerConfig(
+            vocab_size=64, d_model=16, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=32, max_seq=64, dtype=jnp.float32,
+        )
+        pre = prompt(16, seed=17)
+        suf = prompt(4, seed=18)
+        full = jnp.concatenate([pre, suf], axis=1)
+        eng = self._paged(
+            draft_params=init_params(jax.random.PRNGKey(9), DRAFT),
+            draft_cfg=DRAFT, k_draft=3,
+        )
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+
+        async def run():
+            return await eng.generate(np.asarray(full).reshape(-1), 6)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, full, 6, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert eng.spec_stats["rounds"] >= 1
+        eng.clear_prefixes()
+        assert eng.free_pages == base
+
+    def test_pool_too_tight_falls_back_to_copies(self):
+        """A pool that can't pin the prefix still serves (copy-based) —
+        registration degrades, never starves admissions."""
+        eng = self._paged(n_pages=10, page_size=4, max_len=16)
+        pre = prompt(8, seed=19)
+        # usable 9 pages; max_pp = 4 -> pinning 2 would leave 7 (fine),
+        # so shrink further: fill the pool first
+        eng._free_pages = eng._free_pages[:1]
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+        assert eng.free_pages == base  # nothing pinned
+        ids = tuple(int(t) for t in np.asarray(pre).reshape(-1))
+        assert "shared_pages" not in eng._prefixes[ids]
+
+    def test_alias_shrinks_admission_demand(self):
+        """The reservation itself must shrink: two aliased requests run
+        CONCURRENTLY in a pool that could not hold two full copies (the
+        win the sharing exists for — capacity, not just copy bytes)."""
+        # usable 12 pages (ps 4): prefix pins 4 -> 8 free; each aliased
+        # request needs ceil((20+12)/4) - 4 = 4 owned pages, so TWO fit
+        # at once (copy-based need would be 8 each: strictly serialized)
+        eng = self._paged(n_pages=13, page_size=4, max_slots=4, max_len=32)
+        pre = prompt(16, seed=21)
+        sufa, sufb = prompt(4, seed=22), prompt(4, seed=23)
+        fa = jnp.concatenate([pre, sufa], axis=1)
+        fb = jnp.concatenate([pre, sufb], axis=1)
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+
+        async def run():
+            a = eng.stream(np.asarray(fa).reshape(-1), 12)
+            ta = [await a.__anext__()]
+            b = eng.stream(np.asarray(fb).reshape(-1), 12)
+            tb = [await b.__anext__()]
+            # both admitted and active at once
+            assert len(eng._slots) == 2
+            assert eng.free_pages == 0  # 4 shared + 2x4 owned = 12
+            async for t in a:
+                ta.append(t)
+            async for t in b:
+                tb.append(t)
+            return ta, tb
+
+        ta, tb = asyncio.run(run())
+        ra = generate(self.GQA_PARAMS, fa, 12, self.GQA)
+        rb = generate(self.GQA_PARAMS, fb, 12, self.GQA)
+        np.testing.assert_array_equal(np.asarray(ta), np.asarray(ra)[0, 20:])
+        np.testing.assert_array_equal(np.asarray(tb), np.asarray(rb)[0, 20:])
+
+    def test_reregistration_does_not_leak_pinned_pages(self):
+        eng = self._paged()
+        pre = prompt(16, seed=24)
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+        assert eng.free_pages == base - 4
+        eng.register_prefix(np.asarray(pre).reshape(-1))  # idempotent-ish
+        assert eng.free_pages == base - 4  # OLD pages freed, new pinned
+        eng.clear_prefixes()
+        assert eng.free_pages == base
+
+    def test_pinning_never_starves_max_len_admissions(self):
+        """Pinning must preserve the init invariant that one max-length
+        request stays admissible — otherwise the strict-FIFO queue wedges
+        forever behind it."""
+        # usable 8 = max_pp exactly: ANY pinning would break the invariant
+        eng = self._paged(n_pages=9, page_size=4, max_len=32)
+        pre = prompt(16, seed=25)
+        base = eng.free_pages
+        eng.register_prefix(np.asarray(pre).reshape(-1))
+        assert eng.free_pages == base  # refused: copies instead
+        ids = tuple(int(t) for t in np.asarray(pre).reshape(-1))
+        assert not eng._prefixes[ids].get("shared_pages")
+
+        async def run():  # and a max-length request still serves
+            return await eng.generate(prompt(24, seed=26), 8)
+
+        out = asyncio.run(run())
+        ref = generate(self.GQA_PARAMS, prompt(24, seed=26), 8, self.GQA)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
