@@ -5,9 +5,15 @@ The reference boots user classes from CRD parameters
 (``wrappers/python/microservice.py:209-216``); this class makes the LLM
 stack deployable the same way — an example graph names it via the
 ``model_class`` parameter and sizes it with plain JSON parameters (see
-``examples/graphs/llm.json``).  Weights are seeded (no checkpoint
-download in examples); real deployments construct ``LLMEngine`` from an
-orbax checkpoint instead.
+``examples/graphs/llm.json``).
+
+Weights come from the ``model_uri`` parameter (a checkpoint directory,
+runtime/checkpoint.py — materialized from remote storage by the
+operator's initContainer in-cluster) when set; otherwise they are seeded
+from ``seed`` (demo/CI mode).  tp sharding and int8 quantization are
+applied at load either way, so a checkpoint-booted engine serves
+byte-identically to the seeded engine that exported it
+(tests/test_checkpoint.py).
 """
 
 from __future__ import annotations
@@ -53,43 +59,62 @@ class DemoLLM(LLMComponent):
         page_size: int = 16,
         auto_prefix_tokens: int = -1,
         ring_prefill: int = 0,
+        model_uri: str = "",
     ):
-        cfg = TransformerConfig(
-            vocab_size=vocab_size,
-            d_model=d_model,
-            n_layers=n_layers,
-            n_heads=n_heads,
-            n_kv_heads=n_kv_heads or None,
-            d_ff=d_ff,
-            max_seq=max_seq,
-            dtype=jnp.dtype(dtype),
-        )
-        params = init_params(jax.random.PRNGKey(seed), cfg)
         mesh = None
         if tp > 1:
             # tensor-parallel serving over the visible chips (the operator
             # sizes the pod via the seldon.io/tpu-chips annotation); int8
             # "full" (attention projections) stays single-chip — the
             # quantize path documents the restriction
-            from seldon_core_tpu.models.transformer import shard_params
             from seldon_core_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(n_devices=tp, tp=tp, pp=1)
-            params = shard_params(params, mesh, cfg)
             if int8 == "full":
                 raise ValueError(
                     "int8='full' (attention projections) is single-chip; "
                     "use int8='ffn' with tp>1"
                 )
-        if int8 in ("ffn", "full"):
-            params = quantize_ffn_params(params, mesh=mesh)
-        if int8 == "full":
-            params = quantize_attn_params(params)
+        if model_uri:
+            # trained weights: cfg comes from the ARTIFACT (the shape
+            # parameters above are demo-mode knobs), sharding/quantization
+            # from the deployment — one checkpoint serves every tp/int8
+            # combination
+            from seldon_core_tpu.runtime.checkpoint import (
+                load_transformer,
+                resolve_model_uri,
+            )
+
+            params, cfg = load_transformer(
+                resolve_model_uri(model_uri), mesh=mesh, int8=int8
+            )
+        else:
+            cfg = TransformerConfig(
+                vocab_size=vocab_size,
+                d_model=d_model,
+                n_layers=n_layers,
+                n_heads=n_heads,
+                n_kv_heads=n_kv_heads or None,
+                d_ff=d_ff,
+                max_seq=max_seq,
+                dtype=jnp.dtype(dtype),
+            )
+            params = init_params(jax.random.PRNGKey(seed), cfg)
+            if mesh is not None:
+                from seldon_core_tpu.models.transformer import shard_params
+
+                params = shard_params(params, mesh, cfg)
+            if int8 in ("ffn", "full"):
+                params = quantize_ffn_params(params, mesh=mesh)
+            if int8 == "full":
+                params = quantize_attn_params(params)
         if auto_prefix_tokens < 0:
             # ON by default in the serving component: real traffic shares
             # system prompts without announcing them (engine default is
-            # off so library users opt in explicitly)
-            auto_prefix_tokens = 4 * max_seq
+            # off so library users opt in explicitly).  cfg.max_seq: with
+            # model_uri the artifact's sequence length governs, not the
+            # demo-shape parameter
+            auto_prefix_tokens = 4 * cfg.max_seq
         if paged_pages > 0:
             # paged KV serving (runtime/paged.py): HBM ~ tokens in flight;
             # composes with tp (page pool shards its KV-head axis over
@@ -114,3 +139,8 @@ class DemoLLM(LLMComponent):
 
     def tags(self):
         return {"model": "demo-llm", "engine": "continuous-batching"}
+
+    def save_checkpoint(self, path: str) -> str:
+        """Export the served weights as a ``model_uri``-loadable artifact
+        (refused for int8 engines — see LLMEngine.save_checkpoint)."""
+        return self.engine.save_checkpoint(path)

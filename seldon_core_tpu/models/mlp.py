@@ -39,13 +39,32 @@ class MNISTMLP:
 
     class_names = [f"class:{i}" for i in range(10)]
 
-    def __init__(self, seed: int = 0, hidden: int = 512):
-        self.params = init_mlp_params(
-            jax.random.PRNGKey(seed), (784, hidden, hidden // 2, 10)
-        )
+    def __init__(self, seed: int = 0, hidden: int = 512, model_uri: str = ""):
+        if model_uri:
+            from seldon_core_tpu.runtime.checkpoint import (
+                load_checkpoint,
+                resolve_model_uri,
+            )
+
+            self.params, meta = load_checkpoint(resolve_model_uri(model_uri))
+            if meta.get("family") not in (None, "mlp"):
+                raise ValueError(f"model_uri holds {meta.get('family')!r},"
+                                 " not mlp weights")
+        else:
+            self.params = init_mlp_params(
+                jax.random.PRNGKey(seed), (784, hidden, hidden // 2, 10)
+            )
 
     def predict_fn(self, params, X):
         return mlp_apply(params, jnp.asarray(X, jnp.float32))
 
     def tags(self):
         return {"model": "mnist-mlp"}
+
+    def save_checkpoint(self, path: str) -> str:
+        import numpy as np
+
+        from seldon_core_tpu.runtime.checkpoint import save_checkpoint
+
+        host = jax.tree.map(np.asarray, self.params)
+        return save_checkpoint(path, host, {"family": "mlp"})

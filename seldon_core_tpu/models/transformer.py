@@ -293,6 +293,14 @@ def _has_q8(blocks: dict) -> bool:
     return any(_is_q8(v) for v in blocks.values())
 
 
+def has_quantized_params(params: dict) -> bool:
+    """Whether a whole param tree carries int8-quantized leaves — the ONE
+    definition of "is this tree quantized" (checkpoint export refusal,
+    load-path verbatim handling); lives beside _is_q8 so a layout change
+    updates every consumer at once."""
+    return _has_q8(params.get("blocks", {})) or _is_q8(params.get("lm_head"))
+
+
 def _check_q8_pipeline(params: dict, pp: int) -> None:
     """Reject quantized params on the PIPELINE path up front: the unstacked
     per-layer tuples cannot ride pipeline stages — without this check the

@@ -44,6 +44,26 @@ def engine_image() -> str:
 
     return os.environ.get("SELDON_ENGINE_IMAGE", ENGINE_IMAGE)
 
+# Model-artifact materialization (runtime/checkpoint.py model_uri): graph
+# nodes with a REMOTE model_uri parameter get their artifact downloaded
+# into an emptyDir by an initContainer before the serving container boots,
+# and the parameter rewritten to the mount path — the artifact analog of
+# the reference baking weights into the image at s2i build time
+# (``wrappers/s2i/python/s2i/bin/assemble:16-60``); a rolling update of
+# the CRD's model_uri rolls weight versions exactly like the reference's
+# image-tag rollout (``SeldonDeploymentOperatorImpl.java:642``).
+MODEL_MOUNT = "/mnt/seldon-models"
+MODEL_VOLUME = "seldon-models"
+MODEL_INITIALIZER_IMAGE = "seldon-core-tpu/model-initializer:latest"
+
+
+def model_initializer_image() -> str:
+    import os
+
+    return os.environ.get("SELDON_MODEL_INITIALIZER_IMAGE",
+                          MODEL_INITIALIZER_IMAGE)
+
+
 # v5e host topology: chips per VM host; slices larger than one host need a
 # multi-host JobSet-style rollout (emitted as replicated pods with
 # TPU_WORKER_ID env) — jax.distributed handles the rest at runtime.
@@ -115,6 +135,66 @@ NATIVE_PORT = 8500       # C++ REST tier (seldon.io/native-wire)
 NATIVE_GRPC_PORT = 5500  # C++ h2c gRPC tier
 
 
+def _remote_model_uris(p: PredictorSpec, local_only: bool = False
+                       ) -> list[tuple[str, str]]:
+    """``(unit_name, uri)`` for graph nodes whose ``model_uri`` parameter
+    is a remote artifact (scheme'd, non-file) the pod must materialize.
+    ``local_only``: restrict to nodes the ENGINE pod itself instantiates
+    (implementation / LOCAL endpoint) — in the distributed layout the
+    others are served by their own component pods."""
+    import re
+
+    out = []
+    for unit in p.graph.walk():
+        uri = unit.parameters.get("model_uri")
+        if not (isinstance(uri, str)
+                and re.match(r"^[a-z][a-z0-9+.-]*://", uri, re.IGNORECASE)
+                and not uri.startswith("file://")):
+            continue
+        if local_only and not (
+            unit.parameters.get("model_class") or unit.implementation
+            or unit.endpoint.type == "LOCAL"
+        ):
+            continue
+        out.append((unit.name, uri))
+    return out
+
+
+def _rewrite_model_uris(graph_dict: dict, names: set[str]) -> None:
+    """Point the serialized graph's ``model_uri`` parameters at the
+    initContainer mount paths (in place, on the DICT copy — the caller's
+    spec object keeps the user's remote URIs)."""
+    if graph_dict.get("name") in names:
+        for param in graph_dict.get("parameters", []) or []:
+            if param.get("name") == "model_uri":
+                param["value"] = f"{MODEL_MOUNT}/{graph_dict['name']}"
+    for child in graph_dict.get("children", []) or []:
+        _rewrite_model_uris(child, names)
+
+
+def _model_init(pod_spec: dict, container: dict,
+                uris: list[tuple[str, str]]) -> None:
+    """Mount the artifact emptyDir into ``container`` and prepend one
+    initContainer that downloads every (unit, uri) into it."""
+    if not uris:
+        return
+    pod_spec.setdefault("volumes", []).append(
+        {"name": MODEL_VOLUME, "emptyDir": {}}
+    )
+    pod_spec.setdefault("initContainers", []).append({
+        "name": "model-initializer",
+        "image": model_initializer_image(),
+        # pairwise [src dst ...] argv, matching the kfserving-style
+        # storage-initializer contract
+        "args": [a for name, uri in uris
+                 for a in (uri, f"{MODEL_MOUNT}/{name}")],
+        "volumeMounts": [{"name": MODEL_VOLUME, "mountPath": MODEL_MOUNT}],
+    })
+    container.setdefault("volumeMounts", []).append(
+        {"name": MODEL_VOLUME, "mountPath": MODEL_MOUNT}
+    )
+
+
 def _engine_env(dep: SeldonDeployment, p: PredictorSpec) -> list[dict]:
     """Graph spec handed to the engine pod as base64 JSON — parity with the
     reference's ``ENGINE_PREDICTOR`` env (``createEngineContainer:119``).
@@ -122,7 +202,11 @@ def _engine_env(dep: SeldonDeployment, p: PredictorSpec) -> list[dict]:
     ("true" → serve the C++ REST/gRPC tiers on NATIVE_PORT/NATIVE_GRPC_PORT
     beside the Python ones) and ``seldon.io/engine-workers`` (N →
     SO_REUSEPORT worker processes, serving/workers.py)."""
-    pred_json = json.dumps(p.to_dict())
+    pred = p.to_dict()
+    uris = _remote_model_uris(p, local_only=True)
+    if uris:
+        _rewrite_model_uris(pred["graph"], {n for n, _ in uris})
+    pred_json = json.dumps(pred)
     ann = {**dep.annotations, **p.annotations}
     env = [
         {"name": "ENGINE_PREDICTOR", "value": base64.b64encode(
@@ -231,6 +315,9 @@ def _colocated_predictor(
             {"containerPort": NATIVE_GRPC_PORT, "name": "grpc-native"},
         ])
     pod_spec: dict[str, Any] = {"containers": [container]}
+    # remote model artifacts materialize before the engine boots; the
+    # ENGINE_PREDICTOR env (already rewritten) points at the mount paths
+    _model_init(pod_spec, container, _remote_model_uris(p, local_only=True))
     # merge user componentSpecs (images for user-code components)
     for cs in p.component_specs:
         for c in (cs.get("spec", {}) or {}).get("containers", []) or []:
@@ -357,6 +444,19 @@ def _distributed_predictor(
     """Reference-style layout: engine Deployment + one Deployment/Service per
     graph component (``createResources:580-735``)."""
     out: list[dict] = []
+    engine_container = {
+        "name": "engine",
+        "image": engine_image(),
+        "args": ["serve"],
+        "env": _engine_env(dep, p),
+        "ports": [{"containerPort": ENGINE_PORT}],
+        **_probes(),
+    }
+    engine_pod_spec: dict[str, Any] = {"containers": [engine_container]}
+    # the engine instantiates LOCAL/implementation nodes itself — their
+    # remote artifacts materialize on the engine pod
+    _model_init(engine_pod_spec, engine_container,
+                _remote_model_uris(p, local_only=True))
     engine = {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -370,18 +470,7 @@ def _distributed_predictor(
             "selector": {"matchLabels": _engine_labels(dep, p)},
             "template": {
                 "metadata": {"labels": _engine_labels(dep, p)},
-                "spec": {
-                    "containers": [
-                        {
-                            "name": "engine",
-                            "image": engine_image(),
-                            "args": ["serve"],
-                            "env": _engine_env(dep, p),
-                            "ports": [{"containerPort": ENGINE_PORT}],
-                            **_probes(),
-                        }
-                    ]
-                },
+                "spec": engine_pod_spec,
             },
         },
     }
@@ -399,6 +488,13 @@ def _distributed_predictor(
             unit.name,
             {"name": unit.name, "image": engine_image(), "args": ["component"]},
         ).copy()
+        # this pod's own remote artifact (if any): initContainer + rewrite
+        # of the parameter the component container sees
+        unit_uris = _remote_model_uris(p)
+        my_uri = [(n, u) for n, u in unit_uris if n == unit.name]
+        unit_params = dict(unit.parameters)
+        if my_uri:
+            unit_params["model_uri"] = f"{MODEL_MOUNT}/{unit.name}"
         container.setdefault("env", []).extend(
             [
                 {"name": "PREDICTIVE_UNIT_SERVICE_PORT",
@@ -406,7 +502,7 @@ def _distributed_predictor(
                 {"name": "PREDICTIVE_UNIT_PARAMETERS",
                  "value": json.dumps(
                      [{"name": k, "value": str(v)} for k, v in
-                      unit.parameters.items()])},
+                      unit_params.items()])},
                 {"name": "PREDICTIVE_UNIT_ID", "value": unit.name},
                 {"name": "PREDICTOR_ID", "value": p.name},
                 {"name": "SELDON_DEPLOYMENT_ID", "value": dep.name},
@@ -420,6 +516,8 @@ def _distributed_predictor(
             ]
         )
         labels = {**_common_labels(dep, p), "seldon-app": name}
+        comp_pod_spec: dict[str, Any] = {"containers": [container]}
+        _model_init(comp_pod_spec, container, my_uri)
         out.append(
             {
                 "apiVersion": "apps/v1",
@@ -431,7 +529,7 @@ def _distributed_predictor(
                     "selector": {"matchLabels": labels},
                     "template": {
                         "metadata": {"labels": labels},
-                        "spec": {"containers": [container]},
+                        "spec": comp_pod_spec,
                     },
                 },
             }

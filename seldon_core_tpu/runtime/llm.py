@@ -346,6 +346,48 @@ class LLMEngine:
         return init_cache(self.cfg, self.max_slots, max_len=cache_len,
                           mesh=self.mesh)
 
+    # -- checkpoints -----------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, path: str, *, mesh=None, int8: str = "none",
+                        draft_path: Optional[str] = None, **engine_kwargs):
+        """Boot an engine from a weights artifact (runtime/checkpoint.py)
+        instead of in-memory params: the production path — the reference
+        bakes weights into the s2i image at build
+        (``wrappers/s2i/python/s2i/bin/assemble:16-60``); here they are a
+        standalone checkpoint dir re-targeted (tp sharding, int8) at load.
+        ``draft_path`` loads a second checkpoint as the speculative draft
+        model (always dense/unquantized-as-saved; drafts are small).
+        Works for :class:`PagedLLMEngine` too — pass ``paged=`` through
+        ``engine_kwargs``.  Byte-identical serving to the engine that
+        saved (tests/test_checkpoint.py)."""
+        from seldon_core_tpu.runtime.checkpoint import load_transformer
+
+        params, cfg = load_transformer(path, mesh=mesh, int8=int8)
+        if draft_path is not None:
+            dparams, dcfg = load_transformer(draft_path, mesh=mesh)
+            engine_kwargs.setdefault("draft_params", dparams)
+            engine_kwargs.setdefault("draft_cfg", dcfg)
+        return cls(params, cfg, mesh=mesh, **engine_kwargs)
+
+    def save_checkpoint(self, path: str) -> str:
+        """Export this engine's weights as a checkpoint artifact.  Only
+        canonical (unquantized) trees export — an int8 tree cannot be
+        re-placed at load, so serving-side exports of quantized engines
+        are refused rather than silently producing a one-deployment
+        artifact (quantize at LOAD instead: ``from_checkpoint(int8=...)``)."""
+        from seldon_core_tpu.models.transformer import has_quantized_params
+        from seldon_core_tpu.runtime.checkpoint import save_transformer
+
+        if has_quantized_params(self.params):
+            raise ValueError(
+                "engine params are int8-quantized; export the canonical "
+                "weights (save before quantizing, or via "
+                "checkpoint.save_transformer on the master tree) and "
+                "quantize at load with from_checkpoint(int8=...)"
+            )
+        host = jax.tree.map(np.asarray, self.params)
+        return save_transformer(path, host, self.cfg)
+
     def _step_impl(self, params, cache, tok, temps, top_k, top_p, keys):
         """One decode tick + on-device sampling: logits never leave HBM.
         (Speculative mode never runs plain ticks — _spec_impl owns the

@@ -72,6 +72,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "(latency at fixed offered load); 0 = closed-loop "
                          "with --concurrency workers")
 
+    sm = sub.add_parser(
+        "save-model",
+        help="export a component's weights as a model_uri checkpoint dir",
+    )
+    sm.add_argument("model_class", help="pkg.module:Class (the CRD "
+                                        "model_class parameter)")
+    sm.add_argument("out", help="checkpoint directory to write")
+    sm.add_argument("--param", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="constructor parameter (repeatable; values "
+                         "JSON-decoded, falling back to string)")
+
     ft = sub.add_parser(
         "firehose-tail",
         help="replay/tail a client's firehose topic from a broker",
@@ -92,6 +104,40 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.cmd == "save-model":
+        # the weights-export half of the model_uri path
+        # (runtime/checkpoint.py): construct the component exactly the
+        # way the engine pod would (model_class + parameters) and ask it
+        # to export — components expose save_checkpoint (DemoLLM,
+        # ResNet50Model, MNISTMLP, or any user class following suit)
+        import importlib
+
+        # JAX_PLATFORMS=cpu must stick (the axon TPU plugin force-appends
+        # itself): seeded exports must not silently initialize on a
+        # different backend than the user pinned — jax.random draws are
+        # NOT bit-stable across backends, so the backend choice is part
+        # of the artifact's provenance
+        from seldon_core_tpu.operator.local import _honor_jax_platforms_env
+
+        _honor_jax_platforms_env()
+
+        params = {}
+        for kv in args.param:
+            name, _, value = kv.partition("=")
+            try:
+                params[name] = json.loads(value)
+            except ValueError:
+                params[name] = value
+        mod_name, _, cls_name = args.model_class.partition(":")
+        obj = getattr(importlib.import_module(mod_name), cls_name)(**params)
+        save = getattr(obj, "save_checkpoint", None)
+        if not callable(save):
+            print(f"save-model: {args.model_class} has no save_checkpoint()",
+                  file=sys.stderr)
+            return 1
+        print(save(args.out))
+        return 0
 
     if args.cmd == "firehose-tail":
         import time as _time
