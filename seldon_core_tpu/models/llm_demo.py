@@ -60,6 +60,9 @@ class DemoLLM(LLMComponent):
         auto_prefix_tokens: int = -1,
         ring_prefill: int = 0,
         model_uri: str = "",
+        priority: int = 0,
+        admit_timeout_ms: float = 0.0,
+        max_priority: int = -1,
     ):
         mesh = None
         if tp > 1:
@@ -134,7 +137,15 @@ class DemoLLM(LLMComponent):
                                chunk_prefill=chunk_prefill, mesh=mesh,
                                auto_prefix_tokens=auto_prefix_tokens,
                                ring_prefill=ring_prefill)
-        super().__init__(engine, n_new=n_new)
+        # SLO deployment defaults (docs/annotations.md "LLM serving SLOs"):
+        # admission class + shed deadline for this deployment's requests;
+        # max_priority >= 0 caps the per-request priority override
+        # (shared-deployment operators set it; -1 = uncapped)
+        super().__init__(
+            engine, n_new=n_new, priority=priority,
+            admit_timeout_ms=admit_timeout_ms or None,
+            max_priority=None if max_priority < 0 else max_priority,
+        )
         self.name = "llm"
 
     def tags(self):
