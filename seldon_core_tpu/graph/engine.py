@@ -74,6 +74,7 @@ class GraphEngine:
         name: str = "predictor",
         metrics_sink: Optional[Any] = None,
         tracer: Optional[Any] = None,
+        walk_timeout_s: Optional[float] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -83,6 +84,11 @@ class GraphEngine:
         self._resolver = resolver
         self.metrics = metrics_sink  # duck: .observe_node(name, secs), .merge_custom(metrics)
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # per-request deadline over the WHOLE walk (the reference only has
+        # per-hop client timeouts; a deep graph could still stall a request
+        # for hops x timeout) — annotation seldon.io/engine-walk-timeout-ms
+        # via operator/local.py; None = unbounded
+        self.walk_timeout_s = walk_timeout_s
         self.root = self._build(self.spec)
         self._nodes: dict[str, _Node] = {}
         self._index(self.root)
@@ -124,7 +130,30 @@ class GraphEngine:
             meta.puid = new_puid()
         try:
             with self.tracer.trace(meta.puid, graph=self.name):
-                out = await self._walk(self.root, request, meta)
+                coro = self._walk(self.root, request, meta)
+                if self.walk_timeout_s:
+                    # asyncio.timeout + expired(): only the WALK deadline
+                    # maps to the 504 below — a TimeoutError leaking out
+                    # of a component is that component's bug and takes
+                    # the generic 500 path like any other exception
+                    cm = asyncio.timeout(self.walk_timeout_s)
+                    try:
+                        async with cm:
+                            out = await coro
+                    except TimeoutError:
+                        if not cm.expired():
+                            raise
+                        return SeldonMessage(
+                            status=Status.failure(
+                                504,
+                                f"graph walk exceeded "
+                                f"{self.walk_timeout_s}s deadline",
+                                "DEADLINE_EXCEEDED",
+                            ),
+                            meta=meta,
+                        )
+                else:
+                    out = await coro
         except SeldonComponentError as e:
             return SeldonMessage(
                 status=Status.failure(e.status_code, str(e), e.reason), meta=meta

@@ -74,6 +74,7 @@ def resolve_component(
             return GrpcComponentClient(
                 f"{unit.endpoint.service_host}:{unit.endpoint.service_port or 5000}",
                 methods=unit.methods,
+                timeout_s=_timeout_s(ann, "seldon.io/grpc-read-timeout", 30.0),
             )
         from seldon_core_tpu.serving.client import RemoteComponent
 
@@ -82,10 +83,24 @@ def resolve_component(
             f"http://{unit.endpoint.service_host}:{scheme_port}",
             name=unit.name,
             methods=unit.methods,
+            timeout_s=_timeout_s(ann, "seldon.io/rest-read-timeout", 30.0),
+            connect_timeout_s=_timeout_s(
+                ann, "seldon.io/rest-connection-timeout", None
+            ),
         )
     raise ValueError(
         f"node {unit.name!r}: no implementation, model_class, or endpoint"
     )
+
+
+def _timeout_s(ann: dict, key: str, default):
+    """Reference timeout annotations carry MILLISECONDS (their values set
+    Tomcat/gRPC ms knobs — ``docs/annotations.md`` example uses 100000);
+    clients here take seconds."""
+    raw = ann.get(key)
+    if raw is None or str(raw).strip() == "":
+        return default
+    return float(raw) / 1000.0
 
 
 def _batching_enabled(ann: dict) -> bool:
@@ -126,6 +141,9 @@ class LocalPredictor:
             name=pred.name,
             metrics_sink=self.metrics,
             tracer=_tracer_from_config(ann),
+            walk_timeout_s=_timeout_s(
+                ann, "seldon.io/engine-walk-timeout-ms", None
+            ),
         )
 
 

@@ -36,13 +36,21 @@ class RemoteComponent:
         base_url: str,
         name: str = "",
         timeout_s: float = 30.0,
+        connect_timeout_s: Optional[float] = None,
         encoding: str = "ndarray",
         session: Optional[aiohttp.ClientSession] = None,
         methods: Sequence[str] = (),
     ):
+        """``timeout_s`` / ``connect_timeout_s`` are the reference's
+        ``seldon.io/rest-read-timeout`` / ``rest-connection-timeout``
+        annotations (docs/annotations.md:17-25 there), plumbed per
+        deployment by operator/local.py — a read past the deadline sheds
+        with 504 DEADLINE_EXCEEDED instead of stalling the graph walk."""
         self.base_url = base_url.rstrip("/")
         self.name = name or self.base_url
-        self.timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self.timeout = aiohttp.ClientTimeout(
+            total=timeout_s, sock_connect=connect_timeout_s
+        )
         self.encoding = encoding
         self._session = session
         self._own_session = session is None
@@ -75,7 +83,24 @@ class RemoteComponent:
                 headers={"Content-Type": "application/json"},
             ) as resp:
                 raw = await resp.read()
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        except getattr(aiohttp, "ConnectionTimeoutError",
+                       aiohttp.ServerTimeoutError) as e:
+            # connect-phase expiry (rest-connection-timeout) subclasses
+            # asyncio.TimeoutError too, but an unreachable backend is
+            # "down" (503 TRANSPORT, reference semantics), not "slow" —
+            # it must not fall into the read-timeout branch below
+            raise SeldonComponentError(
+                f"{self.name}{path} connect timeout: {e}", 503, "TRANSPORT"
+            )
+        except asyncio.TimeoutError:
+            # reference timeout semantics: the rest-read-timeout annotation
+            # bounds a slow component; surfacing it as its own 504 (not a
+            # generic 503) lets callers distinguish "slow" from "down"
+            raise SeldonComponentError(
+                f"{self.name}{path} read timeout after "
+                f"{self.timeout.total}s", 504, "DEADLINE_EXCEEDED"
+            )
+        except aiohttp.ClientError as e:
             raise SeldonComponentError(
                 f"{self.name}{path} transport error: {e}", 503, "TRANSPORT"
             )

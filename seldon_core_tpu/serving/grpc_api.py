@@ -424,7 +424,29 @@ class GrpcComponentClient:
         stub = self._stubs.get(service)
         if stub is None:
             stub = self._stubs[service] = _Stub(self._channel, service)
-        resp = await getattr(stub, method)(req_pb, timeout=self.timeout)
+        try:
+            resp = await getattr(stub, method)(req_pb, timeout=self.timeout)
+        except grpc.aio.AioRpcError as e:
+            from seldon_core_tpu.runtime.component import SeldonComponentError
+
+            # reference grpc-read-timeout semantics: a deadline is its own
+            # failure class (504), transport unavailability is 503 — both
+            # become wire-level FAILURE Status in the graph walk instead
+            # of raw AioRpcErrors
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise SeldonComponentError(
+                    f"{service}.{method} deadline exceeded after "
+                    f"{self.timeout}s", 504, "DEADLINE_EXCEEDED"
+                )
+            if e.code() == grpc.StatusCode.UNAVAILABLE:
+                raise SeldonComponentError(
+                    f"{service}.{method} unavailable: {e.details()}",
+                    503, "TRANSPORT",
+                )
+            raise SeldonComponentError(
+                f"{service}.{method} rpc failed: {e.code().name} "
+                f"{e.details()}", 500, "RPC",
+            )
         return resp
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
