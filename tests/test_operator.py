@@ -409,3 +409,9 @@ def test_two_process_distributed_engine():
     assert r["n_hosts"] == 2
     assert r["global_devices"] == 4
     assert len(r["tokens"][0]) == 9  # 4 prompt + 5 generated
+    # round 5: the COMPOSED PagedLLMEngine (paged x tp x spec x ring x
+    # prefix aliasing) also crosses the process boundary — page tables and
+    # alias refcounts live per rank, collectives through Gloo
+    assert r["paged_requests"] == 3
+    assert r["spec_rounds"] > 0
+    assert r["pinned_pages"] == 4  # 16-token prefix / page_size 4
