@@ -17,11 +17,13 @@
  * answers every request from a canned response — the pure-native transport
  * ceiling used by bench.py to separate wire cost from handler cost.
  *
- * HTTP/2 scope: what a unary gRPC client exercises — SETTINGS, HEADERS
- * (+CONTINUATION, padding, priority), DATA, WINDOW_UPDATE (both
- * directions, with response flow control), PING, RST_STREAM, GOAWAY, full
- * HPACK decode (dynamic table + Huffman).  Server streaming stays on the
- * grpc.aio tier (serving/grpc_api.py Stream RPC).
+ * HTTP/2 scope: what a unary OR server-streaming gRPC client exercises —
+ * SETTINGS, HEADERS (+CONTINUATION, padding, priority), DATA,
+ * WINDOW_UPDATE (both directions, with response flow control), PING,
+ * RST_STREAM, GOAWAY, full HPACK decode (dynamic table + Huffman).
+ * Server streaming is native here too: gRPC Stream over h2c and SSE over
+ * chunked h1 (seldon_http_stream_* below; round 4).  Client/bidi
+ * streaming stays on the grpc.aio tier.
  */
 #include "seldon_native.h"
 

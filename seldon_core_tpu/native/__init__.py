@@ -286,17 +286,23 @@ def _bind(lib: C.CDLL) -> None:
     lib.sn_http_server_requests.argtypes = [C.c_void_p]
     lib.sn_http_server_stop.argtypes = [C.c_void_p]
     lib.sn_http_server_destroy.argtypes = [C.c_void_p]
+    # body params are declared c_char_p (ABI-identical to const uint8_t*)
+    # so Python `bytes` pass ZERO-COPY — the C side copies synchronously
+    # into its completion struct before returning (httpserver.cc
+    # sn_http_complete/stream_chunk/set_static_response), so borrowing the
+    # bytes' internal buffer is safe, and the hot completion path skips a
+    # ctypes array construction + copy per response
     lib.sn_http_complete.argtypes = [
-        C.c_void_p, C.c_uint64, C.c_int, C.c_char_p, u8p, C.c_uint64,
+        C.c_void_p, C.c_uint64, C.c_int, C.c_char_p, C.c_char_p, C.c_uint64,
     ]
     lib.sn_http_stream_chunk.argtypes = [
-        C.c_void_p, C.c_uint64, u8p, C.c_uint64,
+        C.c_void_p, C.c_uint64, C.c_char_p, C.c_uint64,
     ]
     lib.sn_http_stream_end.argtypes = [
         C.c_void_p, C.c_uint64, C.c_int, C.c_char_p,
     ]
     lib.sn_http_set_static_response.argtypes = [
-        C.c_void_p, C.c_int, u8p, C.c_uint64,
+        C.c_void_p, C.c_int, C.c_char_p, C.c_uint64,
     ]
     lib.sn_loadgen_run.restype = C.c_int
     lib.sn_loadgen_run.argtypes = [
@@ -596,9 +602,8 @@ class NativeHttpServer:
             raise OSError(f"failed to bind {bind}:{port}")
 
     def set_static_response(self, status: int, body: bytes) -> None:
-        buf = (C.c_uint8 * max(len(body), 1)).from_buffer_copy(body or b"\0")
         self._lib.sn_http_set_static_response(
-            self._h, status, buf, len(body)
+            self._h, status, body or b"\0", len(body)
         )
 
     def complete(
@@ -608,19 +613,19 @@ class NativeHttpServer:
         body: bytes = b"",
         message: Optional[str] = None,
     ) -> None:
-        buf = (
-            (C.c_uint8 * len(body)).from_buffer_copy(body) if body else None
-        )
+        # bytes pass zero-copy through the c_char_p argtype; the C side
+        # copies before returning (see the argtype declaration note)
         self._lib.sn_http_complete(
             self._h, token, status,
-            message.encode() if message else None, buf, len(body),
+            message.encode() if message else None, body or None, len(body),
         )
 
     def stream_chunk(self, token: int, data: bytes) -> None:
         """One server-streaming chunk: a gRPC message (h2) or raw SSE
         bytes (h1).  Call stream_end exactly once when done."""
-        buf = (C.c_uint8 * len(data)).from_buffer_copy(data) if data else None
-        self._lib.sn_http_stream_chunk(self._h, token, buf, len(data))
+        self._lib.sn_http_stream_chunk(
+            self._h, token, data or None, len(data)
+        )
 
     def stream_end(
         self, token: int, status: int = 0, message: Optional[str] = None

@@ -33,6 +33,7 @@ import asyncio
 import json
 import logging
 import time
+from collections import deque
 from typing import Any, Awaitable, Callable, Optional
 
 from seldon_core_tpu.messages import Feedback, SeldonMessage, Status
@@ -77,6 +78,13 @@ class _AsyncBridge:
         self._error_result = error_result
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._tasks: set = set()
+        # submission batching: under load the IO thread delivers many
+        # requests per loop iteration; one deque + one scheduled drain
+        # amortizes call_soon_threadsafe's lock + self-pipe wakeup
+        # (~2-3 us + a syscall each) across the burst instead of paying
+        # it per request
+        self._inbox: deque = deque()
+        self._drain_scheduled = False
         self.server = NativeHttpServer(
             submit=self._submit, http2=http2, port=port, bind=bind,
             reuseport=reuseport,
@@ -84,7 +92,22 @@ class _AsyncBridge:
 
     # IO thread (GIL held by ctypes) — enqueue and return immediately
     def _submit(self, token: int, method: str, path: str, body: bytes) -> None:
-        self._loop.call_soon_threadsafe(self._spawn, token, method, path, body)
+        self._inbox.append((token, method, path, body))
+        if not self._drain_scheduled:
+            # benign race: a concurrent drain may consume the item and
+            # leave the extra scheduled drain a no-op; the flag only
+            # bounds wakeups, it never gates correctness (deque ops are
+            # GIL-atomic, and the flag clears BEFORE the drain loop runs
+            # so an append after the clear always re-schedules)
+            self._drain_scheduled = True
+            self._loop.call_soon_threadsafe(self._drain)
+
+    def _drain(self) -> None:
+        self._drain_scheduled = False
+        inbox = self._inbox
+        while inbox:
+            token, method, path, body = inbox.popleft()
+            self._spawn(token, method, path, body)
 
     def _spawn(self, token: int, method: str, path: str, body: bytes) -> None:
         # EAGER task start (3.12 stdlib): the handler runs synchronously
