@@ -480,6 +480,7 @@ def _distributed_predictor(
         for cs in p.component_specs
         for c in (cs.get("spec", {}) or {}).get("containers", []) or []
     }
+    unit_uris = _remote_model_uris(p)  # depends only on p: walk once
     for unit in p.graph.walk():
         if unit.implementation or unit.endpoint.type == "LOCAL":
             continue
@@ -490,7 +491,6 @@ def _distributed_predictor(
         ).copy()
         # this pod's own remote artifact (if any): initContainer + rewrite
         # of the parameter the component container sees
-        unit_uris = _remote_model_uris(p)
         my_uri = [(n, u) for n, u in unit_uris if n == unit.name]
         unit_params = dict(unit.parameters)
         if my_uri:

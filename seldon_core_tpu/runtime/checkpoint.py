@@ -138,8 +138,15 @@ def save_checkpoint(path: str, tree: Any, model_config: Optional[dict] = None,
         "skeleton": skeleton,
     }
     meta = {"framework": "seldon-core-tpu",
-            "seldon_checkpoint": json.dumps(cfg)}
-    meta.update({str(k): str(v) for k, v in (metadata or {}).items()})
+            "seldon.checkpoint": json.dumps(cfg)}
+    for k, v in (metadata or {}).items():
+        if str(k) in meta:
+            # a clobbered "seldon.checkpoint" would save fine and fail
+            # only at load time with a missing/corrupt-skeleton error
+            raise ValueError(
+                f"metadata key {k!r} is reserved by the checkpoint format"
+            )
+        meta[str(k)] = str(v)
     final = os.path.join(path, TENSOR_FILE)
     tmp = f"{final}.tmp.{os.getpid()}"
     save_file(tensors, tmp, metadata=meta)
@@ -167,10 +174,10 @@ def load_checkpoint(path: str) -> tuple[Any, dict]:
             " — interrupted save, or wrong model_uri?)"
         )
     with safe_open(tensor_path, framework="numpy") as f:
-        raw = (f.metadata() or {}).get("seldon_checkpoint")
+        raw = (f.metadata() or {}).get("seldon.checkpoint")
         if raw is None:
             raise ValueError(
-                f"{tensor_path!r} carries no seldon_checkpoint metadata "
+                f"{tensor_path!r} carries no seldon.checkpoint metadata "
                 "(foreign safetensors file? convert via save_checkpoint)"
             )
         cfg = json.loads(raw)

@@ -145,6 +145,24 @@ class TestCodec:
 # transformer artifacts
 # ----------------------------------------------------------------------
 
+class TestReservedMetadata:
+    def test_user_metadata_cannot_clobber_format_keys(self, tmp_path):
+        """A colliding metadata key would save fine and corrupt the
+        artifact discovered only at load time — refuse at save."""
+        from seldon_core_tpu.runtime.checkpoint import save_checkpoint
+
+        tree = {"w": np.ones((2, 2), np.float32)}
+        with pytest.raises(ValueError, match="reserved"):
+            save_checkpoint(str(tmp_path / "ck"), tree,
+                            metadata={"seldon.checkpoint": "evil"})
+        with pytest.raises(ValueError, match="reserved"):
+            save_checkpoint(str(tmp_path / "ck"), tree,
+                            metadata={"framework": "other"})
+        # non-colliding metadata still saves
+        save_checkpoint(str(tmp_path / "ck"), tree,
+                        metadata={"trained_by": "ci"})
+
+
 class TestTransformerArtifact:
     def test_round_trip_params_and_config(self, tmp_path):
         params = _params()
