@@ -1149,6 +1149,85 @@ def bench_llm_stream_open_loop(seconds: float = 8.0) -> dict:
     }
 
 
+def bench_llm_slo_open_loop(seconds: float = 10.0) -> dict:
+    """SLO machinery past saturation (the r4 record showed the cliff: at
+    offered rate 5 the demo engine's TTFT p50 hit 2.9 s because requests
+    queued forever).  The same engine now serves a two-class overload
+    mix: priority-1 interactive traffic (no deadline) at rate 1
+    alongside priority-0 bulk traffic with a 1 s admission deadline at
+    rate 6 — over capacity by design.  The SLO claim under test:
+    interactive TTFT stays BOUNDED (class-ordered admission + preemption
+    of bulk decodes) while bulk sheds its overload as 504s instead of
+    queueing unboundedly.  Per-class percentiles + shed counts."""
+    import numpy as np
+
+    import jax
+
+    from seldon_core_tpu.models.llm_demo import DemoLLM
+    from seldon_core_tpu.serving.rest import build_app, start_server
+    from seldon_core_tpu.tools.loadtest import SseStreamDriver, run_open_loop
+
+    comp = DemoLLM(
+        d_model=256, n_layers=4, n_heads=4, d_ff=512, vocab_size=1024,
+        max_seq=128, max_slots=8, n_new=16,
+        dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+    prompt = [int(t) for t in
+              np.random.default_rng(0).integers(1, 1024, size=12)]
+    bulk = {"jsonData": {"prompt_ids": prompt, "n_new": 16,
+                         "admit_timeout_ms": 1000.0}}
+    interactive = {"jsonData": {"prompt_ids": prompt, "n_new": 16,
+                                "priority": 1}}
+
+    async def run() -> dict:
+        runner = await start_server(build_app(component=comp),
+                                    "127.0.0.1", 0)
+        port = runner.addresses[0][1]
+        try:
+            warm = SseStreamDriver(f"http://127.0.0.1:{port}", interactive,
+                                   path="/stream", connections=2)
+            async with warm:
+                await warm()
+            bulk_drv = SseStreamDriver(f"http://127.0.0.1:{port}", bulk,
+                                       path="/stream", connections=48)
+            hi_drv = SseStreamDriver(f"http://127.0.0.1:{port}",
+                                     interactive, path="/stream",
+                                     connections=8)
+            bulk_res, hi_res = await asyncio.gather(
+                run_open_loop(bulk_drv, rate=6.0, seconds=seconds,
+                              warmup_s=1.0, protocol="sse-bulk"),
+                run_open_loop(hi_drv, rate=1.0, seconds=seconds,
+                              warmup_s=1.0, protocol="sse-priority"),
+            )
+            db, dh = bulk_res.to_dict(), hi_res.to_dict()
+            out = {
+                "bulk_rate6_deadline1s": {
+                    "achieved_req_per_s": db["req_per_s"],
+                    "shed_504": db["failures"],
+                    "dropped": db["dropped"],
+                    **bulk_drv.stream_stats(db["req_per_s"]),
+                },
+                "priority_rate1": {
+                    "achieved_req_per_s": dh["req_per_s"],
+                    "failures": dh["failures"],
+                    "dropped": dh["dropped"],
+                    **hi_drv.stream_stats(dh["req_per_s"]),
+                },
+            }
+        finally:
+            await runner.cleanup()
+        out["engine"] = dict(comp.engine.preempt_stats)
+        hi_ttft = (out["priority_rate1"].get("ttft_ms") or {})
+        # headline keys
+        out["ttft_p50_ms_priority"] = hi_ttft.get("p50")
+        out["ttft_p99_ms_priority"] = hi_ttft.get("p99")
+        out["shed_total"] = out["engine"]["shed"]
+        out["preempted_total"] = out["engine"]["preempted"]
+        return out
+
+    return asyncio.run(run())
+
+
 def bench_rest_socket(seconds: float = 3.0, concurrency: int = 64) -> dict:
     """REST throughput over a REAL localhost socket: aiohttp server (engine +
     SIMPLE_MODEL graph) driven by the tools load harness — apples-to-apples
@@ -1413,6 +1492,10 @@ def main() -> None:
             extras["llm7b_open_loop"] = bench_llm7b_open_loop()
         except Exception as e:
             extras["llm7b_open_loop_error"] = f"{type(e).__name__}: {e}"
+        try:
+            extras["llm_slo_open_loop"] = bench_llm_slo_open_loop()
+        except Exception as e:
+            extras["llm_slo_open_loop_error"] = f"{type(e).__name__}: {e}"
 
     # Compact headline summary, emitted as the LAST key of the JSON line.
     # The driver records only the TAIL of this (long) line; round 3 printed
@@ -1453,6 +1536,13 @@ def main() -> None:
           "llm7b_alias_hits", 0)
     _pick(extras, ["llm7b_open_loop", "alias_pages_saved"],
           "llm7b_alias_pages_saved", 0)
+    _pick(extras, ["llm_slo_open_loop", "ttft_p50_ms_priority"],
+          "slo_hi_ttft_p50_ms", 1)
+    _pick(extras, ["llm_slo_open_loop", "ttft_p99_ms_priority"],
+          "slo_hi_ttft_p99_ms", 1)
+    _pick(extras, ["llm_slo_open_loop", "shed_total"], "slo_shed", 0)
+    _pick(extras, ["llm_slo_open_loop", "preempted_total"],
+          "slo_preempted", 0)
 
     result = {
         "metric": "graph_orchestrator_req_per_s_1core",

@@ -174,7 +174,11 @@ def load_checkpoint(path: str) -> tuple[Any, dict]:
             " — interrupted save, or wrong model_uri?)"
         )
     with safe_open(tensor_path, framework="numpy") as f:
-        raw = (f.metadata() or {}).get("seldon.checkpoint")
+        md = f.metadata() or {}
+        # "seldon_checkpoint" is the key the first artifact version wrote
+        # (renamed: underscore names pattern-match Prometheus series in
+        # doc/catalog tooling) — keep loading those artifacts
+        raw = md.get("seldon.checkpoint") or md.get("seldon_checkpoint")
         if raw is None:
             raise ValueError(
                 f"{tensor_path!r} carries no seldon.checkpoint metadata "

@@ -86,6 +86,12 @@ def _fetch_request(corr: int, topic: str, offset: int) -> bytes:
     return _req_header(1, 4, corr, "seldon-it-consumer") + body
 
 
+class _TransientFetchError(Exception):
+    """Non-zero partition error code — e.g. UNKNOWN_TOPIC_OR_PARTITION(3)
+    or NOT_LEADER_FOR_PARTITION(6) right after topic auto-creation;
+    retried by the poll loop instead of failing the test instantly."""
+
+
 def _parse_fetch_values(frame: bytes) -> list:
     """Fetch v4 response → list of record value bytes (partition 0)."""
     off = 4  # correlation id
@@ -106,7 +112,8 @@ def _parse_fetch_values(frame: bytes) -> list:
             off += 4 + max(n_aborted, 0) * 16
             (set_len,) = struct.unpack_from(">i", frame, off)
             off += 4
-            assert err == 0, f"fetch error code {err}"
+            if err != 0:
+                raise _TransientFetchError(f"fetch error code {err}")
             end = off + set_len
             while off < end:
                 off = _parse_batch(frame, off, end, values)
@@ -141,10 +148,14 @@ def _consume_values(bootstrap: str, topic: str, want: int,
                     timeout_s: float = 20.0) -> list:
     deadline = time.monotonic() + timeout_s
     corr = 1000
+    values: list = []
     while time.monotonic() < deadline:
         corr += 1
-        frame = _roundtrip(bootstrap, _fetch_request(corr, topic, 0))
-        values = _parse_fetch_values(frame)
+        try:
+            frame = _roundtrip(bootstrap, _fetch_request(corr, topic, 0))
+            values = _parse_fetch_values(frame)
+        except _TransientFetchError:
+            values = []  # not ready yet (auto-creation / leader election)
         if len(values) >= want:
             return values
         time.sleep(0.5)
