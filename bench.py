@@ -168,12 +168,16 @@ def bench_resnet50(batches=(64, 256)) -> dict:
         x = jax.random.normal(
             jax.random.PRNGKey(0), (batch, 224, 224, 3), jnp.bfloat16
         )
-        # n=64: the chained window must dwarf the ~70-80 ms dispatch base
-        # or the probe subtraction amplifies tunnel hiccups into +-25%
-        # swings — round 4's 58.7%-doc / 66.6%-capture contradiction was
-        # exactly this artifact at n=16 (docs/benchmarks.md, round-5 MFU
-        # note); at n=64 interleaved runs agree within a few percent
-        ms = _chained_ms(lambda c: m.module.apply(m.params, c), x, n=64)
+        # the chained window must DWARF the ~70-80 ms dispatch base or the
+        # probe subtraction amplifies tunnel hiccups into +-25% swings —
+        # round 4's 58.7%-doc / 66.6%-capture contradiction was exactly
+        # this artifact at n=16 (docs/benchmarks.md, round-5 MFU note).
+        # n scales inversely with batch so EVERY point gets a ~1.3 s
+        # window (~17x base): at fixed n=64 the batch-64 window was only
+        # ~5x base and still over-read by up to 25% in busy contexts
+        # while batch-256 agreed within 1% across every context.
+        n = max(64, 16384 // batch)
+        ms = _chained_ms(lambda c: m.module.apply(m.params, c), x, n=n)
         img_s = batch / ms * 1000.0
         # physical sanity: >95% MFU on a conv net means the measurement was
         # jitter-corrupted — re-measure (bounded, conservative max), and
@@ -186,7 +190,7 @@ def bench_resnet50(batches=(64, 256)) -> dict:
                 break
             ms = max(
                 ms,
-                _chained_ms(lambda c: m.module.apply(m.params, c), x, n=64),
+                _chained_ms(lambda c: m.module.apply(m.params, c), x, n=n),
             )
             img_s = batch / ms * 1000.0
         suspect = mfu(img_s) > 0.95
