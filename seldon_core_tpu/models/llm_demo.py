@@ -59,6 +59,7 @@ class DemoLLM(LLMComponent):
         page_size: int = 16,
         auto_prefix_tokens: int = -1,
         ring_prefill: int = 0,
+        batch_prefill_ms: float = 0.0,
         model_uri: str = "",
         priority: int = 0,
         admit_timeout_ms: float = 0.0,
@@ -131,12 +132,14 @@ class DemoLLM(LLMComponent):
                 max_slots=max_slots, chunk_prefill=chunk_prefill,
                 auto_prefix_tokens=auto_prefix_tokens, mesh=mesh,
                 ring_prefill=ring_prefill,
+                batch_prefill_ms=batch_prefill_ms,
             )
         else:
             engine = LLMEngine(params, cfg, max_slots=max_slots,
                                chunk_prefill=chunk_prefill, mesh=mesh,
                                auto_prefix_tokens=auto_prefix_tokens,
-                               ring_prefill=ring_prefill)
+                               ring_prefill=ring_prefill,
+                               batch_prefill_ms=batch_prefill_ms)
         # SLO deployment defaults (docs/annotations.md "LLM serving SLOs"):
         # admission class + shed deadline for this deployment's requests;
         # max_priority >= 0 caps the per-request priority override

@@ -870,8 +870,15 @@ def prefill(params, input_ids, cfg: TransformerConfig, max_len: int,
 
     x = rmsnorm(x, params["ln_f"])
     if logit_pos is not None:
-        # project ONE position: (B, 1, D) through the vocab matrix
-        x = jax.lax.dynamic_slice_in_dim(x, logit_pos, 1, axis=1)
+        lp = jnp.asarray(logit_pos)
+        if lp.ndim == 0:
+            # project ONE position: (B, 1, D) through the vocab matrix
+            x = jax.lax.dynamic_slice_in_dim(x, logit_pos, 1, axis=1)
+        else:
+            # PER-ROW position (batched-admission prefill: rows carry
+            # different true lengths padded to one bucket): gather each
+            # row's own last-true position, then project (B, 1, D)
+            x = x[jnp.arange(B), lp][:, None]
         logits = _vocab_proj(x, params["lm_head"], cfg, mesh)[:, 0].astype(
             jnp.float32
         )

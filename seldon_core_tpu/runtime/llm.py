@@ -273,6 +273,7 @@ class LLMEngine:
         auto_prefix_tokens: int = 0,
         auto_prefix_granularity: int = 16,
         ring_prefill: int = 0,
+        batch_prefill_ms: float = 0.0,
     ):
         """``mesh``: serve TENSOR-PARALLEL over a jax.sharding.Mesh with a
         "tp" axis.  Params must be placed to match (``shard_params`` for
@@ -308,6 +309,26 @@ class LLMEngine:
         # in-flight decode ticks interleave with the prefill instead of
         # stalling behind one monolithic device program.  0 = off.
         self.chunk_prefill = int(chunk_prefill)
+        # BATCHED admission prefill (vLLM-style): dense-path admissions
+        # arriving within this window coalesce into ONE multi-row prefill
+        # program (padded to the group's max bucket, per-row logit_pos),
+        # dividing per-admission dispatch cost and batching the MXU work
+        # under bursts.  Exact: right-padding and batch rows are
+        # independent under causal attention (masked positions contribute
+        # exact zeros), so each row is byte-identical to its solo
+        # prefill.  0 = off (every admission prefills alone, prior
+        # behavior).  Applies to the plain dense path only — prefix-hit,
+        # chunked, and ring admissions keep their own programs.
+        self.batch_prefill_ms = float(batch_prefill_ms)
+        self._pf_queue: list = []
+        self._pf_flusher: Optional[asyncio.Task] = None
+        # early-flush signal: set when the group can no longer grow
+        # (every member holds a slot, so max_slots members is the cap) or
+        # when a higher-class waiter needs window members to REGISTER so
+        # they become preemptible (mid-admission requests are invisible
+        # to _pick_victim)
+        self._pf_wake = asyncio.Event()
+        self.prefill_batch_stats = {"groups": 0, "requests": 0}
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("draft_params and draft_cfg go together")
         # speculative verification transiently writes up to k_draft+1 rows
@@ -689,6 +710,102 @@ class LLMEngine:
             done += n
         return logits, small
 
+    # -- batched admission prefill ---------------------------------------
+    async def _batched_prefill(self, prompt_ids, L0: int):
+        """Join the current coalescing window; the window's flusher runs
+        ONE prefill for every queued admission and hands each caller its
+        own row.  Returns ``(logits [1, V], 1-row cache)`` exactly like
+        the solo path."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pf_queue.append((prompt_ids, L0, fut))
+        if len(self._pf_queue) >= self.max_slots:
+            # every member holds a slot, so the group cannot grow —
+            # waiting out the rest of the window would be pure latency
+            self._pf_wake.set()
+        if self._pf_flusher is None or self._pf_flusher.done():
+            self._pf_flusher = loop.create_task(self._pf_flush_after_window())
+        return await fut
+
+    async def _pf_flush_after_window(self) -> None:
+        try:
+            await asyncio.wait_for(
+                self._pf_wake.wait(), self.batch_prefill_ms / 1000.0
+            )
+        except asyncio.TimeoutError:
+            pass
+        self._pf_wake.clear()
+        batch, self._pf_queue = self._pf_queue, []
+        # reset BEFORE dispatch: arrivals during the device call open a
+        # fresh window instead of missing this one silently
+        self._pf_flusher = None
+        for group in self._pf_partition(batch):
+            try:
+                self._pf_dispatch(group)
+            except BaseException as e:
+                for _, _, f in group:
+                    if not f.done():
+                        f.set_exception(e)
+            # decode ticks dispatch between group programs (the same
+            # interleave chunked prefill exists to provide)
+            await asyncio.sleep(0)
+
+    def _pf_partition(self, batch: list) -> list:
+        """Split a window's members into consecutive groups whose total
+        padded-token work respects the chunk_prefill per-program bound —
+        one giant B x bucket group would stall in-flight decode ticks for
+        exactly the latency chunk_prefill exists to cap.  A single row
+        may exceed the budget alone (its solo path wouldn't have chunked
+        either, since only rows with L0 <= chunk_prefill reach the
+        batched branch).  No bound configured = one group."""
+        if not batch:
+            return []
+        budget = self.chunk_prefill
+        if not budget:
+            return [batch]
+        groups, cur, cur_tokens = [], [], 0
+        for item in batch:
+            b = _bucket(item[1])
+            if cur and cur_tokens + b > budget:
+                groups.append(cur)
+                cur, cur_tokens = [], 0
+            cur.append(item)
+            cur_tokens += b
+        groups.append(cur)
+        return groups
+
+    def _pf_dispatch(self, batch: list) -> None:
+        """One prefill program for the whole group: rows padded to the
+        group's max bucket (exact — masked positions contribute exact
+        zeros under causal attention), per-row logit_pos, row count padded
+        to a power of two so program variety stays O(log slots x log L)
+        (padding rows repeat row 0 and are discarded)."""
+        B = len(batch)
+        bucket = _bucket(max(L for _, L, _ in batch))
+        rows = [
+            jnp.pad(p, ((0, 0), (0, bucket - L))) for p, L, _ in batch
+        ]
+        Bp = 1
+        while Bp < B:
+            Bp *= 2
+        rows.extend(rows[0] for _ in range(Bp - B))
+        ids = jnp.concatenate(rows, axis=0)
+        pos = jnp.asarray(
+            [L - 1 for _, L, _ in batch] + [0] * (Bp - B), jnp.int32
+        )
+        logits, small = self._prefill_for(bucket)(
+            self.params, ids, logit_pos=pos
+        )
+        self.prefill_batch_stats["groups"] += 1
+        self.prefill_batch_stats["requests"] += B
+        for b, (_, _, f) in enumerate(batch):
+            if not f.done():  # caller may have been cancelled meanwhile
+                f.set_result((
+                    logits[b : b + 1],
+                    {"k": small["k"][:, b : b + 1],
+                     "v": small["v"][:, b : b + 1]},
+                ))
+
     # -- device programs -------------------------------------------------
     def _ring_eligible(self, bucket: int) -> bool:
         if not self.ring_prefill or bucket < self.ring_prefill:
@@ -896,6 +1013,7 @@ class LLMEngine:
         self._emit(slot, st, first_tok)
         if slot in self._slots:  # not already finished by stop/n_new=1
             self._ensure_ticking()
+            self._recheck_preemption()
         try:
             while True:
                 item = await st.queue.get()
@@ -997,6 +1115,13 @@ class LLMEngine:
             )
         elif chunking:
             logits, small = await self._chunked_prefill(prompt_ids, L0)
+        elif self.batch_prefill_ms and not use_ring and priority <= 0:
+            # coalesce with concurrently-arriving admissions into one
+            # multi-row prefill program (byte-identical per row).
+            # Priority classes above 0 skip the window: they are
+            # latency-sensitive by declaration, and batching latency is
+            # exactly what they pay extra to avoid.
+            logits, small = await self._batched_prefill(prompt_ids, L0)
         else:
             # bucketed prefill (right-padding is exact under causal
             # attention); logit_pos: only the last true position is
@@ -1142,6 +1267,21 @@ class LLMEngine:
         victim = self._pick_victim(head_prio)
         if victim is not None:
             self._preempt(*victim)
+        elif self._pf_queue:
+            # no victim NOW, but requests sitting in the batch-prefill
+            # window hold slots while invisible to _pick_victim — flush
+            # them so they register and _recheck_preemption can evict one
+            self._pf_wake.set()
+
+    def _recheck_preemption(self) -> None:
+        """Run after a request REGISTERS (becomes visible in _slots): a
+        queued higher-class waiter may have found no victim earlier only
+        because its candidates were mid-admission — the newly-registered
+        request may be exactly the victim it needs (possibly bouncing the
+        registrant itself straight back out, which is correct: lower
+        class yields)."""
+        if self._slot_waiters:
+            self._preempt_for_slot()
 
     def _pick_victim(self, priority: int):
         """Victim for a ``priority``-class admission: strictly lower class
@@ -1235,6 +1375,7 @@ class LLMEngine:
             self._slots[slot] = st
             self.preempt_stats["resumed"] += 1
             self._ensure_ticking()
+            self._recheck_preemption()
         except BaseException as e:
             # resume failed: the consumer must not hang on a silent queue
             st.queue.put_nowait(e)
@@ -1402,6 +1543,7 @@ class PagedLLMEngine(LLMEngine):
         draft_cfg: Optional[TransformerConfig] = None,
         k_draft: int = 4,
         ring_prefill: int = 0,
+        batch_prefill_ms: float = 0.0,
     ):
         from seldon_core_tpu.runtime.paged import (
             PagedConfig,
@@ -1424,7 +1566,8 @@ class PagedLLMEngine(LLMEngine):
                          auto_prefix_granularity=auto_prefix_granularity,
                          mesh=mesh, draft_params=draft_params,
                          draft_cfg=draft_cfg, k_draft=k_draft,
-                         ring_prefill=ring_prefill)
+                         ring_prefill=ring_prefill,
+                         batch_prefill_ms=batch_prefill_ms)
         # speculative verification transiently writes up to k_draft+1 page
         # rows past a slot's final position before the rewind — the same
         # headroom the slab engine adds to cache_len, paid here per
@@ -1711,8 +1854,18 @@ class PagedLLMEngine(LLMEngine):
                 continue
             victim = self._pick_victim(-negp)
             if victim is None:
+                if self._pf_queue:
+                    # candidates may be sitting in the batch-prefill
+                    # window holding pages: flush so they register and
+                    # _recheck_preemption can evict one
+                    self._pf_wake.set()
                 return
             self._preempt(*victim)
+
+    def _recheck_preemption(self) -> None:
+        super()._recheck_preemption()
+        if self._page_waiters:
+            self._preempt_for_pages()
 
     def _release_slot(self, slot: int) -> None:
         pages = self._reserved.pop(slot, None)

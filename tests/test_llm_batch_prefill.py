@@ -1,0 +1,285 @@
+"""Batched admission prefill: dense-path admissions arriving within a
+coalescing window share ONE multi-row prefill program (vLLM-style prefill
+batching) — per-admission dispatch divides across the burst.  The
+contract, like every engine feature, is byte-identical outputs vs the
+solo-prefill engine."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.models.transformer import (
+    TransformerConfig,
+    generate,
+    init_params,
+)
+from seldon_core_tpu.runtime.llm import LLMEngine, PagedLLMEngine
+from seldon_core_tpu.runtime.paged import PagedConfig
+
+TINY = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64, max_seq=64,
+    dtype=jnp.float32,
+)
+PARAMS = init_params(jax.random.PRNGKey(0), TINY)
+
+
+def prompt(L, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (1, L), 0, 64)
+
+
+class TestBatchedPrefill:
+    def test_concurrent_mixed_lengths_byte_identical(self):
+        """A burst of different-length greedy requests coalesces (fewer
+        groups than requests) and each output equals the plain decode."""
+        reqs = [(prompt(3, seed=2), 6), (prompt(5, seed=3), 4),
+                (prompt(9, seed=4), 5), (prompt(4, seed=5), 3)]
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=4, max_len=32,
+                            batch_prefill_ms=30.0)
+            outs = await asyncio.gather(
+                *(eng.generate(p, n) for p, n in reqs)
+            )
+            return eng, outs
+
+        eng, outs = asyncio.run(run())
+        for (p, n), out in zip(reqs, outs):
+            ref = generate(PARAMS, p, n, TINY)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        st = eng.prefill_batch_stats
+        assert st["requests"] == len(reqs)
+        assert st["groups"] < len(reqs)  # the burst actually coalesced
+
+    def test_sampled_byte_identical_to_unbatched_engine(self):
+        """Sampling state is per-request (seeded), so batching the prefill
+        must not change a single sampled token."""
+        kw = dict(temperature=0.8, top_k=16, top_p=0.9)
+        reqs = [(prompt(4, seed=2), 6, 11), (prompt(6, seed=3), 5, 12)]
+
+        async def run(batch_ms):
+            eng = LLMEngine(PARAMS, TINY, max_slots=4, max_len=32,
+                            batch_prefill_ms=batch_ms)
+            return await asyncio.gather(
+                *(eng.generate(p, n, seed=s, **kw) for p, n, s in reqs)
+            )
+
+        batched = asyncio.run(run(30.0))
+        solo = asyncio.run(run(0.0))
+        for b, s in zip(batched, solo):
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(s))
+
+    def test_single_request_window_matches_solo(self):
+        """A window of one (no concurrency) still matches the solo path —
+        the padded-row/array-logit-pos program is exact at B=1."""
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32,
+                            batch_prefill_ms=5.0)
+            out = await eng.generate(prompt(5), 6)
+            assert eng.prefill_batch_stats == {"groups": 1, "requests": 1}
+            return out
+
+        out = asyncio.run(run())
+        ref = generate(PARAMS, prompt(5), 6, TINY)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_paged_engine_composes(self):
+        """Batched prefill feeds the paged insert exactly like solo
+        prefill (same (logits, 1-row cache) contract)."""
+        reqs = [(prompt(4, seed=2), 5), (prompt(7, seed=3), 4),
+                (prompt(5, seed=4), 6)]
+
+        async def run():
+            eng = PagedLLMEngine(
+                PARAMS, TINY, PagedConfig(n_pages=17, page_size=4),
+                max_slots=4, max_len=32, batch_prefill_ms=30.0,
+            )
+            outs = await asyncio.gather(
+                *(eng.generate(p, n) for p, n in reqs)
+            )
+            assert eng.free_pages == 16
+            assert eng.prefill_batch_stats["requests"] == len(reqs)
+            return outs
+
+        outs = asyncio.run(run())
+        for (p, n), out in zip(reqs, outs):
+            ref = generate(PARAMS, p, n, TINY)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_abandoned_waiter_does_not_poison_group(self):
+        """A caller cancelled while waiting for the window must not break
+        the other members of its group."""
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=4, max_len=32,
+                            batch_prefill_ms=50.0)
+            t1 = asyncio.create_task(eng.generate(prompt(4, seed=2), 5))
+            t2 = asyncio.create_task(eng.generate(prompt(6, seed=3), 4))
+            await asyncio.sleep(0.01)  # both join the window
+            t1.cancel()
+            try:
+                await t1
+            except asyncio.CancelledError:
+                pass
+            return await t2
+
+        out = asyncio.run(run())
+        ref = generate(PARAMS, prompt(6, seed=3), 4, TINY)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_full_group_flushes_early(self):
+        """Once every slot's holder has joined the window the group
+        cannot grow — the flusher must dispatch immediately instead of
+        sleeping out the rest of a long window."""
+        import time
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=2, max_len=32,
+                            batch_prefill_ms=8000.0)
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(
+                eng.generate(prompt(4, seed=2), 3),
+                eng.generate(prompt(5, seed=3), 3),
+            )
+            return time.perf_counter() - t0, outs, eng
+
+        elapsed, outs, eng = asyncio.run(run())
+        assert elapsed < 6.0  # compiles only — never the 8 s window
+        assert eng.prefill_batch_stats["groups"] == 1
+        for (p, s), out in zip(((4, 2), (5, 3)), outs):
+            ref = generate(PARAMS, prompt(p, seed=s), 3, TINY)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_high_priority_flushes_window_and_preempts(self):
+        """Window residents hold resources (pages here) while invisible
+        to preemption; a higher-class waiter must flush the window, let
+        them register, and evict one — not starve behind a long window.
+        Priority > 0 also SKIPS the window for its own prefill."""
+        import time
+
+        async def run():
+            # pool: 8 usable pages; each low reserves 4 (4+8+.. rows at
+            # page_size 4) BEFORE entering the window, so the pool is dry
+            # while both sit in an 8 s window (group of 2 < max_slots=4:
+            # no group-full flush) — only the empty-scan wake frees them
+            eng = PagedLLMEngine(
+                PARAMS, TINY, PagedConfig(n_pages=9, page_size=4),
+                max_slots=4, max_len=32, batch_prefill_ms=8000.0,
+            )
+            lows = [
+                asyncio.create_task(eng.generate(prompt(4, seed=s), 10))
+                for s in (2, 3)
+            ]
+            while len(eng._pf_queue) < 2:  # both hold pages, in-window
+                await asyncio.sleep(0.01)
+            assert eng.free_pages == 0
+            t0 = time.perf_counter()
+            high = await eng.generate(prompt(4, seed=5), 4, priority=1)
+            hi_elapsed = time.perf_counter() - t0
+            outs = await asyncio.gather(*lows)
+            return eng, hi_elapsed, high, outs
+
+        eng, hi_elapsed, high, outs = asyncio.run(run())
+        # without the flush-and-recheck path the lows would hold every
+        # page for the full 8 s window; with it, the high request pays
+        # preemption + compiles + its own (window-skipping) solo prefill
+        assert hi_elapsed < 7.0
+        assert eng.preempt_stats["preempted"] >= 1
+        np.testing.assert_array_equal(
+            np.asarray(high),
+            np.asarray(generate(PARAMS, prompt(4, seed=5), 4, TINY)),
+        )
+        for s, out in zip((2, 3), outs):  # victims resumed byte-identically
+            ref = generate(PARAMS, prompt(4, seed=s), 10, TINY)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_slab_slot_waiter_flushes_window(self):
+        """The SLAB engine's slot-waiter branch of the window flush: a
+        higher-class arrival finds no registered victim (one equal-class
+        occupant, the rest hidden in the window), flushes the window, and
+        evicts a resident once it registers."""
+        import time
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=3, max_len=32,
+                            batch_prefill_ms=8000.0)
+            # equal-class occupant: registered, not a victim for the high
+            blocker = eng.stream(prompt(4, seed=7), 20, priority=1)
+            await blocker.__anext__()
+            lows = [
+                asyncio.create_task(eng.generate(prompt(4, seed=s), 10))
+                for s in (2, 3)
+            ]
+            while len(eng._pf_queue) < 2:  # both hold slots, in-window
+                await asyncio.sleep(0.01)
+            assert not eng._free
+            t0 = time.perf_counter()
+            high = await eng.generate(prompt(4, seed=5), 3, priority=1)
+            hi_elapsed = time.perf_counter() - t0
+            outs = await asyncio.gather(*lows)
+            await blocker.aclose()
+            return eng, hi_elapsed, high, outs
+
+        eng, hi_elapsed, high, outs = asyncio.run(run())
+        assert hi_elapsed < 7.0  # never slept out the 8 s window
+        assert eng.preempt_stats["preempted"] >= 1
+        np.testing.assert_array_equal(
+            np.asarray(high),
+            np.asarray(generate(PARAMS, prompt(4, seed=5), 3, TINY)),
+        )
+        for s, out in zip((2, 3), outs):
+            ref = generate(PARAMS, prompt(4, seed=s), 10, TINY)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_group_work_respects_chunk_prefill_budget(self):
+        """chunk_prefill bounds per-program prefill work; a window's
+        group must partition instead of fusing into one B x bucket
+        program that stalls decode ticks."""
+        async def run():
+            # chunk_prefill=16: rows of bucket 8 pack at most 2 per group
+            eng = LLMEngine(PARAMS, TINY, max_slots=6, max_len=32,
+                            chunk_prefill=16, batch_prefill_ms=40.0)
+            reqs = [(prompt(5, seed=s), 3) for s in range(2, 7)]
+            outs = await asyncio.gather(
+                *(eng.generate(p, n) for p, n in reqs)
+            )
+            return eng, reqs, outs
+
+        eng, reqs, outs = asyncio.run(run())
+        st = eng.prefill_batch_stats
+        assert st["requests"] == len(reqs)
+        # 5 rows of bucket 8 under a 16-token budget = ceil(5/2) groups
+        # minimum (later arrivals may open their own window; never fewer)
+        assert st["groups"] >= 3
+        for (p, n), out in zip(reqs, outs):
+            ref = generate(PARAMS, p, n, TINY)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_composes_with_prefix_cache(self):
+        """Prefix-hit admissions keep their extend path; only the dense
+        ones coalesce — and both stay exact side by side."""
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=4, max_len=48,
+                            batch_prefill_ms=30.0)
+            pre = np.asarray(prompt(8, seed=9)[0])
+            eng.register_prefix(pre)
+            with_prefix = np.concatenate(
+                [pre, np.asarray(prompt(3, seed=10)[0])]
+            )
+            outs = await asyncio.gather(
+                eng.generate(with_prefix, 4),       # extend path
+                eng.generate(prompt(5, seed=11), 4),  # batched dense path
+            )
+            return outs
+
+        outs = asyncio.run(run())
+        pre = np.asarray(prompt(8, seed=9)[0])
+        full = np.concatenate([pre, np.asarray(prompt(3, seed=10)[0])])
+        np.testing.assert_array_equal(
+            np.asarray(outs[0]),
+            np.asarray(generate(PARAMS, full[None, :], 4, TINY)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(outs[1]),
+            np.asarray(generate(PARAMS, prompt(5, seed=11), 4, TINY)),
+        )
