@@ -445,6 +445,49 @@ class TestComponentPlumbing:
 
         asyncio.run(run())
 
+    def test_sse_shed_maps_to_http_504(self):
+        """An admission shed before the first token must surface as a
+        REAL HTTP 504 on the SSE route — pre-stream errors never hide
+        inside a 200 event stream.  (The slot is held directly so the
+        scenario is deterministic; engine-level shed/preempt semantics
+        have their own tests above.)"""
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.runtime.component import ComponentHandle
+        from seldon_core_tpu.serving.rest import build_app
+
+        async def run():
+            eng = LLMEngine(PARAMS, TINY, max_slots=1, max_len=32)
+            comp = LLMComponent(eng, n_new=4)
+            app = build_app(component=ComponentHandle(comp, name="llm"))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                slot = await eng._acquire_slot()
+                resp = await client.post("/stream", json={"jsonData": {
+                    "prompt_ids": [1, 2, 3], "n_new": 2,
+                    "admit_timeout_ms": 100.0,
+                }})
+                assert resp.status == 504
+                body = await resp.json()
+                assert body["status"]["reason"] == "DEADLINE_EXCEEDED"
+                assert eng.preempt_stats["shed"] == 1
+                eng._release_slot(slot)
+                # same wire, capacity back: a normal stream completes
+                resp2 = await client.post("/stream", json={"jsonData": {
+                    "prompt_ids": [1, 2, 3], "n_new": 2,
+                }}, timeout=aiohttp.ClientTimeout(total=60))
+                assert resp2.status == 200
+                assert resp2.content_type == "text/event-stream"
+                events = [ln async for ln in resp2.content
+                          if ln.startswith(b"data: ")]
+                assert b'"done": true' in events[-1]
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
     def test_component_default_deadline(self):
         async def run():
             eng = LLMEngine(PARAMS, TINY, max_slots=1, max_len=32)
