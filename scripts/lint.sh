@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Repo lint gate — run locally before pushing; CI runs the same script.
+#
+#   1. ruff        style/correctness lint (config: [tool.ruff] in
+#                  pyproject.toml).  Skipped with a warning when ruff is
+#                  not installed (the hermetic CI image does not ship it).
+#   2. graphlint --self   AST pass: blocking calls on async hot paths,
+#                  host-sync JAX ops inside jit'd functions (RL4xx/RL5xx).
+#   3. graphlint over every shipped example graph, so examples/ never
+#                  drifts dirty (GL1xx/GL2xx/GL3xx).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  ruff check seldon_core_tpu tests scripts
+else
+  echo "lint.sh: ruff not installed — skipping ruff, graphlint still gates" >&2
+fi
+
+echo "== graphlint --self (seldon_core_tpu/) =="
+python -m seldon_core_tpu.analysis --self seldon_core_tpu
+
+echo "== graphlint (examples/graphs/) =="
+python -m seldon_core_tpu.analysis examples/graphs/*.json
+
+echo "lint.sh: OK"

@@ -1,0 +1,47 @@
+"""Static analysis for inference graphs and async/TPU hot paths.
+
+Public surface:
+
+- :func:`lint_graph` / :func:`lint_deployment` — the graph checker
+  (structure, shape/dtype signatures, deadline + HBM feasibility).
+- :func:`lint_paths` — the AST repo-lint pass (blocking calls in async
+  functions, host-sync ops inside jit'd functions).
+- :class:`Finding` — one diagnosed defect with a stable code.
+- :class:`GraphAnalysisError` — raised by operator admission when a spec
+  carries ERROR-severity findings.
+
+CLI: ``python -m seldon_core_tpu.analysis <spec.json | --self>``.
+Finding codes and severities are documented in docs/static-analysis.md.
+"""
+
+from seldon_core_tpu.analysis.findings import (
+    ERROR,
+    INFO,
+    WARN,
+    Finding,
+    errors,
+    make_finding,
+    worst_severity,
+)
+from seldon_core_tpu.analysis.graphlint import (
+    GraphAnalysisError,
+    lint_deployment,
+    lint_graph,
+)
+from seldon_core_tpu.analysis.repolint import lint_file, lint_paths, lint_source
+
+__all__ = [
+    "ERROR",
+    "INFO",
+    "WARN",
+    "Finding",
+    "GraphAnalysisError",
+    "errors",
+    "lint_deployment",
+    "lint_file",
+    "lint_graph",
+    "lint_paths",
+    "lint_source",
+    "make_finding",
+    "worst_severity",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from seldon_core_tpu.analysis.cli import main
+
+sys.exit(main())

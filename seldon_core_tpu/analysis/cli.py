@@ -1,0 +1,123 @@
+"""``python -m seldon_core_tpu.analysis`` — the graphlint CLI.
+
+Two modes:
+
+- ``python -m seldon_core_tpu.analysis spec.json [spec2.json ...]``
+  lints inference-graph specs.  A file holding a full SeldonDeployment
+  (``kind``/``spec.predictors``) lints every predictor graph with the
+  deployment's annotations; a bare graph dict lints standalone
+  (``--deadline-ms`` / ``--hbm-gb`` / ``--chips`` supply the budgets a
+  bare graph has no annotations for).
+
+- ``python -m seldon_core_tpu.analysis --self [PATH ...]`` runs the
+  repo-lint pass (async blocking calls, host-sync-in-jit) over the given
+  files/directories, defaulting to the installed ``seldon_core_tpu``
+  package.
+
+Exit status: 1 if any finding at or above ``--fail-on`` (default:
+``error``) was emitted, else 0 — wired into ``scripts/lint.sh`` and CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from seldon_core_tpu.analysis.findings import ERROR, WARN, Finding
+from seldon_core_tpu.analysis.graphlint import (
+    CHIPS_ANNOTATION,
+    HBM_BUDGET_ANNOTATION,
+    WALK_DEADLINE_ANNOTATION,
+    lint_deployment,
+    lint_graph,
+)
+from seldon_core_tpu.analysis.repolint import lint_paths
+
+
+def _lint_spec_file(path: str, extra_ann: dict) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        try:
+            spec = json.load(f)
+        except ValueError as e:
+            from seldon_core_tpu.analysis.findings import (
+                SPEC_INVALID,
+                make_finding,
+            )
+
+            return [make_finding(SPEC_INVALID, path, f"not valid JSON: {e}")]
+    if isinstance(spec, dict) and (
+            spec.get("kind") == "SeldonDeployment" or "predictors" in
+            (spec.get("spec") or {})):
+        if extra_ann:
+            spec.setdefault("spec", {}).setdefault(
+                "annotations", {}).update(extra_ann)
+        return lint_deployment(spec)
+    return lint_graph(spec, annotations=extra_ann)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m seldon_core_tpu.analysis",
+        description="static analysis for inference graphs and async/TPU "
+                    "hot paths",
+    )
+    ap.add_argument("specs", nargs="*",
+                    help="inference-graph or SeldonDeployment JSON files")
+    ap.add_argument("--self", dest="self_paths", nargs="*", default=None,
+                    metavar="PATH",
+                    help="run the repo-lint pass over PATHs (default: the "
+                         "seldon_core_tpu package)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help=f"walk deadline for bare graphs "
+                         f"({WALK_DEADLINE_ANNOTATION})")
+    ap.add_argument("--chips", type=int, default=None,
+                    help=f"TPU chip count for bare graphs "
+                         f"({CHIPS_ANNOTATION})")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help=f"HBM budget for bare graphs "
+                         f"({HBM_BUDGET_ANNOTATION})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--fail-on", choices=["error", "warn"], default="error",
+                    help="lowest severity that fails the run")
+    args = ap.parse_args(argv)
+
+    if not args.specs and args.self_paths is None:
+        ap.error("give spec files and/or --self")
+
+    extra_ann: dict = {}
+    if args.deadline_ms is not None:
+        extra_ann[WALK_DEADLINE_ANNOTATION] = str(args.deadline_ms)
+    if args.chips is not None:
+        extra_ann[CHIPS_ANNOTATION] = str(args.chips)
+    if args.hbm_gb is not None:
+        extra_ann[HBM_BUDGET_ANNOTATION] = str(args.hbm_gb)
+
+    findings: list[Finding] = []
+    for spec in args.specs:
+        findings.extend(_lint_spec_file(spec, extra_ann))
+    if args.self_paths is not None:
+        paths = args.self_paths or [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]
+        findings.extend(lint_paths(paths))
+
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+    fail_sevs = (ERROR,) if args.fail_on == "error" else (ERROR, WARN)
+    failed = [f for f in findings if f.severity in fail_sevs]
+    if not args.json:
+        n_err = sum(1 for f in findings if f.severity == ERROR)
+        n_warn = sum(1 for f in findings if f.severity == WARN)
+        print(f"graphlint: {n_err} error(s), {n_warn} warning(s), "
+              f"{len(findings) - n_err - n_warn} info")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

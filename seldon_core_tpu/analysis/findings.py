@@ -1,0 +1,106 @@
+"""Finding model shared by both graphlint passes.
+
+A finding is one diagnosed defect with a **stable code** (tests, CI
+greps, and operator status all key on it), a severity, the unit path or
+``file:line`` it anchors to, and a human message.  Codes are grouped:
+
+- ``GL0xx`` — spec-level (parse/validation) failures
+- ``GL1xx`` — structural graph invariants
+- ``GL2xx`` — shape/dtype signature propagation
+- ``GL3xx`` — resource / deadline feasibility
+- ``RL4xx`` — blocking calls on async hot paths (repo lint)
+- ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
+
+Codes are append-only: never renumber or reuse a retired code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+ERROR = "ERROR"
+WARN = "WARN"
+INFO = "INFO"
+
+SEVERITIES = (ERROR, WARN, INFO)
+
+# -- graph checker ----------------------------------------------------------
+SPEC_INVALID = "GL001"          # spec failed to parse/validate at all
+GRAPH_CYCLE = "GL101"           # node reachable from itself
+DUPLICATE_NAME = "GL102"        # two nodes share a name
+COMBINER_ARITY = "GL103"        # COMBINER with < 2 children
+ROUTER_NO_CHILDREN = "GL104"    # ROUTER with no children
+IMPL_TYPE_MISMATCH = "GL105"    # implementation's natural type != node type
+METHOD_TYPE_MISMATCH = "GL106"  # declared method unsupported for node type
+ROUTER_BRANCH_MISMATCH = "GL107"  # router config disagrees with child count
+DTYPE_MISMATCH = "GL201"        # edge dtype disagreement
+SHAPE_MISMATCH = "GL202"        # edge shape disagreement
+UNKNOWN_SIGNATURE = "GL203"     # model_class not in the signature registry
+COMBINER_INPUT_DIVERGENCE = "GL204"  # combiner children disagree on output sig
+DEADLINE_INFEASIBLE = "GL301"   # per-node budgets cannot fit the walk deadline
+HBM_OVER_BUDGET = "GL302"       # estimated HBM footprint exceeds the budget
+HBM_NEAR_BUDGET = "GL303"       # estimated HBM footprint > 80% of the budget
+
+# -- repo lint --------------------------------------------------------------
+BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
+SYNC_OPEN_IN_ASYNC = "RL402"      # file I/O in an async def
+HOST_SYNC_IN_JIT = "RL501"        # block_until_ready/device_get under jit
+HOST_MATERIALIZE_IN_JIT = "RL502"  # np.asarray/.item() on tracers under jit
+
+#: every code → default severity; the single source of truth for docs
+CODE_SEVERITY = {
+    SPEC_INVALID: ERROR,
+    GRAPH_CYCLE: ERROR,
+    DUPLICATE_NAME: ERROR,
+    COMBINER_ARITY: ERROR,
+    ROUTER_NO_CHILDREN: ERROR,
+    IMPL_TYPE_MISMATCH: ERROR,
+    METHOD_TYPE_MISMATCH: WARN,
+    ROUTER_BRANCH_MISMATCH: WARN,
+    DTYPE_MISMATCH: ERROR,
+    SHAPE_MISMATCH: ERROR,
+    UNKNOWN_SIGNATURE: INFO,
+    COMBINER_INPUT_DIVERGENCE: ERROR,
+    DEADLINE_INFEASIBLE: ERROR,
+    HBM_OVER_BUDGET: ERROR,
+    HBM_NEAR_BUDGET: WARN,
+    BLOCKING_CALL_IN_ASYNC: ERROR,
+    SYNC_OPEN_IN_ASYNC: WARN,
+    HOST_SYNC_IN_JIT: ERROR,
+    HOST_MATERIALIZE_IN_JIT: ERROR,
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str
+    severity: str  # ERROR | WARN | INFO
+    path: str      # unit path ("p/root/child") or source location ("f.py:12")
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "path": self.path,
+            "message": self.message,
+        }
+
+    def __str__(self) -> str:
+        return f"{self.severity:5s} {self.code} {self.path}: {self.message}"
+
+
+def make_finding(code: str, path: str, message: str,
+                 severity: str | None = None) -> Finding:
+    return Finding(code, severity or CODE_SEVERITY[code], path, message)
+
+
+def errors(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if f.severity == ERROR]
+
+
+def worst_severity(findings: list[Finding]) -> str | None:
+    for sev in SEVERITIES:
+        if any(f.severity == sev for f in findings):
+            return sev
+    return None

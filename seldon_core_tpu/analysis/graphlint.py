@@ -1,0 +1,474 @@
+"""Static graph checker: deploy-time verification of inference graphs.
+
+Runs entirely on the spec — no model instantiation, no jax import — so
+it is cheap enough for operator admission (``operator/compile.py`` calls
+:func:`lint_deployment` on every compile) and for CI over every shipped
+example.  Three pass families:
+
+1. **Structural** (GL1xx): cycles, duplicate names, combiner arity ≥ 2,
+   router children, implementation/type and method/type compatibility.
+2. **Signatures** (GL2xx): shape/dtype propagation through
+   transformer→model→combiner edges using the static registry in
+   ``seldon_core_tpu/models/__init__.py``; mismatches report the full
+   unit path.
+3. **Feasibility** (GL3xx): critical-path sum of per-node ``timeout_ms``
+   budgets vs. the graph-level ``seldon.io/engine-walk-timeout-ms``
+   deadline, and estimated resident-weight HBM footprint vs. the slice
+   budget (``seldon.io/tpu-chips`` × 16 GiB, or an explicit
+   ``seldon.io/tpu-hbm-gb``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from seldon_core_tpu.analysis.findings import (
+    COMBINER_ARITY,
+    COMBINER_INPUT_DIVERGENCE,
+    DEADLINE_INFEASIBLE,
+    DTYPE_MISMATCH,
+    DUPLICATE_NAME,
+    GRAPH_CYCLE,
+    HBM_NEAR_BUDGET,
+    HBM_OVER_BUDGET,
+    IMPL_TYPE_MISMATCH,
+    METHOD_TYPE_MISMATCH,
+    ROUTER_BRANCH_MISMATCH,
+    ROUTER_NO_CHILDREN,
+    SHAPE_MISMATCH,
+    SPEC_INVALID,
+    UNKNOWN_SIGNATURE,
+    Finding,
+    errors,
+    make_finding,
+)
+from seldon_core_tpu.graph.spec import (
+    BUILTIN_IMPLEMENTATIONS,
+    UNIT_TYPES,
+    GraphValidationError,
+    PredictiveUnit,
+)
+from seldon_core_tpu.models import (
+    BUILTIN_SIGNATURES,
+    ModelSignature,
+    signature_for,
+)
+
+WALK_DEADLINE_ANNOTATION = "seldon.io/engine-walk-timeout-ms"
+CHIPS_ANNOTATION = "seldon.io/tpu-chips"
+HBM_BUDGET_ANNOTATION = "seldon.io/tpu-hbm-gb"
+#: per-chip HBM on v5e
+HBM_PER_CHIP_GB = 16.0
+
+#: implementation → the unit type its graph role requires
+IMPL_NATURAL_TYPE = {
+    "SIMPLE_MODEL": "MODEL",
+    "SIMPLE_ROUTER": "ROUTER",
+    "RANDOM_ABTEST": "ROUTER",
+    "EPSILON_GREEDY": "ROUTER",
+    "AVERAGE_COMBINER": "COMBINER",
+}
+
+#: unit type → methods the engine walk can ever invoke on it
+METHODS_FOR_TYPE = {
+    "MODEL": {"predict", "send_feedback", "stream"},
+    "ROUTER": {"route", "send_feedback"},
+    "COMBINER": {"aggregate", "send_feedback"},
+    "TRANSFORMER": {"transform_input", "send_feedback"},
+    "OUTPUT_TRANSFORMER": {"transform_output", "send_feedback"},
+}
+
+
+class GraphAnalysisError(Exception):
+    """Raised by admission when a spec carries ERROR-severity findings.
+
+    ``operator/compile.py`` converts this into a failed compile; the
+    reconcile loop surfaces ``findings`` on the CR's status."""
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = list(findings)
+        lines = "; ".join(str(f) for f in self.findings)
+        super().__init__(
+            f"graphlint: {len(self.findings)} error finding(s): {lines}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_graph(
+    graph: Any,
+    annotations: Optional[dict] = None,
+    path_prefix: str = "",
+) -> list[Finding]:
+    """Lint one predictor graph (dict, JSON string, or PredictiveUnit).
+
+    ``annotations`` supplies the deployment/predictor-scope flags the
+    feasibility passes read (walk deadline, chip count, HBM budget).
+    """
+    ann = annotations or {}
+    findings: list[Finding] = []
+
+    if isinstance(graph, (str, bytes)):
+        try:
+            graph = json.loads(graph)
+        except ValueError as e:
+            return [make_finding(SPEC_INVALID, path_prefix or "<spec>",
+                                 f"not valid JSON: {e}")]
+    if isinstance(graph, dict):
+        cyc = _find_dict_cycle(graph, path_prefix)
+        if cyc is not None:
+            # a cyclic spec cannot even be parsed into a tree — stop here
+            return [cyc]
+        try:
+            unit = PredictiveUnit.from_dict(graph)
+        except (GraphValidationError, TypeError, KeyError, ValueError) as e:
+            return [make_finding(SPEC_INVALID, path_prefix or "<spec>",
+                                 f"spec does not parse: {e}")]
+    elif isinstance(graph, PredictiveUnit):
+        unit = graph
+        cyc = _find_unit_cycle(unit, path_prefix)
+        if cyc is not None:
+            return [cyc]
+    else:
+        return [make_finding(SPEC_INVALID, path_prefix or "<spec>",
+                             f"unsupported spec type {type(graph).__name__}")]
+
+    findings.extend(_structural_pass(unit, path_prefix))
+    if not errors(findings):
+        findings.extend(_signature_pass(unit, path_prefix))
+        findings.extend(_deadline_pass(unit, ann, path_prefix))
+        findings.extend(_hbm_pass(unit, ann, path_prefix))
+    return findings
+
+
+def lint_deployment(dep: Any) -> list[Finding]:
+    """Lint every predictor graph of a SeldonDeployment (object or dict).
+
+    Finding paths are prefixed with the predictor name, so one rejected
+    deployment pinpoints the exact graph and node."""
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    if isinstance(dep, dict):
+        try:
+            dep = SeldonDeployment.from_dict(dep)
+        except (GraphValidationError, TypeError, KeyError, ValueError) as e:
+            return [make_finding(SPEC_INVALID, "<deployment>",
+                                 f"spec does not parse: {e}")]
+    findings: list[Finding] = []
+    for p in dep.predictors:
+        ann = {**dep.annotations, **p.annotations}
+        findings.extend(lint_graph(p.graph, ann, path_prefix=p.name))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# structural pass (GL1xx)
+# ---------------------------------------------------------------------------
+
+def _find_dict_cycle(d: dict, prefix: str) -> Optional[Finding]:
+    """Detect a node dict reachable from itself (programmatic specs can
+    alias dicts; JSON cannot, but admission sees dicts, not JSON)."""
+    stack: list[int] = []
+
+    def visit(node: dict, path: str) -> Optional[Finding]:
+        if id(node) in stack:
+            return make_finding(
+                GRAPH_CYCLE, path,
+                f"node {node.get('name', '?')!r} is its own ancestor",
+            )
+        stack.append(id(node))
+        try:
+            for c in node.get("children", []) or []:
+                if isinstance(c, dict):
+                    out = visit(c, _join(path, c.get("name", "?")))
+                    if out is not None:
+                        return out
+        finally:
+            stack.pop()
+        return None
+
+    return visit(d, _join(prefix, d.get("name", "?")))
+
+
+def _find_unit_cycle(unit: PredictiveUnit, prefix: str) -> Optional[Finding]:
+    stack: list[int] = []
+
+    def visit(u: PredictiveUnit, path: str) -> Optional[Finding]:
+        if id(u) in stack:
+            return make_finding(GRAPH_CYCLE, path,
+                                f"node {u.name!r} is its own ancestor")
+        stack.append(id(u))
+        try:
+            for c in u.children:
+                out = visit(c, _join(path, c.name))
+                if out is not None:
+                    return out
+        finally:
+            stack.pop()
+        return None
+
+    return visit(unit, _join(prefix, unit.name))
+
+
+def _structural_pass(root: PredictiveUnit, prefix: str) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: dict[str, str] = {}  # name → first path
+
+    def visit(u: PredictiveUnit, path: str) -> None:
+        if u.name in seen:
+            findings.append(make_finding(
+                DUPLICATE_NAME, path,
+                f"duplicate node name {u.name!r} (first at {seen[u.name]})",
+            ))
+        else:
+            seen[u.name] = path
+        t = u.resolved_type
+        if t not in UNIT_TYPES:
+            findings.append(make_finding(
+                SPEC_INVALID, path, f"unknown unit type {t!r}"))
+        impl = u.implementation
+        if impl and impl not in BUILTIN_IMPLEMENTATIONS:
+            findings.append(make_finding(
+                SPEC_INVALID, path, f"unknown implementation {impl!r}"))
+        elif impl and u.type and IMPL_NATURAL_TYPE.get(impl) != u.type:
+            findings.append(make_finding(
+                IMPL_TYPE_MISMATCH, path,
+                f"implementation {impl} plays the "
+                f"{IMPL_NATURAL_TYPE[impl]} role but the node is typed "
+                f"{u.type}; the engine would call the wrong method on it",
+            ))
+        if t == "COMBINER" and len(u.children) < 2:
+            findings.append(make_finding(
+                COMBINER_ARITY, path,
+                f"COMBINER has {len(u.children)} child(ren); aggregation "
+                "needs at least 2",
+            ))
+        if t == "ROUTER" and not u.children:
+            findings.append(make_finding(
+                ROUTER_NO_CHILDREN, path, "ROUTER has no children to route to"))
+        if impl == "RANDOM_ABTEST" and len(u.children) not in (0, 2):
+            findings.append(make_finding(
+                ROUTER_BRANCH_MISMATCH, path,
+                f"RANDOM_ABTEST splits over exactly 2 branches but has "
+                f"{len(u.children)} children",
+            ))
+        if impl == "EPSILON_GREEDY" and u.children:
+            n = u.parameters.get("n_branches", 2)
+            if isinstance(n, (int, float)) and int(n) != len(u.children):
+                findings.append(make_finding(
+                    ROUTER_BRANCH_MISMATCH, path,
+                    f"EPSILON_GREEDY n_branches={int(n)} but the node has "
+                    f"{len(u.children)} children",
+                ))
+        if u.methods:
+            allowed = METHODS_FOR_TYPE.get(t, set())
+            bad = [m for m in u.methods if m.lower() not in allowed]
+            if bad:
+                findings.append(make_finding(
+                    METHOD_TYPE_MISMATCH, path,
+                    f"methods {bad} are never invoked on a {t} node "
+                    f"(allowed: {sorted(allowed)})",
+                ))
+        for c in u.children:
+            visit(c, _join(path, c.name))
+
+    visit(root, _join(prefix, root.name))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# signature pass (GL2xx)
+# ---------------------------------------------------------------------------
+
+def _node_signature(u: PredictiveUnit) -> tuple[Optional[ModelSignature], bool]:
+    """(signature, known): the node's declared contract, if any."""
+    model_class = u.parameters.get("model_class")
+    if isinstance(model_class, str) and model_class:
+        sig = signature_for(model_class)
+        return sig, sig is not None
+    if u.implementation:
+        return BUILTIN_SIGNATURES.get(u.implementation), True
+    return None, True  # remote/container node: no static contract
+
+
+def _shapes_compatible(a: tuple, b: tuple) -> bool:
+    if len(a) != len(b):
+        return False
+    return all(x is None or y is None or x == y for x, y in zip(a, b))
+
+
+def _fmt(shape: Optional[tuple], dtype: Optional[str]) -> str:
+    dims = "?" if shape is None else \
+        "[" + ", ".join("?" if d is None else str(d) for d in shape) + "]"
+    return f"{dtype or '?'}{dims}"
+
+
+def _signature_pass(root: PredictiveUnit, prefix: str) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def check_edge(path: str, src: str,
+                   in_shape, in_dtype, sig: ModelSignature) -> None:
+        if in_dtype and sig.input_dtype and in_dtype != sig.input_dtype:
+            findings.append(make_finding(
+                DTYPE_MISMATCH, path,
+                f"receives {_fmt(in_shape, in_dtype)} from {src} but "
+                f"expects dtype {sig.input_dtype}",
+            ))
+        elif (in_shape is not None and sig.input_shape is not None
+                and not _shapes_compatible(in_shape, sig.input_shape)):
+            findings.append(make_finding(
+                SHAPE_MISMATCH, path,
+                f"receives {_fmt(in_shape, in_dtype)} from {src} but "
+                f"expects {_fmt(sig.input_shape, sig.input_dtype)}",
+            ))
+
+    def transformed(u: PredictiveUnit, path: str, in_shape, in_dtype,
+                    src: str, sig: Optional[ModelSignature]) -> tuple:
+        """(shape, dtype, src) after this node transforms the payload."""
+        if sig is not None:
+            check_edge(path, src, in_shape, in_dtype, sig)
+            if sig.output_shape is not None or sig.output_dtype is not None:
+                return sig.output_shape, sig.output_dtype, u.name
+            if sig.input_shape is None and sig.input_dtype is None:
+                # all-None signature = declared passthrough (outlier scorer)
+                return in_shape, in_dtype, src
+        # transforms the payload with no declared output contract
+        return None, None, u.name
+
+    def visit(u: PredictiveUnit, path: str, in_shape, in_dtype,
+              src: str) -> tuple:
+        """Returns the (shape, dtype) this subtree hands to its consumer."""
+        t = u.resolved_type
+        sig, known = _node_signature(u)
+        if not known:
+            findings.append(make_finding(
+                UNKNOWN_SIGNATURE, path,
+                f"model_class {u.parameters.get('model_class')!r} has no "
+                "registered signature; edge checks skipped",
+            ))
+        # downward transform: MODEL.predict / TRANSFORMER.transform_input /
+        # leaf OUTPUT_TRANSFORMER.transform_output (graph/engine.py order);
+        # ROUTER/COMBINER/non-leaf OUTPUT_TRANSFORMER descend as-is
+        out_shape, out_dtype, my_src = in_shape, in_dtype, src
+        if t in ("MODEL", "TRANSFORMER") or (
+                t == "OUTPUT_TRANSFORMER" and not u.children):
+            out_shape, out_dtype, my_src = transformed(
+                u, path, in_shape, in_dtype, src, sig)
+        if not u.children:
+            return out_shape, out_dtype
+        child_outs = [
+            visit(c, _join(path, c.name), out_shape, out_dtype, my_src)
+            for c in u.children
+        ]
+        if t == "COMBINER":
+            kn = [(c.name, o) for c, o in zip(u.children, child_outs)
+                  if o != (None, None)]
+            if len(kn) >= 2:
+                (n0, o0) = kn[0]
+                for n1, o1 in kn[1:]:
+                    d_ok = not (o0[1] and o1[1]) or o0[1] == o1[1]
+                    s_ok = (o0[0] is None or o1[0] is None
+                            or _shapes_compatible(o0[0], o1[0]))
+                    if not (d_ok and s_ok):
+                        findings.append(make_finding(
+                            COMBINER_INPUT_DIVERGENCE, path,
+                            f"children {n0!r} ({_fmt(*o0)}) and {n1!r} "
+                            f"({_fmt(*o1)}) produce incompatible outputs; "
+                            "aggregation would fail at request time",
+                        ))
+            common = child_outs[0]
+            return common if all(o == common for o in child_outs) else (None, None)
+        # ROUTER picks one child; other types take the first child's output
+        if t == "ROUTER":
+            common = child_outs[0]
+            merged = (common if all(o == common for o in child_outs)
+                      else (None, None))
+        else:
+            merged = child_outs[0]
+        if t == "OUTPUT_TRANSFORMER":
+            # non-leaf: transform_output applies to the merged child output
+            out_shape, out_dtype, _ = transformed(
+                u, path, merged[0], merged[1], u.children[0].name, sig)
+            return out_shape, out_dtype
+        return merged
+
+    visit(root, _join(prefix, root.name), None, None, "<request>")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# feasibility passes (GL3xx)
+# ---------------------------------------------------------------------------
+
+def _num(v) -> Optional[float]:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _deadline_pass(root: PredictiveUnit, ann: dict,
+                   prefix: str) -> list[Finding]:
+    deadline_ms = _num(ann.get(WALK_DEADLINE_ANNOTATION))
+    if not deadline_ms or deadline_ms <= 0:
+        return []
+
+    def critical(u: PredictiveUnit, path: str) -> tuple[float, list[str]]:
+        """(worst-case ms, chain) along the deepest budgeted path.  Child
+        fan-out is concurrent (asyncio.gather), so siblings take max."""
+        own = _num(u.parameters.get("timeout_ms")) or 0.0
+        best: tuple[float, list[str]] = (0.0, [])
+        for c in u.children:
+            sub = critical(c, _join(path, c.name))
+            if sub[0] > best[0]:
+                best = sub
+        chain = ([f"{u.name}({own:g}ms)"] if own else []) + best[1]
+        return own + best[0], chain
+
+    total, chain = critical(root, _join(prefix, root.name))
+    if total > deadline_ms:
+        return [make_finding(
+            DEADLINE_INFEASIBLE, _join(prefix, root.name),
+            f"critical path {' -> '.join(chain)} needs {total:g}ms but "
+            f"{WALK_DEADLINE_ANNOTATION} is {deadline_ms:g}ms — the walk "
+            "deadline always fires before the nodes' own budgets",
+        )]
+    return []
+
+
+def _hbm_pass(root: PredictiveUnit, ann: dict, prefix: str) -> list[Finding]:
+    budget_gb = _num(ann.get(HBM_BUDGET_ANNOTATION))
+    if budget_gb is None:
+        chips = _num(ann.get(CHIPS_ANNOTATION))
+        if not chips or chips <= 0:
+            return []
+        budget_gb = chips * HBM_PER_CHIP_GB
+    total = 0
+    for u in root.walk():
+        sig, _ = _node_signature(u)
+        if sig is not None:
+            total += sig.hbm_bytes
+    total_gb = total / (1 << 30)
+    path = _join(prefix, root.name)
+    if total_gb > budget_gb:
+        return [make_finding(
+            HBM_OVER_BUDGET, path,
+            f"estimated resident weights {total_gb:.2f} GiB exceed the "
+            f"{budget_gb:g} GiB slice budget",
+        )]
+    if total_gb > 0.8 * budget_gb:
+        return [make_finding(
+            HBM_NEAR_BUDGET, path,
+            f"estimated resident weights {total_gb:.2f} GiB are above 80% "
+            f"of the {budget_gb:g} GiB slice budget (no headroom for KV "
+            "caches/activations)",
+        )]
+    return []
+
+
+def _join(prefix: str, name: str) -> str:
+    name = name or "?"
+    return f"{prefix}/{name}" if prefix else name

@@ -37,11 +37,19 @@ class Endpoint:
     type: str = "REST"  # REST | GRPC | LOCAL
 
     @classmethod
-    def from_dict(cls, d: Optional[dict]) -> "Endpoint":
+    def from_dict(cls, d: Optional[dict], unit: str = "") -> "Endpoint":
         d = d or {}
+        raw_port = d.get("service_port", d.get("servicePort", 0)) or 0
+        try:
+            port = int(raw_port)
+        except (TypeError, ValueError):
+            raise GraphValidationError(
+                f"{unit or '<unit>'}: endpoint service_port {raw_port!r} "
+                "is not an integer"
+            ) from None
         return cls(
             service_host=d.get("service_host", d.get("serviceHost", "")),
-            service_port=int(d.get("service_port", d.get("servicePort", 0)) or 0),
+            service_port=port,
             type=d.get("type", "REST"),
         )
 
@@ -53,14 +61,38 @@ class Endpoint:
         }
 
 
-def _coerce_param(value: str, ptype: str) -> Any:
+_BOOL_TRUE = ("1", "true", "yes")
+_BOOL_FALSE = ("0", "false", "no")
+
+
+def _coerce_param(value: str, ptype: str, unit: str = "",
+                  param: str = "") -> Any:
     """Parameter typing per ``seldon_deployment.proto:116-124`` — values are
     strings tagged with a type, materialized as typed kwargs
-    (reference ``microservice.py:155-169`` parse_parameters)."""
+    (reference ``microservice.py:155-169`` parse_parameters).
+
+    Invalid values raise :class:`GraphValidationError` naming the unit's
+    full name path and the parameter, never a bare ``ValueError``."""
+    where = f"{unit or '<unit>'}: parameter {param or '?'!r}"
     if ptype == "BOOL":
-        return str(value).lower() in ("1", "true", "yes")
+        s = str(value).strip().lower()
+        if s in _BOOL_TRUE:
+            return True
+        if s in _BOOL_FALSE:
+            return False
+        raise GraphValidationError(
+            f"{where}: invalid BOOL value {value!r} "
+            f"(expected one of {_BOOL_TRUE + _BOOL_FALSE})"
+        )
     conv = PARAM_TYPES.get(ptype, str)
-    return conv(value) if conv else value
+    if conv is None:
+        return value
+    try:
+        return conv(value)
+    except (TypeError, ValueError):
+        raise GraphValidationError(
+            f"{where}: invalid {ptype} value {value!r}"
+        ) from None
 
 
 @dataclass
@@ -77,17 +109,24 @@ class PredictiveUnit:
     slice_group: str = ""
 
     @classmethod
-    def from_dict(cls, d: dict) -> "PredictiveUnit":
+    def from_dict(cls, d: dict, path: str = "") -> "PredictiveUnit":
+        name = d.get("name", "")
+        # full name path from the root, for error reporting ("root/a/b")
+        path = f"{path}/{name}" if path else (name or "<root>")
         params = {}
         for p in d.get("parameters", []) or []:
-            params[p["name"]] = _coerce_param(p.get("value"), p.get("type", "STRING"))
+            params[p["name"]] = _coerce_param(
+                p.get("value"), p.get("type", "STRING"),
+                unit=path, param=p.get("name", ""),
+            )
         unit = cls(
-            name=d.get("name", ""),
+            name=name,
             type=d.get("type"),
             implementation=d.get("implementation"),
-            children=[cls.from_dict(c) for c in d.get("children", []) or []],
+            children=[cls.from_dict(c, path)
+                      for c in d.get("children", []) or []],
             parameters=params,
-            endpoint=Endpoint.from_dict(d.get("endpoint")),
+            endpoint=Endpoint.from_dict(d.get("endpoint"), unit=path),
             methods=list(d.get("methods", []) or []),
             slice_group=d.get("sliceGroup", ""),
         )

@@ -1,0 +1,99 @@
+"""Model zoo + static serving-signature registry.
+
+Every shipped model class registers a :class:`ModelSignature` here so the
+static graph checker (``seldon_core_tpu/analysis``) can propagate
+shape/dtype information through transformer→model→combiner edges and
+estimate HBM footprints **without importing jax or instantiating models**
+— the registry is a plain table keyed by the same ``module:Class``
+strings users write in the CRD's ``model_class`` parameter.
+
+Third-party components can register their own signatures at import time
+(:func:`register_signature`); unregistered classes simply propagate
+"unknown" and downgrade signature checks to INFO findings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+#: wildcard dimension — matches any size (batch, sequence length, ...)
+ANY = None
+
+Shape = tuple  # of int | None
+
+
+@dataclass(frozen=True)
+class ModelSignature:
+    """Static serving contract of one model class.
+
+    ``None`` anywhere means "unknown/any": a ``None`` dim matches every
+    size; a ``None`` shape or dtype disables the corresponding check.
+    ``hbm_bytes`` is the resident-weights estimate used for slice-budget
+    feasibility (KV caches and activations are workload-dependent and
+    deliberately excluded — the check is a floor, not a ceiling).
+    """
+
+    input_shape: Optional[Shape] = None
+    input_dtype: Optional[str] = None
+    output_shape: Optional[Shape] = None
+    output_dtype: Optional[str] = None
+    hbm_bytes: int = 0
+
+
+def _dense_bytes(sizes: tuple, dtype_bytes: int = 4) -> int:
+    total = 0
+    for m, n in zip(sizes[:-1], sizes[1:]):
+        total += (m * n + n) * dtype_bytes
+    return total
+
+
+#: module:Class → signature, for every model class shipped in this package
+SIGNATURES: dict[str, ModelSignature] = {
+    "seldon_core_tpu.models.iris:IrisClassifier": ModelSignature(
+        input_shape=(ANY, 4), input_dtype="float32",
+        output_shape=(ANY, 3), output_dtype="float32",
+        hbm_bytes=_dense_bytes((4, 3)),
+    ),
+    "seldon_core_tpu.models.mlp:MNISTMLP": ModelSignature(
+        input_shape=(ANY, 784), input_dtype="float32",
+        output_shape=(ANY, 10), output_dtype="float32",
+        hbm_bytes=_dense_bytes((784, 512, 256, 10)),
+    ),
+    "seldon_core_tpu.models.resnet:ResNet50Model": ModelSignature(
+        input_shape=(ANY, 224, 224, 3), input_dtype="float32",
+        output_shape=(ANY, 1000), output_dtype="float32",
+        # ~25.6M params stored in the bf16 serving dtype (models/resnet.py)
+        hbm_bytes=25_600_000 * 2,
+    ),
+    "seldon_core_tpu.models.resnet_int8:Int8ResNet50Model": ModelSignature(
+        input_shape=(ANY, 224, 224, 3), input_dtype="float32",
+        output_shape=(ANY, 1000), output_dtype="float32",
+        hbm_bytes=25_600_000 * 1,
+    ),
+    # token-in/token-out: ragged [batch, seq] int32 ids (runtime/llm.py)
+    "seldon_core_tpu.models.llm_demo:DemoLLM": ModelSignature(
+        input_shape=(ANY, ANY), input_dtype="int32",
+        output_shape=(ANY, ANY), output_dtype="int32",
+        hbm_bytes=2 * 64 * (4 * 64 * 64 + 2 * 64 * 128) * 4,
+    ),
+    # learning transformer: scores rows, passes data through unchanged
+    "seldon_core_tpu.models.outlier:MahalanobisOutlier": ModelSignature(),
+}
+
+#: built-in implementations with a static output contract
+BUILTIN_SIGNATURES: dict[str, ModelSignature] = {
+    # fixed [[1.0, 2.0, 3.0]] broadcast per row (graph/builtins.py)
+    "SIMPLE_MODEL": ModelSignature(
+        output_shape=(ANY, 3), output_dtype="float64",
+    ),
+}
+
+
+def register_signature(model_class: str, sig: ModelSignature) -> None:
+    """Register (or override) the static signature for a ``module:Class``."""
+    SIGNATURES[model_class] = sig
+
+
+def signature_for(model_class: str) -> Optional[ModelSignature]:
+    return SIGNATURES.get(model_class)

@@ -253,10 +253,48 @@ def _probes() -> dict:
     }
 
 
+def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
+    """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
+    ERROR findings reject the spec), ``warn`` (compile anyway), ``off``
+    (skip the graph checker)."""
+    ann = {**dep.annotations, **p.annotations}
+    return ann.get("seldon.io/graphlint", "enforce").strip().lower()
+
+
+def admission_lint(dep: SeldonDeployment) -> list:
+    """Static graph analysis at admission (the deploy-time analog of the
+    reference's validate step, but semantic: structure, shape/dtype edges,
+    deadline/HBM feasibility — docs/static-analysis.md).
+
+    Raises :class:`~seldon_core_tpu.analysis.GraphAnalysisError` when an
+    enforce-mode predictor carries ERROR findings; returns every finding
+    otherwise so callers can surface WARN/INFO."""
+    from seldon_core_tpu.analysis.graphlint import (
+        GraphAnalysisError,
+        lint_graph,
+    )
+
+    findings = []
+    rejects = []
+    for p in dep.predictors:
+        mode = graphlint_mode(dep, p)
+        if mode == "off":
+            continue
+        ann = {**dep.annotations, **p.annotations}
+        fs = lint_graph(p.graph, ann, path_prefix=p.name)
+        findings.extend(fs)
+        if mode != "warn":
+            rejects.extend(f for f in fs if f.severity == "ERROR")
+    if rejects:
+        raise GraphAnalysisError(rejects)
+    return findings
+
+
 def compile_deployment(dep: SeldonDeployment) -> list[dict]:
-    """validate → default → manifests (Deployments + Services + optionally
-    per-component resources)."""
+    """validate → lint → default → manifests (Deployments + Services +
+    optionally per-component resources)."""
     validate_deployment(dep)
+    admission_lint(dep)
     defaulting(dep)
     manifests: list[dict] = []
     for p in dep.predictors:

@@ -293,6 +293,12 @@ class SeldonDeploymentController:
                 "state": "Failed",
                 "description": f"{type(e).__name__}: {e}",
             }
+            # graphlint rejection: surface the structured findings (code,
+            # severity, unit path, message) on the CR status so clients
+            # can pinpoint the offending node without parsing the message
+            findings = getattr(e, "findings", None)
+            if findings:
+                status["analysis"] = [f.to_dict() for f in findings]
             self._write_status(ns, name, status, prev=cr.get("status"))
             return status
 

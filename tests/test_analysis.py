@@ -1,0 +1,565 @@
+"""graphlint: the static-analysis subsystem (ISSUE 1).
+
+Every ERROR/WARN finding code is pinned here with a seeded bad spec (or
+seeded bad source, for the repo-lint pass) asserting the exact code and
+unit path, so codes stay stable across refactors.  Admission wiring
+(compile refuses ERROR-bearing specs, reconcile surfaces findings on CR
+status) is covered at the bottom.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from seldon_core_tpu.analysis import (
+    GraphAnalysisError,
+    lint_deployment,
+    lint_graph,
+    lint_source,
+)
+from seldon_core_tpu.analysis.cli import main as analysis_main
+
+
+def _model(name, model_class, extra_params=(), children=()):
+    return {
+        "name": name,
+        "type": "MODEL",
+        "parameters": [
+            {"name": "model_class", "value": model_class, "type": "STRING"},
+            *extra_params,
+        ],
+        "children": list(children),
+    }
+
+
+IRIS = "seldon_core_tpu.models.iris:IrisClassifier"
+MLP = "seldon_core_tpu.models.mlp:MNISTMLP"
+LLM = "seldon_core_tpu.models.llm_demo:DemoLLM"
+RESNET = "seldon_core_tpu.models.resnet:ResNet50Model"
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def the(findings, code):
+    hits = [f for f in findings if f.code == code]
+    assert len(hits) == 1, f"expected exactly one {code}, got {findings}"
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# the five seeded invalid specs (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_seeded_cycle_gl101():
+    node = {"name": "x", "type": "MODEL"}
+    node["children"] = [node]  # programmatic spec aliasing itself
+    f = the(lint_graph(node), "GL101")
+    assert f.severity == "ERROR"
+    assert f.path == "x/x"
+
+
+def test_seeded_duplicate_name_gl102():
+    f = the(lint_graph(_model("a", IRIS, children=[_model("a", IRIS)])),
+            "GL102")
+    assert f.severity == "ERROR"
+    assert f.path == "a/a"
+
+
+def test_seeded_one_child_combiner_gl103():
+    spec = {
+        "name": "ens",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [_model("m", IRIS)],
+    }
+    f = the(lint_graph(spec), "GL103")
+    assert f.severity == "ERROR"
+    assert f.path == "ens"
+
+
+def test_seeded_dtype_mismatch_gl201():
+    # float32 probabilities fed into an int32 token-id model
+    f = the(lint_graph(_model("iris", IRIS, children=[_model("llm", LLM)])),
+            "GL201")
+    assert f.severity == "ERROR"
+    assert f.path == "iris/llm"
+    assert "int32" in f.message
+
+
+def test_seeded_infeasible_deadline_gl301():
+    spec = {
+        "name": "pre", "type": "TRANSFORMER",
+        "parameters": [{"name": "timeout_ms", "value": "800", "type": "INT"}],
+        "children": [_model(
+            "m", IRIS,
+            extra_params=[{"name": "timeout_ms", "value": "800",
+                           "type": "INT"}],
+        )],
+    }
+    ann = {"seldon.io/engine-walk-timeout-ms": "1000"}
+    f = the(lint_graph(spec, annotations=ann), "GL301")
+    assert f.severity == "ERROR"
+    assert f.path == "pre"
+    assert "1600" in f.message and "1000" in f.message
+    # a feasible budget is silent
+    assert lint_graph(spec, annotations={
+        "seldon.io/engine-walk-timeout-ms": "2000"}) == []
+
+
+# ---------------------------------------------------------------------------
+# remaining graph-checker codes
+# ---------------------------------------------------------------------------
+
+def test_shape_mismatch_gl202_with_full_path():
+    spec = _model("mlp", MLP, children=[_model("iris", IRIS)])
+    f = the(lint_graph(spec), "GL202")
+    assert f.path == "mlp/iris"
+    assert "[?, 10]" in f.message and "[?, 4]" in f.message
+
+
+def test_impl_type_mismatch_gl105():
+    spec = {
+        "name": "x", "type": "MODEL",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [{"name": "a", "type": "MODEL"},
+                     {"name": "b", "type": "MODEL"}],
+    }
+    f = the(lint_graph(spec), "GL105")
+    assert f.severity == "ERROR"
+
+
+def test_router_no_children_gl104_and_branch_mismatch_gl107():
+    f = the(lint_graph({"name": "r", "type": "ROUTER"}), "GL104")
+    assert f.severity == "ERROR"
+    spec = {
+        "name": "ab", "implementation": "RANDOM_ABTEST",
+        "children": [{"name": "a", "type": "MODEL"},
+                     {"name": "b", "type": "MODEL"},
+                     {"name": "c", "type": "MODEL"}],
+    }
+    f = the(lint_graph(spec), "GL107")
+    assert f.severity == "WARN"
+    assert "3 children" in f.message
+
+
+def test_method_type_mismatch_gl106():
+    spec = {"name": "m", "type": "MODEL", "methods": ["route"],
+            "parameters": [{"name": "model_class", "value": IRIS,
+                            "type": "STRING"}]}
+    f = the(lint_graph(spec), "GL106")
+    assert f.severity == "WARN"
+    # correct method declaration is silent
+    ok = {"name": "m", "type": "MODEL", "methods": ["predict"],
+          "parameters": [{"name": "model_class", "value": IRIS,
+                          "type": "STRING"}]}
+    assert lint_graph(ok) == []
+
+
+def test_unknown_signature_gl203_is_info():
+    spec = _model("m", "my.pkg:UnknownModel")
+    f = the(lint_graph(spec), "GL203")
+    assert f.severity == "INFO"
+
+
+def test_combiner_divergence_gl204():
+    spec = {
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [_model("a", IRIS), _model("b", MLP)],
+    }
+    f = the(lint_graph(spec), "GL204")
+    assert f.severity == "ERROR"
+    assert "'a'" in f.message and "'b'" in f.message
+
+
+def test_hbm_budget_gl302_gl303():
+    two_resnets = {
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [_model("r1", RESNET), _model("r2", RESNET)],
+    }
+    f = the(lint_graph(two_resnets,
+                       annotations={"seldon.io/tpu-hbm-gb": "0.05"}), "GL302")
+    assert f.severity == "ERROR"
+    f = the(lint_graph(two_resnets,
+                       annotations={"seldon.io/tpu-hbm-gb": "0.11"}), "GL303")
+    assert f.severity == "WARN"
+    # a real slice budget (chips annotation) is plenty
+    assert lint_graph(two_resnets,
+                      annotations={"seldon.io/tpu-chips": "4"}) == []
+
+
+def test_transformer_passthrough_preserves_signature():
+    # outlier transformer passes data through: iris behind it still checks
+    spec = {
+        "name": "out", "type": "TRANSFORMER",
+        "parameters": [{"name": "model_class",
+                        "value": "seldon_core_tpu.models.outlier:"
+                                 "MahalanobisOutlier", "type": "STRING"}],
+        "children": [_model("iris", IRIS,
+                            children=[_model("llm", LLM)])],
+    }
+    f = the(lint_graph(spec), "GL201")
+    assert f.path == "out/iris/llm"
+
+
+def test_spec_invalid_gl001():
+    assert codes(lint_graph({"name": "x", "type": "NOPE"})) == ["GL001"]
+    assert codes(lint_graph("{not json")) == ["GL001"]
+
+
+def test_signature_registry_is_extensible():
+    from seldon_core_tpu.models import (
+        SIGNATURES,
+        ModelSignature,
+        register_signature,
+    )
+
+    key = "tests.fake:Tiny"
+    register_signature(key, ModelSignature(
+        input_shape=(None, 2), input_dtype="float32",
+        output_shape=(None, 1), output_dtype="float32"))
+    try:
+        spec = _model("iris", IRIS, children=[_model("t", key)])
+        f = the(lint_graph(spec), "GL202")
+        assert f.path == "iris/t"
+    finally:
+        SIGNATURES.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# repo lint (RL4xx / RL5xx)
+# ---------------------------------------------------------------------------
+
+def _lint_src(src):
+    return lint_source(textwrap.dedent(src), "mod.py")
+
+
+def test_blocking_call_in_async_rl401():
+    findings = _lint_src("""
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """)
+    f = the(findings, "RL401")
+    assert f.severity == "ERROR"
+    assert f.path == "mod.py:5"
+
+
+def test_sync_http_and_open_in_async():
+    findings = _lint_src("""
+        import requests
+        import urllib.request
+
+        async def fetch():
+            requests.get("http://x")
+            urllib.request.urlopen("http://x")
+            open("/etc/hosts")
+    """)
+    assert codes(findings) == ["RL401", "RL401", "RL402"]
+
+
+def test_nested_sync_def_is_not_async_context():
+    findings = _lint_src("""
+        import time
+
+        async def outer():
+            def sync_helper():
+                time.sleep(1)  # runs in an executor — sync context
+            return sync_helper
+    """)
+    assert findings == []
+
+
+def test_import_aliases_resolved():
+    findings = _lint_src("""
+        from time import sleep
+        import requests as rq
+
+        async def h():
+            sleep(1)
+            rq.post("http://x")
+    """)
+    assert codes(findings) == ["RL401", "RL401"]
+    # asyncio.sleep via from-import is NOT blocking
+    findings = _lint_src("""
+        from asyncio import sleep
+
+        async def h():
+            await sleep(1)
+    """)
+    assert findings == []
+
+
+def test_jnp_asarray_not_flagged_in_jit():
+    findings = _lint_src("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x)
+    """)
+    assert findings == []
+
+
+def test_sync_code_not_flagged():
+    findings = _lint_src("""
+        import time
+
+        def sweep():
+            time.sleep(5)
+    """)
+    assert findings == []
+
+
+def test_host_sync_in_jit_rl501_rl502():
+    findings = _lint_src("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            x.block_until_ready()
+            return np.asarray(x)
+    """)
+    assert codes(findings) == ["RL501", "RL502"]
+    # partial(jax.jit, ...) spelling too
+    findings = _lint_src("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=0)
+        def step(n, x):
+            return x.item()
+    """)
+    assert codes(findings) == ["RL502"]
+
+
+def test_unjitted_host_sync_is_fine():
+    findings = _lint_src("""
+        import numpy as np
+
+        def materialize(x):
+            x.block_until_ready()
+            return np.asarray(x)
+    """)
+    assert findings == []
+
+
+def test_pragma_suppression():
+    findings = _lint_src("""
+        import time
+
+        async def handler():
+            time.sleep(0)  # graphlint: disable=RL401
+    """)
+    assert findings == []
+    findings = _lint_src("""
+        # graphlint: skip-file
+        import time
+
+        async def handler():
+            time.sleep(0)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [{"name": "m", "type": "MODEL"}],
+    }))
+    assert analysis_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "GL103" in out
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_model("m", IRIS)))
+    assert analysis_main([str(good)]) == 0
+
+
+def test_cli_json_output_and_deadline_flag(tmp_path, capsys):
+    spec = tmp_path / "g.json"
+    spec.write_text(json.dumps(_model(
+        "m", IRIS,
+        extra_params=[{"name": "timeout_ms", "value": "500", "type": "INT"}],
+    )))
+    assert analysis_main([str(spec), "--deadline-ms", "100", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["code"] for f in payload] == ["GL301"]
+
+
+def test_cli_self_on_seeded_bad_file(tmp_path):
+    mod = tmp_path / "hot.py"
+    mod.write_text(textwrap.dedent("""
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """))
+    assert analysis_main(["--self", str(mod)]) == 1
+
+
+def test_cli_module_invocation_runs():
+    p = subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.analysis", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0
+    assert "--self" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# operator admission wiring
+# ---------------------------------------------------------------------------
+
+def _deployment(graph, annotations=None):
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "d"},
+        "spec": {
+            "name": "d",
+            "annotations": annotations or {},
+            "predictors": [{"name": "p", "graph": graph}],
+        },
+    }
+
+
+BAD_GRAPH = {
+    "name": "ens", "type": "COMBINER",
+    "implementation": "AVERAGE_COMBINER",
+    "children": [_model("m", IRIS)],
+}
+
+
+def test_compile_refuses_error_bearing_spec():
+    from seldon_core_tpu.operator.compile import compile_deployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    dep = SeldonDeployment.from_dict(_deployment(BAD_GRAPH))
+    with pytest.raises(GraphAnalysisError) as ei:
+        compile_deployment(dep)
+    assert any(f.code == "GL103" for f in ei.value.findings)
+    assert "p/ens" in str(ei.value)
+
+
+def test_compile_graphlint_warn_and_off_modes():
+    from seldon_core_tpu.operator.compile import compile_deployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    for mode in ("warn", "off"):
+        dep = SeldonDeployment.from_dict(_deployment(
+            BAD_GRAPH, annotations={"seldon.io/graphlint": mode}))
+        manifests = compile_deployment(dep)
+        assert manifests  # compiles despite the ERROR finding
+
+
+def test_lint_deployment_prefixes_predictor_name():
+    f = the(lint_deployment(_deployment(BAD_GRAPH)), "GL103")
+    assert f.path == "p/ens"
+
+
+def test_reconcile_surfaces_findings_in_status():
+    from seldon_core_tpu.operator.reconcile import (
+        FakeKubeApi,
+        SeldonDeploymentController,
+    )
+
+    api = FakeKubeApi()
+    cr = _deployment(BAD_GRAPH)
+    cr["metadata"]["namespace"] = "default"
+    api.create(cr)
+    status = SeldonDeploymentController(api).reconcile(cr)
+    assert status["state"] == "Failed"
+    assert "GL103" in status["description"]
+    analysis = status.get("analysis")
+    assert analysis and analysis[0]["code"] == "GL103"
+    assert analysis[0]["path"] == "p/ens"
+    # and it landed on the CR's status subresource
+    live = api.get("SeldonDeployment", "default", "d")
+    assert live["status"]["analysis"][0]["code"] == "GL103"
+
+
+# ---------------------------------------------------------------------------
+# graph/spec.py error reporting (satellite)
+# ---------------------------------------------------------------------------
+
+def test_invalid_port_raises_graph_validation_error_with_path():
+    from seldon_core_tpu.graph.spec import (
+        GraphValidationError,
+        PredictiveUnit,
+    )
+
+    bad = {
+        "name": "root", "type": "MODEL",
+        "children": [{
+            "name": "leaf", "type": "MODEL",
+            "endpoint": {"service_host": "h", "service_port": "http"},
+        }],
+    }
+    with pytest.raises(GraphValidationError) as ei:
+        PredictiveUnit.from_dict(bad)
+    assert "root/leaf" in str(ei.value)
+    assert "service_port" in str(ei.value)
+
+
+def test_invalid_bool_param_raises_with_path():
+    from seldon_core_tpu.graph.spec import (
+        GraphValidationError,
+        PredictiveUnit,
+    )
+
+    bad = {
+        "name": "root", "type": "MODEL",
+        "children": [{
+            "name": "leaf", "type": "MODEL",
+            "parameters": [{"name": "verbose", "value": "maybe",
+                            "type": "BOOL"}],
+        }],
+    }
+    with pytest.raises(GraphValidationError) as ei:
+        PredictiveUnit.from_dict(bad)
+    msg = str(ei.value)
+    assert "root/leaf" in msg and "verbose" in msg and "maybe" in msg
+
+
+def test_invalid_int_param_raises_with_path():
+    from seldon_core_tpu.graph.spec import (
+        GraphValidationError,
+        PredictiveUnit,
+    )
+
+    with pytest.raises(GraphValidationError) as ei:
+        PredictiveUnit.from_dict({
+            "name": "m", "type": "MODEL",
+            "parameters": [{"name": "seed", "value": "ten", "type": "INT"}],
+        })
+    assert "m" in str(ei.value) and "seed" in str(ei.value)
+
+
+def test_valid_bool_spellings_still_coerce():
+    from seldon_core_tpu.graph.spec import PredictiveUnit
+
+    unit = PredictiveUnit.from_dict({
+        "name": "m", "type": "MODEL",
+        "parameters": [
+            {"name": "a", "value": "true", "type": "BOOL"},
+            {"name": "b", "value": "0", "type": "BOOL"},
+            {"name": "c", "value": "YES", "type": "BOOL"},
+        ],
+    })
+    assert unit.parameters == {"a": True, "b": False, "c": True}
