@@ -105,6 +105,174 @@ def bench_graph_fanout(seconds: float = 3.0, concurrency: int = 64) -> float:
     return asyncio.run(run())
 
 
+def _plan_bench_graphs():
+    """(linear 3-node spec, combiner spec, resolver, request array) for the
+    walk-vs-plan microbench: three chained pure-JAX MODELs (dim-preserving
+    so the chain composes) and an AVERAGE_COMBINER fan-in over three."""
+    import numpy as np
+
+    from seldon_core_tpu.models.mlp import MNISTMLP
+
+    dim = 64
+
+    class SquareMLP(MNISTMLP):
+        """Dim-preserving MLP so a 3-deep chain composes."""
+
+        class_names = None
+
+        def __init__(self, seed=0):
+            from seldon_core_tpu.models.mlp import init_mlp_params
+            import jax
+
+            self.params = init_mlp_params(
+                jax.random.PRNGKey(seed), (dim, dim, dim))
+
+    mod = sys.modules[__name__]
+    mod.SquareMLP = SquareMLP  # importable via model_class
+
+    def node(name, seed):
+        return {
+            "name": name, "type": "MODEL",
+            "parameters": [
+                {"name": "model_class", "value": f"{__name__}:SquareMLP",
+                 "type": "STRING"},
+                {"name": "seed", "value": str(seed), "type": "INT"},
+            ],
+        }
+
+    linear = node("m1", 0)
+    linear["children"] = [node("m2", 1)]
+    linear["children"][0]["children"] = [node("m3", 2)]
+    combiner = {
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [node(f"c{i}", i) for i in range(3)],
+    }
+
+    from seldon_core_tpu.operator.local import resolve_component
+
+    resolver = lambda u: resolve_component(u, {"seldon.io/batching": "false"})
+    x = np.random.default_rng(0).normal(size=(1, dim)).astype(np.float32)
+    return linear, combiner, resolver, x
+
+
+def _count_walk_dispatches(eng) -> list:
+    """Wrap every node's compiled callable with a counter (walk mode
+    issues one device dispatch per compiled node per request)."""
+    counter = [0]
+    for node in eng._nodes.values():
+        handle = getattr(node.impl, "handle", node.impl)
+        fn = getattr(handle, "_compiled", None)
+        if fn is None:
+            continue
+
+        def counted(*a, _fn=fn, **kw):
+            counter[0] += 1
+            return _fn(*a, **kw)
+
+        handle._compiled = counted
+    return counter
+
+
+def bench_graph_plan(seconds: float = 2.0) -> dict:
+    """Walk vs fused-plan on the linear 3-node and combiner graphs: device
+    dispatches per request (3 -> 1) and host p50 per predict."""
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.messages import SeldonMessage
+
+    linear, combiner, resolver, x = _plan_bench_graphs()
+    out: dict = {}
+    for label, spec in (("linear3", linear), ("combiner", combiner)):
+        walk = GraphEngine(spec, resolver=resolver, name=label)
+        fused = GraphEngine(spec, resolver=resolver, name=label,
+                            plan_mode="fused")
+        wcount = _count_walk_dispatches(walk)
+        seg = fused.plan.segments[0]
+
+        def p50_us(eng, n_warm=20) -> float:
+            msg = SeldonMessage.from_ndarray(x)
+            for _ in range(n_warm):
+                eng.predict_sync(msg)
+            lat = []
+            t_end = time.perf_counter() + seconds / 2
+            while time.perf_counter() < t_end:
+                t0 = time.perf_counter()
+                eng.predict_sync(SeldonMessage.from_ndarray(x))
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+            return lat[len(lat) // 2] * 1e6
+
+        walk_p50 = p50_us(walk)
+        fused_p50 = p50_us(fused)
+
+        # dispatches for ONE request, measured exactly
+        wcount[0] = 0
+        walk.predict_sync(SeldonMessage.from_ndarray(x))
+        walk_disp = wcount[0]
+        n0 = seg.n_calls
+        fused.predict_sync(SeldonMessage.from_ndarray(x))
+        fused_disp = seg.n_calls - n0
+        out[label] = {
+            "walk_p50_us": round(walk_p50, 1),
+            "fused_p50_us": round(fused_p50, 1),
+            "speedup": round(walk_p50 / fused_p50, 2) if fused_p50 else None,
+            "walk_dispatches_per_req": walk_disp,
+            "fused_dispatches_per_req": fused_disp,
+            "fused_nodes": len(seg.members),
+        }
+    return out
+
+
+def plan_smoke() -> int:
+    """Fast CI gate (CPU JAX, tiny graphs): the fused plan must actually
+    fuse — a regression that silently falls back to the interpreter walk
+    fails here, not in production.  Returns a process exit code."""
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.messages import SeldonMessage
+
+    linear, combiner, resolver, x = _plan_bench_graphs()
+    failures = []
+    report: dict = {}
+    # (label, spec, fused segment size, walk-mode JITTED dispatches — the
+    # eager AVERAGE_COMBINER ops in walk mode are extra host round-trips
+    # on top, not counted here)
+    for label, spec, n_nodes, walk_disp_exp in (
+            ("linear3", linear, 3, 3), ("combiner", combiner, 4, 3)):
+        walk = GraphEngine(spec, resolver=resolver, name=label)
+        fused = GraphEngine(spec, resolver=resolver, name=label,
+                            plan_mode="fused")
+        if fused.plan is None or not fused.plan.fully_fused:
+            failures.append(f"{label}: plan did not fully fuse "
+                            f"({fused.plan and fused.plan.describe()})")
+            continue
+        seg = fused.plan.segments[0]
+        if len(seg.members) != n_nodes:
+            failures.append(
+                f"{label}: fused {len(seg.members)} nodes, expected {n_nodes}")
+        wcount = _count_walk_dispatches(walk)
+        msg = SeldonMessage.from_ndarray(x)
+        msg.meta.puid = "smoke"
+        a = walk.predict_sync(msg)
+        msg2 = SeldonMessage.from_ndarray(x)
+        msg2.meta.puid = "smoke"
+        n0 = seg.n_calls
+        b = fused.predict_sync(msg2)
+        fused_disp = seg.n_calls - n0
+        if fused_disp != 1:
+            failures.append(f"{label}: fused path issued {fused_disp} "
+                            "device dispatches, expected exactly 1")
+        if wcount[0] != walk_disp_exp:
+            failures.append(f"{label}: walk path issued {wcount[0]} "
+                            f"dispatches, expected {walk_disp_exp}")
+        if a.to_dict() != b.to_dict():
+            failures.append(f"{label}: fused response != walk response")
+        report[label] = {"walk_dispatches": wcount[0],
+                         "fused_dispatches": fused_disp,
+                         "parity": a.to_dict() == b.to_dict()}
+    print(json.dumps({"plan_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 RESNET50_GFLOPS = 8.2  # fwd FLOPs per 224x224 image: 4.1 GMACs x 2 FLOPs/MAC
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak, TPU v5e
 
@@ -1373,9 +1541,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--skip-resnet", action="store_true")
+    ap.add_argument("--plan-smoke", action="store_true",
+                    help="fast CI gate: assert the fused graph plan "
+                         "actually fuses (1 dispatch, walk parity) on "
+                         "tiny CPU graphs, then exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
+    if args.plan_smoke:
+        sys.exit(plan_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice
@@ -1389,6 +1563,10 @@ def main() -> None:
     extras: dict = {}
     orch = bench_orchestrator(args.seconds)
     extras["graph_fanout_req_per_s"] = round(bench_graph_fanout(args.seconds), 1)
+    try:
+        extras["graph_plan"] = bench_graph_plan(min(args.seconds, 2.0))
+    except Exception as e:
+        extras["graph_plan_error"] = f"{type(e).__name__}: {e}"
     # headline wire tier: native servers + Python engine + native loadgen
     try:
         rest = bench_rest_socket_native(args.seconds)
@@ -1525,6 +1703,12 @@ def main() -> None:
     _pick(extras, ["open_loop", "rate_500", "p50_ms"], "openloop500_p50_ms", 2)
     _pick(extras, ["open_loop", "rate_500", "p99_ms"], "openloop500_p99_ms", 2)
     _pick(extras, ["batched_serving_req_per_s"], "batched_rps")
+    _pick(extras, ["graph_plan", "linear3", "walk_p50_us"],
+          "plan_walk_p50_us")
+    _pick(extras, ["graph_plan", "linear3", "fused_p50_us"],
+          "plan_fused_p50_us")
+    _pick(extras, ["graph_plan", "linear3", "fused_dispatches_per_req"],
+          "plan_dispatches", 0)
     _pick(extras, ["resnet50", "mfu_pct"], "resnet_mfu_pct")
     _pick(extras, ["resnet50", "img_per_s"], "resnet_img_per_s")
     _pick(extras, ["llm_decode", "bf16_tokens_per_s"], "llm_tok_per_s")

@@ -8,6 +8,8 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL1xx`` — structural graph invariants
 - ``GL2xx`` — shape/dtype signature propagation
 - ``GL3xx`` — resource / deadline feasibility
+- ``GL6xx`` — graph-plan fusion report (which segments fuse, and why the
+  rest stay interpreter boundaries)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -40,6 +42,10 @@ COMBINER_INPUT_DIVERGENCE = "GL204"  # combiner children disagree on output sig
 DEADLINE_INFEASIBLE = "GL301"   # per-node budgets cannot fit the walk deadline
 HBM_OVER_BUDGET = "GL302"       # estimated HBM footprint exceeds the budget
 HBM_NEAR_BUDGET = "GL303"       # estimated HBM footprint > 80% of the budget
+PLAN_SEGMENT_FUSED = "GL601"    # graph-plan: nodes fused into one segment
+PLAN_NODE_BOUNDARY = "GL602"    # graph-plan: node stays an interpreter boundary
+PLAN_NOTHING_FUSED = "GL603"    # fused mode requested but no segment fused
+PLAN_MODE_INVALID = "GL604"     # seldon.io/graph-plan value unknown
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -64,6 +70,10 @@ CODE_SEVERITY = {
     DEADLINE_INFEASIBLE: ERROR,
     HBM_OVER_BUDGET: ERROR,
     HBM_NEAR_BUDGET: WARN,
+    PLAN_SEGMENT_FUSED: INFO,
+    PLAN_NODE_BOUNDARY: INFO,
+    PLAN_NOTHING_FUSED: WARN,
+    PLAN_MODE_INVALID: ERROR,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

@@ -16,6 +16,10 @@ example.  Three pass families:
    deadline, and estimated resident-weight HBM footprint vs. the slice
    budget (``seldon.io/tpu-chips`` × 16 GiB, or an explicit
    ``seldon.io/tpu-hbm-gb``).
+4. **Graph-plan fusion** (GL6xx, only when ``seldon.io/graph-plan`` is
+   set): predicts which subgraphs the plan compiler (``graph/plan.py``)
+   will fuse into single jitted segments and reports why every other
+   node stays an interpreter boundary.
 """
 
 from __future__ import annotations
@@ -34,6 +38,10 @@ from seldon_core_tpu.analysis.findings import (
     HBM_OVER_BUDGET,
     IMPL_TYPE_MISMATCH,
     METHOD_TYPE_MISMATCH,
+    PLAN_MODE_INVALID,
+    PLAN_NODE_BOUNDARY,
+    PLAN_NOTHING_FUSED,
+    PLAN_SEGMENT_FUSED,
     ROUTER_BRANCH_MISMATCH,
     ROUTER_NO_CHILDREN,
     SHAPE_MISMATCH,
@@ -141,6 +149,7 @@ def lint_graph(
         findings.extend(_signature_pass(unit, path_prefix))
         findings.extend(_deadline_pass(unit, ann, path_prefix))
         findings.extend(_hbm_pass(unit, ann, path_prefix))
+        findings.extend(_plan_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -467,6 +476,123 @@ def _hbm_pass(root: PredictiveUnit, ann: dict, prefix: str) -> list[Finding]:
             "caches/activations)",
         )]
     return []
+
+
+# ---------------------------------------------------------------------------
+# graph-plan fusion pass (GL6xx)
+# ---------------------------------------------------------------------------
+
+PLAN_ANNOTATION = "seldon.io/graph-plan"
+#: node types the plan compiler may fuse (mirrors graph/plan.py)
+PLAN_FUSIBLE_TYPES = ("MODEL", "TRANSFORMER", "OUTPUT_TRANSFORMER",
+                      "COMBINER")
+#: built-ins with a pure on-device implementation the compiler can trace
+#: (SIMPLE_MODEL is float64-on-host by contract, so it never fuses)
+PLAN_FUSIBLE_BUILTINS = ("AVERAGE_COMBINER",)
+
+
+def _plan_boundary_reason(u: PredictiveUnit) -> Optional[str]:
+    """Why this node statically cannot fuse, or None if it can.
+
+    Mirrors the runtime test in ``graph/plan.py`` with the knowledge the
+    spec carries: the signature registry's ``pure_fn`` flag stands in for
+    "exposes a pure tensor function" (the runtime inspects the live
+    object; admission cannot)."""
+    t = u.resolved_type
+    if t == "ROUTER":
+        return "ROUTER: data-dependent branch choice cannot be traced"
+    if t not in PLAN_FUSIBLE_TYPES:
+        return f"type {t} is not fusible"
+    if u.endpoint.service_host and u.endpoint.type != "LOCAL":
+        return "remote endpoint: crosses a transport boundary"
+    if u.implementation:
+        if u.implementation in PLAN_FUSIBLE_BUILTINS:
+            return None
+        return (f"built-in {u.implementation} has no pure on-device "
+                "implementation")
+    mc = u.parameters.get("model_class")
+    if not (isinstance(mc, str) and mc):
+        return "no implementation or model_class to resolve in-process"
+    sig = signature_for(mc)
+    if sig is None:
+        return (f"model_class {mc!r} has no registered signature; the "
+                "plan compiler cannot prove a pure tensor function")
+    if not sig.pure_fn:
+        return (f"model_class {mc!r} is not registered as a pure tensor "
+                "function (learning/stateful component)")
+    return None
+
+
+def _plan_pass(root: PredictiveUnit, ann: dict,
+               prefix: str) -> list[Finding]:
+    """Fusion-feasibility report for ``seldon.io/graph-plan`` graphs:
+    which segments the plan compiler will fuse (GL601) and why every
+    other node stays an interpreter boundary (GL602).  Advisory — the
+    runtime re-derives fusibility from the live components; this pass
+    gives the same answer from the spec alone so a CI gate can catch
+    fusion regressions at admission time."""
+    mode = str(ann.get(PLAN_ANNOTATION, "walk")).strip().lower()
+    if mode == "walk":
+        return []
+    if mode != "fused":
+        return [make_finding(
+            PLAN_MODE_INVALID, _join(prefix, root.name),
+            f"{PLAN_ANNOTATION}={mode!r} is not a plan mode "
+            "(expected 'fused' or 'walk')",
+        )]
+    findings: list[Finding] = []
+    segments: list[list[str]] = []
+
+    def subtree_fusible(u: PredictiveUnit) -> bool:
+        if _plan_boundary_reason(u) is not None:
+            return False
+        if u.resolved_type == "COMBINER" and not u.children:
+            return False
+        return all(subtree_fusible(c) for c in u.children)
+
+    def visit(u: PredictiveUnit, path: str) -> None:
+        if subtree_fusible(u):
+            segments.append([n.name for n in u.walk()])
+            findings.append(make_finding(
+                PLAN_SEGMENT_FUSED, path,
+                f"fuses {len(segments[-1])} node(s) into one jitted "
+                f"segment: {' -> '.join(segments[-1])}",
+            ))
+            return
+        # fusible MODEL/TRANSFORMER chain above the first boundary
+        run: list[PredictiveUnit] = []
+        cur = u
+        while (cur.resolved_type in ("MODEL", "TRANSFORMER")
+               and len(cur.children) == 1
+               and _plan_boundary_reason(cur) is None):
+            run.append(cur)
+            cur = cur.children[0]
+        if run:
+            segments.append([n.name for n in run])
+            findings.append(make_finding(
+                PLAN_SEGMENT_FUSED, path,
+                f"fuses a {len(run)}-node chain into one jitted segment: "
+                f"{' -> '.join(segments[-1])} (rest interpreted)",
+            ))
+            visit(cur, _join(path, cur.name))
+            return
+        reason = _plan_boundary_reason(u) or \
+            "a descendant prevents whole-subtree fusion"
+        findings.append(make_finding(
+            PLAN_NODE_BOUNDARY, path,
+            f"stays an interpreter boundary: {reason}",
+        ))
+        for c in u.children:
+            visit(c, _join(path, c.name))
+
+    visit(root, _join(prefix, root.name))
+    if not segments:
+        findings.append(make_finding(
+            PLAN_NOTHING_FUSED, _join(prefix, root.name),
+            f"{PLAN_ANNOTATION}=fused requested but no segment fuses — "
+            "the engine will fall back to the interpreted walk",
+        ))
+    return findings
 
 
 def _join(prefix: str, name: str) -> str:

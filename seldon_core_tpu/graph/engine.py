@@ -75,6 +75,8 @@ class GraphEngine:
         metrics_sink: Optional[Any] = None,
         tracer: Optional[Any] = None,
         walk_timeout_s: Optional[float] = None,
+        plan_mode: str = "walk",
+        plan_batcher: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -92,6 +94,33 @@ class GraphEngine:
         self.root = self._build(self.spec)
         self._nodes: dict[str, _Node] = {}
         self._index(self.root)
+        # fused graph plan (annotation seldon.io/graph-plan=fused):
+        # maximal static subgraphs compiled to single jitted segment calls
+        # at construction; per-request the engine walks the segment DAG
+        # instead of the node tree (graph/plan.py)
+        if plan_mode not in ("walk", "fused"):
+            raise ValueError(
+                f"unknown graph-plan mode {plan_mode!r} "
+                "(expected 'walk' or 'fused')"
+            )
+        self.plan_mode = plan_mode
+        self.plan = None
+        if plan_mode == "fused":
+            from seldon_core_tpu.graph.plan import compile_plan
+
+            self.plan = compile_plan(
+                self.root, batcher_config=plan_batcher,
+                metrics=getattr(metrics_sink, "registry", None),
+            )
+            if not self.plan.segments:
+                # nothing fused: the plan walk would be the interpreter
+                # walk with extra indirection — keep the direct walk
+                logger.warning(
+                    "graph %s: plan mode requested but no segment fused "
+                    "(%s); falling back to interpreted walk",
+                    name, self.plan.boundaries,
+                )
+                self.plan = None
 
     def _build(self, unit: PredictiveUnit) -> _Node:
         impl: NodeImpl
@@ -130,7 +159,10 @@ class GraphEngine:
             meta.puid = new_puid()
         try:
             with self.tracer.trace(meta.puid, graph=self.name):
-                coro = self._walk(self.root, request, meta)
+                if self.plan is not None:
+                    coro = self._plan_walk(self.plan.root, request, meta)
+                else:
+                    coro = self._walk(self.root, request, meta)
                 if self.walk_timeout_s:
                     # asyncio.timeout + expired(): only the WALK deadline
                     # maps to the 504 below — a TimeoutError leaking out
@@ -196,9 +228,22 @@ class GraphEngine:
             return await self._walk_traced(node, msg, meta)
 
     async def _walk_traced(
-        self, node: _Node, msg: SeldonMessage, meta: Meta
+        self,
+        node: _Node,
+        msg: SeldonMessage,
+        meta: Meta,
+        child_walks: Optional[list] = None,
     ) -> SeldonMessage:
+        """``child_walks`` parameterizes descent: the interpreted walk
+        passes None (recurse into ``node.children``); the plan walk passes
+        per-child coroutine factories aligned with ``node.children`` so an
+        interpreter boundary can descend into fused plan nodes."""
         unit, impl = node.unit, node.impl
+        if child_walks is None:
+            child_walks = [
+                (lambda m, _c=c: self._walk(_c, m, meta))
+                for c in node.children
+            ]
 
         # 1. transformInput: MODEL.predict / TRANSFORMER.transform_input
         #    (type→method map, PredictorConfigBean.java:45-99)
@@ -223,7 +268,7 @@ class GraphEngine:
 
         # 3. route (ROUTER only); -1 ⇒ all children
         #    (getBranchIndex, PredictiveUnitBean.java:271-281)
-        selected = node.children
+        selected = child_walks
         if node.type == "ROUTER":
             branch = int(await _maybe_await(impl.route(transformed)))
             meta.routing[unit.name] = branch
@@ -235,17 +280,15 @@ class GraphEngine:
                         status_code=500,
                         reason="ROUTING_ERROR",
                     )
-                selected = [node.children[branch]]
+                selected = [child_walks[branch]]
 
         # 4. fan out children concurrently (reference: one @Async future per
         #    child, PredictiveUnitBean.java:145-151)
         if len(selected) == 1:
-            child_outputs = [await self._walk(selected[0], transformed, meta)]
+            child_outputs = [await selected[0](transformed)]
         else:
             child_outputs = list(
-                await asyncio.gather(
-                    *(self._walk(c, transformed, meta) for c in selected)
-                )
+                await asyncio.gather(*(w(transformed) for w in selected))
             )
 
         # 5. aggregate: COMBINER via impl; default = first child output
@@ -285,6 +328,63 @@ class GraphEngine:
     def _observe(self, node_name: str, elapsed: float) -> None:
         if self.metrics is not None:
             self.metrics.observe_node(self.name, node_name, elapsed)
+
+    # ------------------------------------------------------------------
+    # plan mode: walk the segment DAG instead of the node tree
+    # ------------------------------------------------------------------
+    async def _plan_walk(self, pnode: Any, msg: SeldonMessage,
+                         meta: Meta) -> SeldonMessage:
+        """One node of the plan walk (graph/plan.py PlanNode): fused
+        segments execute as one device dispatch; interpreter boundaries
+        run the standard per-node path but descend into plan children."""
+        if pnode.segment is not None:
+            if msg.data is None:
+                # fused fns are tensor-in/tensor-out; binData/strData/
+                # jsonData requests interpret this subtree per-node (the
+                # node tree is always intact beneath the plan)
+                return await self._walk(pnode.node, msg, meta)
+            out = await self._run_segment(pnode.segment, msg, meta)
+            if pnode.children:
+                # chain segment: fused prefix feeds the interpreted rest
+                return await self._plan_walk(pnode.children[0], out, meta)
+            return out
+        node = pnode.node
+        unit, impl = node.unit, node.impl
+        meta.request_path[unit.name] = unit.implementation or type(
+            getattr(impl, "user", impl)
+        ).__name__
+        walks = [
+            (lambda m, _p=p: self._plan_walk(_p, m, meta))
+            for p in pnode.children
+        ]
+        with self.tracer.span(unit.name, kind=node.type):
+            return await self._walk_traced(node, msg, meta, child_walks=walks)
+
+    async def _run_segment(self, seg: Any, msg: SeldonMessage,
+                           meta: Meta) -> SeldonMessage:
+        """Execute one fused segment: ONE device dispatch (optionally via
+        the segment's dynamic batcher, amortizing it across requests),
+        then replay the segment's meta script so requestPath/tags/custom
+        metrics are byte-identical to the interpreted walk.  Emits ONE
+        observe_node for the whole segment."""
+        t0 = time.perf_counter()
+        with self.tracer.span(seg.label, kind="FUSED_SEGMENT"):
+            x = msg.data
+            if seg.batcher is not None:
+                y = await seg.batcher(x)
+            else:
+                y = seg(x)
+            names = seg.out_names(x, msg.names)
+        for ev in seg.meta_events:
+            if ev.op == "stamp":
+                meta.request_path[ev.name] = ev.label
+            else:
+                cm = ev.handle._component_meta()
+                meta.merge(cm)
+                if self.metrics is not None and cm.metrics:
+                    self.metrics.merge_custom(ev.name, cm.metrics)
+        self._observe(seg.label, time.perf_counter() - t0)
+        return SeldonMessage(data=y, names=names)
 
     # ------------------------------------------------------------------
     # feedback

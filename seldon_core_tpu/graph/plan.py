@@ -1,0 +1,544 @@
+"""Graph plan compiler: fuse static subgraphs into single jitted calls.
+
+The interpreted walk (``graph/engine.py``) pays a Python/asyncio dispatch,
+a ``perf_counter`` pair, and a meta merge **per node per request**, and one
+XLA dispatch per compiled component.  For the common production shape — a
+predictor whose whole graph is a static chain/ensemble of in-process JAX
+components — all of that is avoidable: the shapes and dtypes are known
+statically (``models/__init__.py`` signature registry) and every node's
+math is a pure tensor function, so the whole subgraph can be traced ONCE
+into a single ``jax.jit``-ed callable and served with one device dispatch
+per request (paper §7: keep tensors in HBM across graph edges, collapse
+per-node overhead into compiled XLA calls).
+
+This module partitions a built engine graph into maximal **fusible
+segments** and compiles each into one :class:`FusedSegment`:
+
+- fusible node types: MODEL / TRANSFORMER / OUTPUT_TRANSFORMER / COMBINER
+  (ROUTER is data-dependent control flow — always an interpreter boundary);
+- a node is fusible when its in-process implementation exposes a *pure
+  tensor function* for its role: ``predict_fn`` (MODEL — the existing
+  ComponentHandle jit fast path), ``transform_input_fn`` (TRANSFORMER),
+  ``transform_output_fn`` (OUTPUT_TRANSFORMER), ``aggregate_fn`` or the
+  built-in ``AVERAGE_COMBINER`` (COMBINER).  Remote clients, duck-typed
+  message-level components, and learning components (no pure fn) stay
+  interpreter boundaries;
+- a maximal fully-fusible subtree becomes one segment (combiner fan-in is
+  a single traced expression); a fusible MODEL/TRANSFORMER chain above a
+  non-fusible child becomes a *chain segment* feeding the interpreted
+  remainder.
+
+Wire compatibility: a segment carries a precomputed **meta script** — the
+exact sequence of ``requestPath`` stamps and component tags/metrics merges
+the interpreted walk would perform, replayed host-side per request — so
+responses (data, ``meta.requestPath``, tags, custom metrics) are
+byte-identical between ``walk`` and ``fused`` modes (tests/test_graph_plan
+parity suite).  Only node-timer granularity changes: one ``observe_node``
+per segment instead of per node.
+
+Segments also plug into the dynamic batcher (``runtime/batcher.py``) as a
+single batched callable, so cross-request batching amortizes the whole
+segment — not just one model — per device dispatch.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: node types a fused segment may contain (ROUTER never fuses: its branch
+#: choice is data-dependent control flow the trace cannot see)
+FUSIBLE_TYPES = ("MODEL", "TRANSFORMER", "OUTPUT_TRANSFORMER", "COMBINER")
+
+#: unit type → the pure-tensor-fn attribute that makes it fusible
+PURE_FN_ATTR = {
+    "MODEL": "predict_fn",
+    "TRANSFORMER": "transform_input_fn",
+    "OUTPUT_TRANSFORMER": "transform_output_fn",
+    "COMBINER": "aggregate_fn",
+}
+
+
+# ---------------------------------------------------------------------------
+# stage extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Stage:
+    """One graph node's contribution to a fused segment."""
+
+    name: str
+    kind: str                       # resolved unit type
+    label: str                      # requestPath value (walk-identical)
+    fn: Callable                    # pure: (params, x) -> y  /  (params, ys) -> y
+    params: Any                     # pytree (may be None)
+    handle: Any                     # ComponentHandle (meta/tags/names source)
+    class_names: Optional[list] = None
+    feature_names: Optional[list] = None
+
+    def out_names(self, y_shape: tuple, in_names: list) -> list:
+        """Replicate ComponentHandle name resolution for this stage's
+        output (``_class_names`` / ``_transformed_names``)."""
+        if self.kind == "TRANSFORMER":
+            return (list(self.feature_names)
+                    if self.feature_names is not None else list(in_names))
+        if self.kind == "OUTPUT_TRANSFORMER":
+            return (list(self.class_names)
+                    if self.class_names is not None else list(in_names))
+        # MODEL / COMBINER: _class_names(Y, fallback)
+        if self.class_names is not None:
+            return list(self.class_names)
+        if len(y_shape) >= 2:
+            return [f"t:{i}" for i in range(y_shape[-1])]
+        return list(in_names)
+
+
+def _unwrap_handle(impl: Any) -> Any:
+    """BatchedModel (walk-mode per-node batching) → underlying handle."""
+    return getattr(impl, "handle", impl)
+
+
+def _positional_arity(fn: Callable) -> int:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return 1
+    return len([
+        p for p in sig.parameters.values()
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD)
+    ])
+
+
+def extract_stage(node: Any) -> Optional[_Stage]:
+    """The node's pure tensor function, or None (interpreter boundary).
+
+    ``node`` is a ``graph.engine._Node``.  Only in-process
+    ``ComponentHandle`` implementations qualify — remote clients and
+    message-level passthrough components interpret.
+    """
+    from seldon_core_tpu.graph.builtins import AverageCombiner
+    from seldon_core_tpu.runtime.component import ComponentHandle
+
+    kind = node.type
+    if kind not in FUSIBLE_TYPES:
+        return None
+    handle = _unwrap_handle(node.impl)
+    if not isinstance(handle, ComponentHandle):
+        return None
+    user = handle.user
+    if getattr(user, "accepts_messages", False):
+        return None  # message-level component owns its own semantics
+    label = node.unit.implementation or type(user).__name__
+
+    def stage(fn, params):
+        return _Stage(
+            name=node.unit.name, kind=kind, label=label, fn=fn,
+            params=params, handle=handle,
+            class_names=(list(user.class_names)
+                         if getattr(user, "class_names", None) is not None
+                         else None),
+            feature_names=(list(user.feature_names)
+                           if getattr(user, "feature_names", None) is not None
+                           else None),
+        )
+
+    if kind == "COMBINER":
+        agg = getattr(user, "aggregate_fn", None)
+        if callable(agg):
+            if _positional_arity(agg) >= 2:
+                if not hasattr(user, "params"):
+                    return None
+                return stage(lambda p, ys, _f=agg: _f(p, ys), user.params)
+            return stage(lambda p, ys, _f=agg: _f(ys), None)
+        if isinstance(user, AverageCombiner):
+            def mean_agg(p, ys):
+                import jax
+                import jax.numpy as jnp
+
+                # barrier between stack and mean: the walk-mode combiner
+                # runs them as separate eager dispatches; letting XLA fuse
+                # stack INTO the reduction changes accumulation order and
+                # breaks walk↔fused byte parity (ULP diffs)
+                s = jax.lax.optimization_barrier(
+                    jnp.stack([jnp.asarray(y) for y in ys]))
+                return jnp.mean(s, axis=0)
+
+            return stage(mean_agg, None)
+        return None
+
+    pure = getattr(user, PURE_FN_ATTR[kind], None)
+    if callable(pure):
+        if _positional_arity(pure) >= 2:
+            if not hasattr(user, "params"):
+                return None
+            return stage(lambda p, x, _f=pure: _f(p, x), user.params)
+        return stage(lambda p, x, _f=pure: _f(x), None)
+    if kind == "MODEL" and getattr(user, "jit_compile", False) and callable(
+            getattr(user, "predict", None)):
+        # same opt-in the ComponentHandle jit fast path honors
+        return stage(lambda p, x, _u=user: _u.predict(x, []), None)
+    return None
+
+
+def boundary_reason(node: Any) -> str:
+    """Human-readable reason a node did not fuse (plan report / GL6xx)."""
+    from seldon_core_tpu.runtime.component import ComponentHandle
+
+    if node.type == "ROUTER":
+        return "ROUTER: data-dependent branch choice cannot be traced"
+    if node.type not in FUSIBLE_TYPES:
+        return f"type {node.type} is not fusible"
+    handle = _unwrap_handle(node.impl)
+    if not isinstance(handle, ComponentHandle):
+        return (f"{type(node.impl).__name__} is not an in-process "
+                "component (remote client or duck-typed impl)")
+    if getattr(handle.user, "accepts_messages", False):
+        return "message-level passthrough component (owns its own semantics)"
+    attr = PURE_FN_ATTR[node.type]
+    return (f"{type(handle.user).__name__} exposes no pure tensor function "
+            f"({attr} / built-in equivalent)")
+
+
+# ---------------------------------------------------------------------------
+# segment trees + compilation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _SegTree:
+    stage: _Stage
+    children: list["_SegTree"] = field(default_factory=list)
+
+
+@dataclass
+class MetaEvent:
+    """One host-side meta action, replayed per request in walk order."""
+
+    op: str          # "stamp" | "merge"
+    name: str        # node name
+    label: str = ""  # requestPath value (stamp)
+    handle: Any = None  # ComponentHandle (merge: tags/metrics source)
+
+
+class FusedSegment:
+    """One jitted callable covering a fused run of graph nodes.
+
+    ``__call__(X)`` is ONE device dispatch for the whole segment.  The
+    segment optionally owns a :class:`~seldon_core_tpu.runtime.batcher.
+    DynamicBatcher` (``abatch``) so concurrent requests share that single
+    dispatch end-to-end.
+    """
+
+    def __init__(self, tree: _SegTree, root_node: Any):
+        import jax
+
+        self.tree = tree
+        self.root_node = root_node  # engine _Node (interpreted fallback)
+        self.members: list[_Stage] = []
+        self.meta_events: list[MetaEvent] = []
+        self._collect(tree)
+        self.name = self.members[0].name
+        self.label = "+".join(s.name for s in self.members)
+        self._params = {s.name: s.params for s in self.members}
+        self._fn = jax.jit(self._traced)
+        self.batcher = None  # set by compile_plan when batching is on
+        self.n_calls = 0     # device dispatches issued (bench/CI smoke)
+        self._names_cache: dict = {}
+
+    # -- compile-time ----------------------------------------------------
+    def _collect(self, t: _SegTree) -> None:
+        """Pre-order member list + the walk-order meta script: per node
+        [stamp, downward merge, children..., upward merge] — exactly the
+        event order ``GraphEngine._walk_traced`` produces."""
+        st = t.stage
+        self.members.append(st)
+        self.meta_events.append(MetaEvent("stamp", st.name, label=st.label))
+        downward = st.kind in ("MODEL", "TRANSFORMER") or (
+            st.kind == "OUTPUT_TRANSFORMER" and not t.children)
+        if downward:
+            self.meta_events.append(
+                MetaEvent("merge", st.name, handle=st.handle))
+        for c in t.children:
+            self._collect(c)
+        if st.kind == "COMBINER" or (
+                st.kind == "OUTPUT_TRANSFORMER" and t.children):
+            self.meta_events.append(
+                MetaEvent("merge", st.name, handle=st.handle))
+
+    def _traced(self, params: dict, x):
+        """The fused expression — semantics order-exact with
+        ``_walk_traced`` restricted to fusible types."""
+        return self._run_tree(self.tree, params, x)
+
+    @staticmethod
+    def _fence(y):
+        """Stage boundary inside the fused trace.  Without it XLA fuses
+        ACROSS stages (e.g. a softmax epilogue into the downstream mean),
+        which perturbs low-order bits vs. the per-node dispatches of the
+        interpreted walk — breaking the walk↔fused byte-parity contract.
+        ``optimization_barrier`` pins each stage's subgraph to the same
+        numerics as its standalone compilation while keeping the segment
+        ONE program and ONE device dispatch."""
+        import jax
+
+        return jax.lax.optimization_barrier(y)
+
+    def _run_tree(self, t: _SegTree, params: dict, x):
+        st = t.stage
+        p = params[st.name]
+        down = x
+        if st.kind in ("MODEL", "TRANSFORMER"):
+            down = self._fence(st.fn(p, x))
+        elif st.kind == "OUTPUT_TRANSFORMER" and not t.children:
+            return self._fence(st.fn(p, x))
+        if not t.children:
+            return down
+        # OUTPUT_TRANSFORMER/COMBINER descend as-is (walk order step 1)
+        feed = down if st.kind in ("MODEL", "TRANSFORMER") else x
+        outs = [self._run_tree(c, params, feed) for c in t.children]
+        if st.kind == "COMBINER":
+            return self._fence(st.fn(p, outs))
+        merged = outs[0]  # default aggregation = first child output
+        if st.kind == "OUTPUT_TRANSFORMER":
+            return self._fence(st.fn(p, merged))
+        return merged
+
+    # -- request-time ----------------------------------------------------
+    def __call__(self, x):
+        self.n_calls += 1
+        return self._fn(self._params, x)
+
+    def out_names(self, x, in_names: Sequence[str]) -> list:
+        """Final output names, byte-identical to the interpreted walk.
+
+        Name resolution needs intermediate output *shapes* (the ``t:i``
+        synthesized-names path); one ``jax.eval_shape`` pass per distinct
+        (input shape/dtype, input names) simulates the walk's name
+        propagation, then the result is cached.
+        """
+        key = (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")),
+               tuple(in_names))
+        hit = self._names_cache.get(key)
+        if hit is not None:
+            return list(hit)
+        import jax
+
+        def sim(t: _SegTree, aval, names):
+            st = t.stage
+            down_aval, down_names = aval, names
+            if st.kind in ("MODEL", "TRANSFORMER"):
+                down_aval = jax.eval_shape(st.fn, st.params, aval)
+                down_names = st.out_names(down_aval.shape, names)
+            elif st.kind == "OUTPUT_TRANSFORMER" and not t.children:
+                out = jax.eval_shape(st.fn, st.params, aval)
+                return out, st.out_names(out.shape, names)
+            if not t.children:
+                return down_aval, down_names
+            feed_aval = down_aval if st.kind in ("MODEL", "TRANSFORMER") \
+                else aval
+            feed_names = down_names if st.kind in ("MODEL", "TRANSFORMER") \
+                else names
+            outs = [sim(c, feed_aval, feed_names) for c in t.children]
+            if st.kind == "COMBINER":
+                agg = jax.eval_shape(st.fn, st.params,
+                                     [o[0] for o in outs])
+                return agg, st.out_names(agg.shape, outs[0][1])
+            merged_aval, merged_names = outs[0]
+            if st.kind == "OUTPUT_TRANSFORMER":
+                out = jax.eval_shape(st.fn, st.params, merged_aval)
+                return out, st.out_names(out.shape, merged_names)
+            return merged_aval, merged_names
+
+        aval0 = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        _, names = sim(self.tree, aval0, list(in_names))
+        if len(self._names_cache) < 256:
+            self._names_cache[key] = list(names)
+        return list(names)
+
+    def describe(self) -> dict:
+        return {
+            "root": self.name,
+            "members": [s.name for s in self.members],
+            "n_nodes": len(self.members),
+        }
+
+
+# ---------------------------------------------------------------------------
+# plan DAG
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlanNode:
+    """One node of the segment DAG the engine's plan mode walks.
+
+    - ``segment`` set, no ``children``: fully fused subtree (terminal).
+    - ``segment`` set, one child: fused MODEL/TRANSFORMER chain feeding an
+      interpreted remainder.
+    - ``segment`` None: interpreter boundary — ``node`` executes through
+      the normal per-node path, ``children`` align 1:1 with
+      ``node.children``.
+    """
+
+    node: Any                      # engine _Node
+    segment: Optional[FusedSegment] = None
+    children: list["PlanNode"] = field(default_factory=list)
+
+
+class GraphPlan:
+    """Compiled execution plan of one predictor graph."""
+
+    def __init__(self, root: PlanNode, segments: list[FusedSegment],
+                 boundaries: list[tuple[str, str]]):
+        self.root = root
+        self.segments = segments
+        self.boundaries = boundaries  # (node name, reason) not fused
+
+    @property
+    def fully_fused(self) -> bool:
+        return self.root.segment is not None and not self.root.children
+
+    def describe(self) -> dict:
+        return {
+            "segments": [s.describe() for s in self.segments],
+            "boundaries": [
+                {"node": n, "reason": r} for n, r in self.boundaries
+            ],
+            "fully_fused": self.fully_fused,
+        }
+
+    def warmup(self, example_row=None) -> int:
+        """Pre-compile every batcher bucket of every segment (first TPU
+        compile is seconds — pay it before traffic).  ``example_row`` may
+        be supplied; otherwise it is derived from the entry node's static
+        signature (``models/__init__.py``).  Returns buckets warmed."""
+        import numpy as np
+
+        warmed = 0
+        for seg in self.segments:
+            row = example_row
+            if row is None:
+                sig = _entry_signature(seg.root_node)
+                if sig is None or sig.input_shape is None or any(
+                        d is None for d in sig.input_shape[1:]):
+                    continue
+                dt = np.dtype(sig.input_dtype or "float32")
+                row = np.zeros(tuple(sig.input_shape[1:]), dt)
+            if seg.batcher is not None:
+                seg.batcher.warmup(np.asarray(row))
+                warmed += len(seg.batcher.buckets)
+            else:
+                y = seg(np.asarray(row)[None])
+                if hasattr(y, "block_until_ready"):
+                    y.block_until_ready()
+                warmed += 1
+        return warmed
+
+
+def _entry_signature(node: Any):
+    """Static input signature of the segment rooted at ``node``.  A
+    COMBINER/OUTPUT_TRANSFORMER root descends as-is, so the request shape
+    is whatever its first child expects — recurse until a node with a
+    registered contract appears."""
+    from seldon_core_tpu.models import signature_for
+
+    mc = node.unit.parameters.get("model_class")
+    if isinstance(mc, str) and mc:
+        return signature_for(mc)
+    if node.type in ("COMBINER", "OUTPUT_TRANSFORMER") and node.children:
+        return _entry_signature(node.children[0])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# partitioning
+# ---------------------------------------------------------------------------
+
+
+def _subtree_stages(node: Any, out: dict) -> Optional[_SegTree]:
+    """Whole subtree fusible → its _SegTree; else None (out collects
+    boundary reasons for the report)."""
+    stage = extract_stage(node)
+    if stage is None:
+        out.setdefault(node.unit.name, boundary_reason(node))
+        return None
+    if node.type == "COMBINER" and not node.children:
+        out.setdefault(node.unit.name, "COMBINER without children")
+        return None
+    kids = []
+    ok = True
+    for c in node.children:
+        sub = _subtree_stages(c, out)
+        if sub is None:
+            ok = False
+        else:
+            kids.append(sub)
+    if not ok:
+        return None
+    return _SegTree(stage, kids)
+
+
+def compile_plan(root_node: Any, batcher_config=None,
+                 metrics=None) -> GraphPlan:
+    """Partition the built engine graph into maximal fusible segments and
+    jit-compile each.  ``batcher_config`` (a ``BatcherConfig``) attaches a
+    DynamicBatcher to every segment so concurrent requests share device
+    dispatches across the WHOLE segment."""
+    segments: list[FusedSegment] = []
+    boundaries: dict[str, str] = {}
+
+    def attach_batcher(seg: FusedSegment) -> None:
+        if batcher_config is None:
+            return
+        import dataclasses
+
+        from seldon_core_tpu.runtime.batcher import DynamicBatcher
+
+        cfg = dataclasses.replace(batcher_config)
+        cfg.name = f"plan:{seg.name}"
+        seg.batcher = DynamicBatcher(seg, cfg, metrics=metrics)
+
+    def build(node: Any) -> PlanNode:
+        reasons: dict[str, str] = {}
+        tree = _subtree_stages(node, reasons)
+        if tree is not None:
+            seg = FusedSegment(tree, node)
+            attach_batcher(seg)
+            segments.append(seg)
+            return PlanNode(node=node, segment=seg)
+        # maximal fusible MODEL/TRANSFORMER chain above the boundary
+        run: list[Any] = []
+        cur = node
+        while (cur.type in ("MODEL", "TRANSFORMER")
+               and len(cur.children) == 1
+               and extract_stage(cur) is not None):
+            run.append(cur)
+            cur = cur.children[0]
+        if run:
+            chain: Optional[_SegTree] = None
+            for n in reversed(run):
+                st = extract_stage(n)
+                chain = _SegTree(st, [chain] if chain else [])
+            seg = FusedSegment(chain, run[0])
+            attach_batcher(seg)
+            segments.append(seg)
+            return PlanNode(node=run[0], segment=seg,
+                            children=[build(cur)])
+        boundaries.update(reasons or {node.unit.name:
+                                      boundary_reason(node)})
+        return PlanNode(node=node,
+                        children=[build(c) for c in node.children])
+
+    root = build(root_node)
+    # drop boundary entries for nodes that DID end up inside a segment
+    # (a failed full-subtree attempt records reasons for its whole frontier)
+    fused_names = {s.name for seg in segments for s in seg.members}
+    report = [(n, r) for n, r in boundaries.items() if n not in fused_names]
+    return GraphPlan(root, segments, report)

@@ -32,6 +32,11 @@ class ModelSignature:
     ``hbm_bytes`` is the resident-weights estimate used for slice-budget
     feasibility (KV caches and activations are workload-dependent and
     deliberately excluded — the check is a floor, not a ceiling).
+
+    ``pure_fn`` declares that the class serves through a pure tensor
+    function (``predict_fn``-style) with no per-request host state — the
+    static precondition the graph-plan compiler (``graph/plan.py``) needs
+    to fuse the node into a jitted segment; the GL6xx lint pass reads it.
     """
 
     input_shape: Optional[Shape] = None
@@ -39,6 +44,7 @@ class ModelSignature:
     output_shape: Optional[Shape] = None
     output_dtype: Optional[str] = None
     hbm_bytes: int = 0
+    pure_fn: bool = False
 
 
 def _dense_bytes(sizes: tuple, dtype_bytes: int = 4) -> int:
@@ -54,22 +60,26 @@ SIGNATURES: dict[str, ModelSignature] = {
         input_shape=(ANY, 4), input_dtype="float32",
         output_shape=(ANY, 3), output_dtype="float32",
         hbm_bytes=_dense_bytes((4, 3)),
+        pure_fn=True,
     ),
     "seldon_core_tpu.models.mlp:MNISTMLP": ModelSignature(
         input_shape=(ANY, 784), input_dtype="float32",
         output_shape=(ANY, 10), output_dtype="float32",
         hbm_bytes=_dense_bytes((784, 512, 256, 10)),
+        pure_fn=True,
     ),
     "seldon_core_tpu.models.resnet:ResNet50Model": ModelSignature(
         input_shape=(ANY, 224, 224, 3), input_dtype="float32",
         output_shape=(ANY, 1000), output_dtype="float32",
         # ~25.6M params stored in the bf16 serving dtype (models/resnet.py)
         hbm_bytes=25_600_000 * 2,
+        pure_fn=True,
     ),
     "seldon_core_tpu.models.resnet_int8:Int8ResNet50Model": ModelSignature(
         input_shape=(ANY, 224, 224, 3), input_dtype="float32",
         output_shape=(ANY, 1000), output_dtype="float32",
         hbm_bytes=25_600_000 * 1,
+        pure_fn=True,
     ),
     # token-in/token-out: ragged [batch, seq] int32 ids (runtime/llm.py)
     "seldon_core_tpu.models.llm_demo:DemoLLM": ModelSignature(

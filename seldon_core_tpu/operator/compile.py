@@ -253,6 +253,24 @@ def _probes() -> dict:
     }
 
 
+def graph_plan_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
+    """``seldon.io/graph-plan`` execution mode: ``walk`` (default, the
+    per-node interpreted traversal) or ``fused`` (compile maximal static
+    subgraphs into single jitted segment calls at engine construction —
+    graph/plan.py).  Unknown values fail validation here so a typo'd
+    annotation rejects at admission instead of silently interpreting."""
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+    ann = {**dep.annotations, **p.annotations}
+    mode = str(ann.get("seldon.io/graph-plan", "walk")).strip().lower()
+    if mode not in ("walk", "fused"):
+        raise DeploymentValidationError(
+            f"annotation seldon.io/graph-plan must be 'walk' or 'fused', "
+            f"got {mode!r}"
+        )
+    return mode
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

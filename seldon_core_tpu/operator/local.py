@@ -135,6 +135,16 @@ class LocalPredictor:
         self.spec = pred
         self.metrics = metrics or EngineMetrics(deployment=dep.name)
         ann = {**dep.annotations, **pred.annotations}
+        from seldon_core_tpu.operator.compile import graph_plan_mode
+
+        plan_mode = graph_plan_mode(dep, pred)
+        # fused segments batch END-TO-END: the whole segment is the
+        # batched callable, so one device dispatch serves a cross-request
+        # batch through every fused node (walk mode batches per MODEL)
+        plan_batcher = (
+            _batcher_config(ann)
+            if plan_mode == "fused" and _batching_enabled(ann) else None
+        )
         self.engine = GraphEngine(
             pred.graph,
             resolver=lambda u: resolve_component(u, ann, self.metrics.registry),
@@ -144,7 +154,13 @@ class LocalPredictor:
             walk_timeout_s=_timeout_s(
                 ann, "seldon.io/engine-walk-timeout-ms", None
             ),
+            plan_mode=plan_mode,
+            plan_batcher=plan_batcher,
         )
+        if (self.engine.plan is not None
+                and ann.get("seldon.io/graph-plan-warmup", "").lower()
+                in ("1", "true", "yes")):
+            self.engine.plan.warmup()
 
 
 def _tracer_from_config(ann: dict):

@@ -27,6 +27,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept both so the
+# kernels load on either side of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 __all__ = ["flash_attention", "use_interpret"]
 
 NEG_INF = -1e30
@@ -122,7 +127,7 @@ def _flash_bhld(q, k, v, causal: bool, scale: float, block_q: int,
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
             pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -137,8 +137,16 @@ def save_checkpoint(path: str, tree: Any, model_config: Optional[dict] = None,
         "model": model_config or {},
         "skeleton": skeleton,
     }
+    # DUAL-WRITE the config under both metadata keys for one deprecation
+    # window: "seldon_checkpoint" is what earlier releases read — without
+    # it, artifacts saved here fail to load on those releases ("no
+    # seldon_checkpoint metadata"), which bites version-skewed fleets
+    # sharing one model store mid-rollout (docs/production.md).  Load
+    # prefers "seldon.checkpoint".
+    cfg_json = json.dumps(cfg)
     meta = {"framework": "seldon-core-tpu",
-            "seldon.checkpoint": json.dumps(cfg)}
+            "seldon.checkpoint": cfg_json,
+            "seldon_checkpoint": cfg_json}
     for k, v in (metadata or {}).items():
         if str(k) in meta:
             # a clobbered "seldon.checkpoint" would save fine and fail
@@ -177,7 +185,8 @@ def load_checkpoint(path: str) -> tuple[Any, dict]:
         md = f.metadata() or {}
         # "seldon_checkpoint" is the key the first artifact version wrote
         # (renamed: underscore names pattern-match Prometheus series in
-        # doc/catalog tooling) — keep loading those artifacts
+        # doc/catalog tooling); save_checkpoint dual-writes both keys for
+        # rollout skew, so accept either
         raw = md.get("seldon.checkpoint") or md.get("seldon_checkpoint")
         if raw is None:
             raise ValueError(

@@ -2062,7 +2062,19 @@ class LLMComponent:
         pstats = self.engine.preempt_stats
         if pstats["preempted"] or pstats["shed"]:
             # cumulative engine counts reported as gauges (a COUNTER here
-            # would re-add the running total on every request)
+            # would re-add the running total on every request).  Canonical
+            # names carry no _total suffix — OpenMetrics forbids gauges
+            # named *_total and strict scrapers reject them; the suffixed
+            # originals ride along as DEPRECATED aliases for one release
+            # (docs/analytics.md).
+            out.append(
+                Metric("seldon_llm_preempted", MetricType.GAUGE,
+                       float(pstats["preempted"]))
+            )
+            out.append(
+                Metric("seldon_llm_admission_shed", MetricType.GAUGE,
+                       float(pstats["shed"]))
+            )
             out.append(
                 Metric("seldon_llm_preempted_total", MetricType.GAUGE,
                        float(pstats["preempted"]))

@@ -27,6 +27,18 @@ from seldon_core_tpu.runtime.component import SeldonComponentError
 
 logger = logging.getLogger(__name__)
 
+try:
+    # aiohttp >= 3.10 (pinned in the `serving` extra) raises a dedicated
+    # class for connect-phase expiry; on older aiohttp ServerTimeoutError
+    # covers BOTH phases, so there is no class-based way to tell "down"
+    # from "slow" — the sentinel below makes the connect branch dead and
+    # every timeout classifies as a read timeout (504), the safer default
+    # (a retried 503 against a merely-slow backend doubles its load).
+    from aiohttp import ConnectionTimeoutError as _ConnectTimeout
+except ImportError:  # pragma: no cover - aiohttp < 3.10
+    class _ConnectTimeout(Exception):
+        """Never raised: placeholder keeping the except clause valid."""
+
 
 class RemoteComponent:
     """REST client for one remote component endpoint."""
@@ -83,8 +95,7 @@ class RemoteComponent:
                 headers={"Content-Type": "application/json"},
             ) as resp:
                 raw = await resp.read()
-        except getattr(aiohttp, "ConnectionTimeoutError",
-                       aiohttp.ServerTimeoutError) as e:
+        except _ConnectTimeout as e:
             # connect-phase expiry (rest-connection-timeout) subclasses
             # asyncio.TimeoutError too, but an unreachable backend is
             # "down" (503 TRANSPORT, reference semantics), not "slow" —

@@ -51,6 +51,9 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test run via asyncio.run")
+    config.addinivalue_line(
+        "markers", "slow: heavyweight test excluded from the tier-1 gate"
+    )
 
 
 @pytest.fixture(scope="session")

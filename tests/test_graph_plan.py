@@ -1,0 +1,419 @@
+"""Graph-plan compiler tests: walk↔fused parity, partitioning, fallback.
+
+The contract under test (graph/plan.py + engine plan mode): for every
+shipped example graph, the fused plan produces a **byte-identical**
+response — data, ``meta.requestPath``, routing, tags, custom metrics —
+to the interpreted walk; non-fusible graphs (router roots, resolver-only
+duck nodes, unregistered signatures) fall back to the interpreter without
+behavior change; and fused execution issues exactly ONE device dispatch
+per segment per request.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.operator.local import LocalDeployment, load_deployment_file
+from seldon_core_tpu.runtime.component import ComponentHandle
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "graphs")
+
+NO_BATCH = {"seldon.io/batching": "false"}
+
+
+def resolver_for(ann=NO_BATCH):
+    from seldon_core_tpu.operator.local import resolve_component
+
+    return lambda u: resolve_component(u, ann)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mlp_node(name, seed=0, hidden=32):
+    return {
+        "name": name, "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+            {"name": "seed", "value": str(seed), "type": "INT"},
+            {"name": "hidden", "value": str(hidden), "type": "INT"},
+        ],
+    }
+
+
+def pinned(x, names=()):
+    msg = SeldonMessage.from_ndarray(np.asarray(x), names)
+    msg.meta.puid = "parity-pinned"
+    return msg
+
+
+def assert_parity(spec_or_engines, x, resolver=None, n=2):
+    """walk and fused engines produce byte-identical wire responses."""
+    if isinstance(spec_or_engines, tuple):
+        walk, fused = spec_or_engines
+    else:
+        resolver = resolver or resolver_for()
+        walk = GraphEngine(spec_or_engines, resolver=resolver, name="p")
+        fused = GraphEngine(spec_or_engines, resolver=resolver, name="p",
+                            plan_mode="fused")
+    for _ in range(n):
+        a = run(walk.predict(pinned(x)))
+        b = run(fused.predict(pinned(x)))
+        assert a.status.status == "SUCCESS", a.status.info
+        assert a.to_dict() == b.to_dict()
+    return walk, fused
+
+
+# ---- partitioning ------------------------------------------------------
+
+
+def test_linear_chain_fuses_to_one_segment_one_dispatch():
+    # a 3-deep chain of dim-preserving pure-fn MODELs
+    import jax
+
+    from seldon_core_tpu.models.mlp import init_mlp_params
+
+    class Square:
+        def __init__(self, seed=0):
+            self.params = init_mlp_params(jax.random.PRNGKey(seed),
+                                          (16, 16, 16))
+
+        def predict_fn(self, params, X):
+            from seldon_core_tpu.models.mlp import mlp_apply
+
+            return mlp_apply(params, X)
+
+    spec = {
+        "name": "m1", "type": "MODEL",
+        "children": [{
+            "name": "m2", "type": "MODEL",
+            "children": [{"name": "m3", "type": "MODEL"}],
+        }],
+    }
+
+    def resolve(u):
+        return ComponentHandle(Square(seed=ord(u.name[-1])), name=u.name,
+                               service_type="MODEL")
+
+    walk = GraphEngine(spec, resolver=resolve, name="p")
+    fused = GraphEngine(spec, resolver=resolve, name="p", plan_mode="fused")
+    assert fused.plan is not None and fused.plan.fully_fused
+    seg = fused.plan.segments[0]
+    assert [s.name for s in seg.members] == ["m1", "m2", "m3"]
+    x = np.random.default_rng(0).normal(size=(2, 16)).astype(np.float32)
+    n0 = seg.n_calls
+    a = run(walk.predict(pinned(x)))
+    b = run(fused.predict(pinned(x)))
+    assert seg.n_calls - n0 == 1  # exactly ONE device dispatch for 3 nodes
+    assert a.to_dict() == b.to_dict()
+    assert list(b.meta.request_path) == ["m1", "m2", "m3"]
+
+
+def test_combiner_fan_in_is_single_traced_segment():
+    spec = {
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [mlp_node(f"m{i}", seed=i) for i in range(3)],
+    }
+    walk, fused = assert_parity(
+        spec, np.random.default_rng(1).normal(size=(2, 784)).astype(np.float32))
+    assert fused.plan.fully_fused
+    assert len(fused.plan.segments) == 1
+    assert len(fused.plan.segments[0].members) == 4
+
+
+def test_chain_segment_above_interpreter_boundary():
+    """Fusible MODEL above a non-fusible (duck) node: the prefix fuses,
+    the rest interprets, responses stay identical."""
+
+    class DuckNegate:  # plain duck predict: no pure fn, never fuses
+        def predict(self, X, names):
+            return -np.asarray(X)
+
+    spec = mlp_node("top", seed=3)
+    spec["children"] = [{"name": "duck", "type": "MODEL"}]
+
+    def resolve(u):
+        if u.name == "duck":
+            return ComponentHandle(DuckNegate(), name="duck",
+                                   service_type="MODEL")
+        return resolver_for()(u)
+
+    walk = GraphEngine(spec, resolver=resolve, name="p")
+    fused = GraphEngine(spec, resolver=resolve, name="p", plan_mode="fused")
+    assert fused.plan is not None and not fused.plan.fully_fused
+    assert [s.name for s in fused.plan.segments[0].members] == ["top"]
+    assert dict(fused.plan.boundaries)["duck"]
+    x = np.zeros((1, 784), np.float32)
+    a = run(walk.predict(pinned(x)))
+    b = run(fused.predict(pinned(x)))
+    assert a.to_dict() == b.to_dict()
+
+
+# ---- fallback: non-fusible graphs --------------------------------------
+
+
+def test_router_root_falls_back_to_walk():
+    spec = {
+        "name": "r", "implementation": "SIMPLE_ROUTER",
+        "children": [mlp_node("a", seed=0), mlp_node("b", seed=1)],
+    }
+    walk = GraphEngine(spec, resolver=resolver_for(), name="p")
+    fused = GraphEngine(spec, resolver=resolver_for(), name="p",
+                        plan_mode="fused")
+    # router is a boundary; each branch still fuses as its own segment
+    assert fused.plan is not None
+    assert {s.name for s in fused.plan.segments} == {"a", "b"}
+    assert "r" in dict(fused.plan.boundaries)
+    x = np.zeros((1, 784), np.float32)
+    a = run(walk.predict(pinned(x)))
+    b = run(fused.predict(pinned(x)))
+    assert a.to_dict() == b.to_dict()
+    assert b.meta.routing == {"r": 0}
+
+
+def test_all_duck_graph_disables_plan():
+    class Duck:
+        def predict(self, X, names):
+            return np.asarray(X) + 1.0
+
+    spec = {"name": "m", "type": "MODEL"}
+    eng = GraphEngine(
+        spec,
+        resolver=lambda u: ComponentHandle(Duck(), name="m"),
+        plan_mode="fused",
+    )
+    assert eng.plan is None  # nothing fused -> direct interpreted walk
+    out = run(eng.predict(pinned(np.zeros((1, 2)))))
+    np.testing.assert_array_equal(out.host_data(), [[1.0, 1.0]])
+
+
+def test_non_tensor_payload_interprets_per_request():
+    """A fused graph still serves binData/jsonData requests — the fused fn
+    is tensor-in/tensor-out, so those interpret per-node."""
+    eng = GraphEngine(mlp_node("m"), resolver=resolver_for(),
+                      plan_mode="fused")
+    assert eng.plan is not None
+    msg = SeldonMessage(json_data={"rows": [[0.0] * 784]})
+    # MNISTMLP can't consume jsonData either way; both modes must agree on
+    # the failure surface, not crash the engine
+    out = run(eng.predict(msg))
+    walk = GraphEngine(mlp_node("m"), resolver=resolver_for())
+    ref = run(walk.predict(msg))
+    assert (out.status.status == ref.status.status
+            and out.status.code == ref.status.code)
+
+
+def test_invalid_plan_mode_rejected():
+    with pytest.raises(ValueError):
+        GraphEngine(mlp_node("m"), resolver=resolver_for(),
+                    plan_mode="turbo")
+
+
+# ---- custom metrics / tags parity --------------------------------------
+
+
+def test_tags_and_custom_metrics_identical_in_fused_mode():
+    import jax.numpy as jnp
+
+    class Tagged:
+        class_names = ["a", "b"]
+
+        def predict_fn(self, X):
+            return jnp.asarray(X) * 2.0
+
+        def tags(self):
+            return {"version": "v7"}
+
+        def metrics(self):
+            return [{"key": "hits", "type": "COUNTER", "value": 1}]
+
+    def resolve(u):
+        return ComponentHandle(Tagged(), name="m")
+
+    walk = GraphEngine({"name": "m", "type": "MODEL"}, resolver=resolve)
+    fused = GraphEngine({"name": "m", "type": "MODEL"}, resolver=resolve,
+                        plan_mode="fused")
+    assert fused.plan is not None and fused.plan.fully_fused
+    x = np.ones((1, 2), np.float32)
+    a = run(walk.predict(pinned(x)))
+    b = run(fused.predict(pinned(x)))
+    assert a.to_dict() == b.to_dict()
+    assert b.meta.tags == {"version": "v7"}
+    assert [m.key for m in b.meta.metrics] == ["hits"]
+    assert b.names == ["a", "b"]
+
+
+# ---- segment-level batching --------------------------------------------
+
+
+def test_fused_segment_batches_end_to_end():
+    from seldon_core_tpu.runtime.batcher import BatcherConfig
+
+    spec = {
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [mlp_node(f"m{i}", seed=i) for i in range(2)],
+    }
+    fused = GraphEngine(
+        spec, resolver=resolver_for(), name="p", plan_mode="fused",
+        plan_batcher=BatcherConfig(max_batch_size=8, max_delay_ms=5.0),
+    )
+    seg = fused.plan.segments[0]
+    assert seg.batcher is not None
+    walk = GraphEngine(spec, resolver=resolver_for(), name="p")
+    rng = np.random.default_rng(2)
+    xs = [rng.normal(size=(1, 784)).astype(np.float32) for _ in range(6)]
+
+    async def drive():
+        n0 = seg.n_calls
+        outs = await asyncio.gather(*(fused.predict(pinned(x)) for x in xs))
+        return outs, seg.n_calls - n0
+
+    outs, dispatches = run(drive())
+    # 6 concurrent requests coalesce into FEWER whole-segment dispatches
+    assert dispatches < len(xs)
+    for x, out in zip(xs, outs):
+        ref = run(walk.predict(pinned(x)))
+        np.testing.assert_allclose(np.asarray(out.host_data()),
+                                   np.asarray(ref.host_data()), rtol=2e-6)
+        assert out.meta.request_path == ref.meta.request_path
+
+
+# ---- example-graph parity (the acceptance contract) --------------------
+
+FAST_EXAMPLES = [
+    ("iris.json", np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)),
+    ("iris-with-outlier.json", np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)),
+    ("mnist.json", np.zeros((1, 784), np.float32)),
+    ("ensemble.json", np.zeros((1, 784), np.float32)),
+    ("epsilon-greedy-mab.json", np.zeros((1, 784), np.float32)),
+]
+
+SLOW_EXAMPLES = [
+    ("resnet50-v5e8.json", np.zeros((1, 224, 224, 3), np.float32)),
+    ("llm.json", np.array([[5, 9, 2, 7, 1]], np.int32)),
+]
+
+
+def _pin_router_seeds(dep) -> None:
+    # stochastic routers (EPSILON_GREEDY explore, RANDOM_ABTEST) must make
+    # the SAME branch choices in both engines for response comparison
+    for p in dep.predictors:
+        for u in p.graph.walk():
+            if u.implementation in ("EPSILON_GREEDY", "RANDOM_ABTEST"):
+                u.parameters["seed"] = 0
+
+
+#: metric keys whose VALUE is wall-clock-derived — identical between two
+#: executions only by coincidence, in walk mode just as in fused mode
+TIME_DERIVED_METRICS = {
+    "seldon_llm_generate_duration_seconds",
+    "seldon_llm_tokens_per_second",
+}
+
+
+def _canon(d: dict) -> dict:
+    for m in d.get("meta", {}).get("metrics", []):
+        if m.get("key") in TIME_DERIVED_METRICS:
+            m["value"] = None
+    return d
+
+
+def _example_parity(fname: str, x) -> None:
+    dep_walk = load_deployment_file(os.path.join(EXAMPLES, fname))
+    dep_fused = load_deployment_file(os.path.join(EXAMPLES, fname))
+    dep_fused.annotations["seldon.io/graph-plan"] = "fused"
+    _pin_router_seeds(dep_walk)
+    _pin_router_seeds(dep_fused)
+    walk = LocalDeployment(dep_walk, seed=0)
+    fused = LocalDeployment(dep_fused, seed=0)
+    for _ in range(2):
+        a = run(walk.predictors[0].engine.predict(pinned(x)))
+        b = run(fused.predictors[0].engine.predict(pinned(x)))
+        assert a.status is None or a.status.status == "SUCCESS", a.status
+        assert _canon(a.to_dict()) == _canon(b.to_dict()), fname
+
+
+@pytest.mark.parametrize("fname,x", FAST_EXAMPLES,
+                         ids=[f[0] for f in FAST_EXAMPLES])
+def test_example_graph_walk_fused_parity(fname, x):
+    _example_parity(fname, x)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fname,x", SLOW_EXAMPLES,
+                         ids=[f[0] for f in SLOW_EXAMPLES])
+def test_example_graph_walk_fused_parity_slow(fname, x):
+    _example_parity(fname, x)
+
+
+# ---- GL6xx lint report -------------------------------------------------
+
+
+def test_plan_lint_reports_segments_and_boundaries():
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    spec = {
+        "name": "r", "implementation": "SIMPLE_ROUTER",
+        "children": [mlp_node("a"), {"name": "duck", "type": "MODEL"}],
+    }
+    fs = lint_graph(spec, {"seldon.io/graph-plan": "fused"})
+    by_code = {}
+    for f in fs:
+        by_code.setdefault(f.code, []).append(f)
+    assert "GL601" in by_code  # 'a' fuses
+    assert any("a" in f.message for f in by_code["GL601"])
+    assert "GL602" in by_code  # router + duck stay boundaries
+    assert not any(f.code == "GL603" for f in fs)
+
+
+def test_plan_lint_warns_when_nothing_fuses():
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    fs = lint_graph({"name": "m", "type": "MODEL"},
+                    {"seldon.io/graph-plan": "fused"})
+    assert any(f.code == "GL603" and f.severity == "WARN" for f in fs)
+
+
+def test_plan_lint_rejects_bad_mode():
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    fs = lint_graph({"name": "m", "implementation": "SIMPLE_MODEL"},
+                    {"seldon.io/graph-plan": "warp"})
+    assert any(f.code == "GL604" and f.severity == "ERROR" for f in fs)
+
+
+def test_plan_lint_silent_in_walk_mode():
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    fs = lint_graph({"name": "m", "implementation": "SIMPLE_MODEL"}, {})
+    assert not [f for f in fs if f.code.startswith("GL6")]
+
+
+def test_operator_rejects_bad_plan_annotation():
+    from seldon_core_tpu.operator.compile import graph_plan_mode
+    from seldon_core_tpu.operator.spec import (
+        DeploymentValidationError,
+        SeldonDeployment,
+    )
+
+    dep = SeldonDeployment.from_dict({
+        "metadata": {"name": "d"},
+        "spec": {
+            "annotations": {"seldon.io/graph-plan": "warp"},
+            "predictors": [{
+                "name": "main",
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        },
+    })
+    with pytest.raises(DeploymentValidationError):
+        graph_plan_mode(dep, dep.predictors[0])
