@@ -273,6 +273,189 @@ def plan_smoke() -> int:
     return 1 if failures else 0
 
 
+def _cache_bench_engine(with_cache: bool, batching: bool = False,
+                        hidden: int = 1024):
+    """(engine, cache) over a single jitted MNIST MLP — the canonical
+    cacheable node — resolved through operator/local.py so annotations
+    drive batching exactly like production."""
+    from seldon_core_tpu.caching import CacheConfig, PredictionCache
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.operator.local import resolve_component
+
+    spec = {
+        "name": "m", "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+            {"name": "hidden", "value": str(hidden), "type": "INT"},
+        ],
+    }
+    ann = {"seldon.io/batching": "true" if batching else "false",
+           "seldon.io/batch-max-queue-rows": "0"}
+    cache = PredictionCache(CacheConfig(name="bench")) if with_cache else None
+    eng = GraphEngine(spec, resolver=lambda u: resolve_component(u, ann),
+                      name="cachebench", cache=cache)
+    return eng, cache
+
+
+def _seq_p50_us(eng, x, seconds: float, n_warm: int = 20) -> float:
+    """Sequential predict p50 (µs) for one pinned payload, measured
+    inside ONE event loop (an asyncio.run per call would swamp the hit
+    path with ~100µs of loop setup)."""
+    from seldon_core_tpu.messages import SeldonMessage
+
+    async def run() -> float:
+        for _ in range(n_warm):
+            await eng.predict(SeldonMessage.from_ndarray(x))
+        lat = []
+        t_end = time.perf_counter() + seconds
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            await eng.predict(SeldonMessage.from_ndarray(x))
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return lat[len(lat) // 2] * 1e6
+
+    return asyncio.run(run())
+
+
+def bench_prediction_cache(seconds: float = 2.0, concurrency: int = 32,
+                           pool: int = 64) -> dict:
+    """Prediction cache under Zipfian repeat traffic (the distribution
+    Clipper's cache was built for): throughput uplift vs the cold engine,
+    hit-path p50 vs cold p50, hit rate, and coalescing counters."""
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(pool, 1, 784)).astype(np.float32)
+    ranks = np.arange(1, pool + 1, dtype=np.float64)
+    p = ranks ** -1.1
+    p /= p.sum()
+    seq = np.random.default_rng(1).choice(pool, size=200_000, p=p)
+
+    async def drive(eng, secs: float) -> float:
+        # warm every distinct payload's compile path once
+        await eng.predict(SeldonMessage.from_ndarray(rows[0]))
+        count = 0
+        cursor = [0]
+        t_end = time.perf_counter() + secs
+
+        async def worker():
+            nonlocal count
+            while time.perf_counter() < t_end:
+                i = seq[cursor[0] % len(seq)]
+                cursor[0] += 1
+                out = await eng.predict(SeldonMessage.from_ndarray(rows[i]))
+                out.host_data()
+                count += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+        return count / (time.perf_counter() - t0)
+
+    cold_eng, _ = _cache_bench_engine(with_cache=False)
+    cache_eng, cache = _cache_bench_engine(with_cache=True)
+    cold_rps = asyncio.run(drive(cold_eng, seconds / 2))
+    cache_rps = asyncio.run(drive(cache_eng, seconds / 2))
+
+    cold_p50 = _seq_p50_us(_cache_bench_engine(False)[0], rows[0],
+                           seconds / 4)
+    hit_p50 = _seq_p50_us(_cache_bench_engine(True)[0], rows[0],
+                          seconds / 4)
+    s = cache.stats
+    total = s["hits"] + s["misses"]
+    return {
+        "traffic": f"zipf(1.1) over {pool} payloads, "
+                   f"concurrency {concurrency}",
+        "cold_req_per_s": round(cold_rps, 1),
+        "cached_req_per_s": round(cache_rps, 1),
+        "rps_uplift": round(cache_rps / cold_rps, 2) if cold_rps else None,
+        "cold_p50_us": round(cold_p50, 1),
+        "hit_p50_us": round(hit_p50, 1),
+        "hit_speedup": round(cold_p50 / hit_p50, 2) if hit_p50 else None,
+        "hit_rate": round(s["hits"] / total, 3) if total else None,
+        "coalesced": s["coalesced"],
+        "entries": s["entries"],
+    }
+
+
+def cache_smoke() -> int:
+    """Fast CI gate (CPU JAX): the prediction cache + single-flight must
+    actually dedupe — 100 concurrent identical requests reach the model
+    EXACTLY once (the coalesced group occupies one dynamic-batcher row),
+    a repeat after completion reaches it zero times, and the hit path is
+    >=5x faster than the cold path.  Returns a process exit code."""
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    failures = []
+    x = np.zeros((1, 784), np.float32)
+
+    # coalescing gate: batching ON so requests genuinely overlap in the
+    # event loop (the batcher's flush timer suspends the leader)
+    eng, cache = _cache_bench_engine(with_cache=True, batching=True)
+    calls = _count_walk_dispatches(eng)
+    batch_rows = []
+    node = next(iter(eng._nodes.values()))
+    batcher = node.impl._batcher
+    orig_run = batcher._run_batch
+
+    def counted_run(items, rows, _orig=orig_run):
+        batch_rows.append(rows)
+        return _orig(items, rows)
+
+    batcher._run_batch = counted_run
+
+    async def storm():
+        await asyncio.gather(
+            *(eng.predict(SeldonMessage.from_ndarray(x)) for _ in range(100))
+        )
+
+    asyncio.run(storm())
+    invocations = calls[0]
+    if invocations != 1:
+        failures.append(
+            f"100 concurrent identical requests invoked the model "
+            f"{invocations}x, expected exactly 1"
+        )
+    if batch_rows and batch_rows[0] != 1:
+        failures.append(
+            f"coalesced group occupied {batch_rows[0]} batch rows, "
+            "expected 1"
+        )
+    eng.predict_sync(SeldonMessage.from_ndarray(x))  # repeat → pure hit
+    if calls[0] != invocations:
+        failures.append("a repeat identical request re-invoked the model")
+    stats = cache.stats
+
+    # hit-path latency gate (batching off for a clean cold baseline)
+    cold_p50 = _seq_p50_us(_cache_bench_engine(False)[0], x, 0.5)
+    hit_p50 = _seq_p50_us(_cache_bench_engine(True)[0], x, 0.5)
+    speedup = cold_p50 / hit_p50 if hit_p50 else float("inf")
+    if speedup < 5.0:
+        failures.append(
+            f"hit-path p50 {hit_p50:.1f}us is only {speedup:.1f}x faster "
+            f"than cold {cold_p50:.1f}us, expected >=5x"
+        )
+    print(json.dumps({
+        "cache_smoke": {
+            "model_invocations_for_100_concurrent": invocations,
+            "batch_rows_first_flush": batch_rows[:1],
+            "coalesced": stats["coalesced"],
+            "hits": stats["hits"],
+            "cold_p50_us": round(cold_p50, 1),
+            "hit_p50_us": round(hit_p50, 1),
+            "hit_speedup": round(speedup, 2),
+        },
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
 RESNET50_GFLOPS = 8.2  # fwd FLOPs per 224x224 image: 4.1 GMACs x 2 FLOPs/MAC
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak, TPU v5e
 
@@ -1545,11 +1728,18 @@ def main() -> None:
                     help="fast CI gate: assert the fused graph plan "
                          "actually fuses (1 dispatch, walk parity) on "
                          "tiny CPU graphs, then exit")
+    ap.add_argument("--cache-smoke", action="store_true",
+                    help="fast CI gate: assert the prediction cache + "
+                         "single-flight dedupe (100 concurrent identical "
+                         "requests -> 1 model invocation, hit p50 >=5x "
+                         "faster than cold), then exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
     if args.plan_smoke:
         sys.exit(plan_smoke())
+    if args.cache_smoke:
+        sys.exit(cache_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice
@@ -1567,6 +1757,12 @@ def main() -> None:
         extras["graph_plan"] = bench_graph_plan(min(args.seconds, 2.0))
     except Exception as e:
         extras["graph_plan_error"] = f"{type(e).__name__}: {e}"
+    try:
+        extras["prediction_cache"] = bench_prediction_cache(
+            min(args.seconds, 2.0)
+        )
+    except Exception as e:
+        extras["prediction_cache_error"] = f"{type(e).__name__}: {e}"
     # headline wire tier: native servers + Python engine + native loadgen
     try:
         rest = bench_rest_socket_native(args.seconds)
@@ -1709,6 +1905,13 @@ def main() -> None:
           "plan_fused_p50_us")
     _pick(extras, ["graph_plan", "linear3", "fused_dispatches_per_req"],
           "plan_dispatches", 0)
+    _pick(extras, ["prediction_cache", "cached_req_per_s"], "cache_rps")
+    _pick(extras, ["prediction_cache", "rps_uplift"], "cache_rps_uplift", 2)
+    _pick(extras, ["prediction_cache", "cold_p50_us"], "cache_cold_p50_us")
+    _pick(extras, ["prediction_cache", "hit_p50_us"], "cache_hit_p50_us")
+    _pick(extras, ["prediction_cache", "hit_speedup"], "cache_speedup", 2)
+    _pick(extras, ["prediction_cache", "hit_rate"], "cache_hit_rate", 3)
+    _pick(extras, ["prediction_cache", "coalesced"], "cache_coalesced", 0)
     _pick(extras, ["resnet50", "mfu_pct"], "resnet_mfu_pct")
     _pick(extras, ["resnet50", "img_per_s"], "resnet_img_per_s")
     _pick(extras, ["llm_decode", "bf16_tokens_per_s"], "llm_tok_per_s")

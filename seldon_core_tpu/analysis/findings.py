@@ -10,6 +10,10 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL3xx`` — resource / deadline feasibility
 - ``GL6xx`` — graph-plan fusion report (which segments fuse, and why the
   rest stay interpreter boundaries)
+- ``GL7xx`` — prediction-cache admission (annotation validation +
+  cacheability: RNG routers, stateful components, and
+  per-request-meta-dependent nodes are uncacheable; forcing them cached
+  is an error)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -46,6 +50,11 @@ PLAN_SEGMENT_FUSED = "GL601"    # graph-plan: nodes fused into one segment
 PLAN_NODE_BOUNDARY = "GL602"    # graph-plan: node stays an interpreter boundary
 PLAN_NOTHING_FUSED = "GL603"    # fused mode requested but no segment fused
 PLAN_MODE_INVALID = "GL604"     # seldon.io/graph-plan value unknown
+CACHE_ANNOTATION_INVALID = "GL701"  # seldon.io/prediction-cache* value invalid
+CACHE_FORCED_UNCACHEABLE = "GL702"  # node forced `cacheable` but unsafe
+CACHE_SUBTREE_CACHEABLE = "GL703"   # cache report: subtree serves from cache
+CACHE_NODE_UNCACHEABLE = "GL704"    # cache report: node always bypasses
+CACHE_NOTHING_CACHEABLE = "GL705"   # cache enabled but nothing cacheable
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -74,6 +83,11 @@ CODE_SEVERITY = {
     PLAN_NODE_BOUNDARY: INFO,
     PLAN_NOTHING_FUSED: WARN,
     PLAN_MODE_INVALID: ERROR,
+    CACHE_ANNOTATION_INVALID: ERROR,
+    CACHE_FORCED_UNCACHEABLE: ERROR,
+    CACHE_SUBTREE_CACHEABLE: INFO,
+    CACHE_NODE_UNCACHEABLE: INFO,
+    CACHE_NOTHING_CACHEABLE: WARN,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

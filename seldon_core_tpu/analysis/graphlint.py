@@ -28,6 +28,11 @@ import json
 from typing import Any, Optional
 
 from seldon_core_tpu.analysis.findings import (
+    CACHE_ANNOTATION_INVALID,
+    CACHE_FORCED_UNCACHEABLE,
+    CACHE_NODE_UNCACHEABLE,
+    CACHE_NOTHING_CACHEABLE,
+    CACHE_SUBTREE_CACHEABLE,
     COMBINER_ARITY,
     COMBINER_INPUT_DIVERGENCE,
     DEADLINE_INFEASIBLE,
@@ -150,6 +155,7 @@ def lint_graph(
         findings.extend(_deadline_pass(unit, ann, path_prefix))
         findings.extend(_hbm_pass(unit, ann, path_prefix))
         findings.extend(_plan_pass(unit, ann, path_prefix))
+        findings.extend(_cache_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -591,6 +597,139 @@ def _plan_pass(root: PredictiveUnit, ann: dict,
             PLAN_NOTHING_FUSED, _join(prefix, root.name),
             f"{PLAN_ANNOTATION}=fused requested but no segment fuses — "
             "the engine will fall back to the interpreted walk",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# prediction-cache pass (GL7xx)
+# ---------------------------------------------------------------------------
+
+#: cache annotations validated here (values live in caching/store.py)
+CACHE_ANNOTATION = "seldon.io/prediction-cache"
+
+
+def _cache_boundary_reason(u: PredictiveUnit) -> Optional[str]:
+    """Why this node can never serve from the prediction cache, or None.
+
+    Mirrors the runtime test (``caching/policy.py``: pure tensor function
+    AND deterministic) with what the spec can prove.  Determinism comes
+    from the signature registry (``models/__init__.py``) — RNG routers,
+    learning components, and per-request-meta-dependent classes register
+    ``deterministic=False`` there, so this pass never hardcodes names."""
+    if u.parameters.get("cacheable") is False:
+        return "opted out (`cacheable: false` parameter)"
+    t = u.resolved_type
+    if t == "ROUTER":
+        sig = BUILTIN_SIGNATURES.get(u.implementation or "")
+        if sig is not None and not sig.deterministic:
+            return (f"router {u.implementation} is registered "
+                    "non-deterministic (RNG/learned routing state)")
+        return "ROUTER: data-dependent control flow re-runs per request"
+    if u.endpoint.service_host and u.endpoint.type != "LOCAL":
+        return "remote endpoint: determinism cannot be proven over transport"
+    if u.implementation:
+        sig = BUILTIN_SIGNATURES.get(u.implementation)
+        if sig is None:
+            return (f"built-in {u.implementation} has no registered "
+                    "signature; determinism cannot be proven")
+        if not sig.deterministic:
+            return (f"built-in {u.implementation} is registered "
+                    "non-deterministic")
+        if not sig.pure_fn:
+            return (f"built-in {u.implementation} is not a pure on-device "
+                    "tensor function")
+        return None
+    mc = u.parameters.get("model_class")
+    if not (isinstance(mc, str) and mc):
+        return "no implementation or model_class to resolve in-process"
+    sig = signature_for(mc)
+    if sig is None:
+        return (f"model_class {mc!r} has no registered signature; "
+                "determinism cannot be proven")
+    if not sig.deterministic:
+        return (f"model_class {mc!r} is registered non-deterministic "
+                "(stateful/learning or per-request-meta-dependent output)")
+    if not sig.pure_fn:
+        return (f"model_class {mc!r} is not registered as a pure tensor "
+                "function")
+    return None
+
+
+def _cache_pass(root: PredictiveUnit, ann: dict,
+                prefix: str) -> list[Finding]:
+    """Prediction-cache admission (GL7xx, active when
+    ``seldon.io/prediction-cache`` is set): validates the annotation
+    values (GL701), reports which maximal subtrees will serve from the
+    engine-tier cache (GL703) and why every other node always bypasses
+    (GL704), and ERRORS on nodes force-annotated ``cacheable: true`` that
+    the runtime would have to bypass (GL702) — an unsatisfiable spec must
+    reject at admission, not silently under-cache in production."""
+    if CACHE_ANNOTATION not in ann:
+        return []
+    from seldon_core_tpu.caching import config_from_annotations
+
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(CACHE_ANNOTATION_INVALID, path0, str(e))]
+    if cfg is None:
+        return []
+    findings: list[Finding] = []
+    cacheable_subtrees: list[list[str]] = []
+
+    def subtree_ok(u: PredictiveUnit) -> bool:
+        if _cache_boundary_reason(u) is not None:
+            return False
+        if u.resolved_type == "COMBINER" and not u.children:
+            return False
+        return all(subtree_ok(c) for c in u.children)
+
+    def first_reason(u: PredictiveUnit) -> Optional[str]:
+        r = _cache_boundary_reason(u)
+        if r is not None:
+            return f"{u.name}: {r}"
+        for c in u.children:
+            rr = first_reason(c)
+            if rr is not None:
+                return rr
+        return None
+
+    def visit(u: PredictiveUnit, path: str) -> None:
+        ok = subtree_ok(u)
+        if u.parameters.get("cacheable") is True and not ok:
+            findings.append(make_finding(
+                CACHE_FORCED_UNCACHEABLE, path,
+                f"annotated `cacheable: true` but the subtree can never "
+                f"serve from the cache ({first_reason(u)}); the runtime "
+                "would silently bypass — fix the node or drop the "
+                "annotation",
+            ))
+            # fall through: still report the boundary structure below
+        if ok:
+            cacheable_subtrees.append([n.name for n in u.walk()])
+            findings.append(make_finding(
+                CACHE_SUBTREE_CACHEABLE, path,
+                f"caches as one unit ({len(cacheable_subtrees[-1])} "
+                f"node(s)): {' -> '.join(cacheable_subtrees[-1])}",
+            ))
+            return
+        reason = _cache_boundary_reason(u) or \
+            "a descendant prevents whole-subtree caching"
+        findings.append(make_finding(
+            CACHE_NODE_UNCACHEABLE, path,
+            f"always bypasses the prediction cache: {reason}",
+        ))
+        for c in u.children:
+            visit(c, _join(path, c.name))
+
+    visit(root, path0)
+    if not cacheable_subtrees:
+        findings.append(make_finding(
+            CACHE_NOTHING_CACHEABLE, path0,
+            f"{CACHE_ANNOTATION} enabled but no subtree is cacheable — "
+            "only the gateway tier (raw-body dedup) will cache",
         ))
     return findings
 

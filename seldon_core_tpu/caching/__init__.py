@@ -1,0 +1,43 @@
+"""Prediction cache + single-flight coalescing (docs/caching.md).
+
+Three tiers share this package:
+
+1. **gateway** (``gateway/app.py``): content-addressed cache over the raw
+   request body per deployment (``seldon.io/prediction-cache``
+   annotation), ``X-Seldon-Cache: hit|miss|coalesced`` response header;
+2. **engine walk mode** (``graph/engine.py``): memoisation of maximal
+   deterministic-pure subtrees, with per-request meta replay;
+3. **engine fused-plan mode**: per-segment cache — a hit skips the whole
+   compiled device dispatch and may hand back an HBM-resident result.
+
+All tiers coalesce concurrent identical requests through one
+:class:`SingleFlight` table (N arrivals → 1 model invocation → N
+responses), composing with the dynamic batcher: a coalesced group
+occupies exactly one batch row.
+"""
+
+from seldon_core_tpu.caching.key import array_key, message_key, raw_key
+from seldon_core_tpu.caching.singleflight import SingleFlight
+from seldon_core_tpu.caching.store import (
+    CACHE_ANNOTATION,
+    CACHE_BYTES_ANNOTATION,
+    CACHE_TTL_ANNOTATION,
+    CacheConfig,
+    PredictionCache,
+    cache_enabled,
+    config_from_annotations,
+)
+
+__all__ = [
+    "array_key",
+    "message_key",
+    "raw_key",
+    "SingleFlight",
+    "CacheConfig",
+    "PredictionCache",
+    "CACHE_ANNOTATION",
+    "CACHE_BYTES_ANNOTATION",
+    "CACHE_TTL_ANNOTATION",
+    "cache_enabled",
+    "config_from_annotations",
+]

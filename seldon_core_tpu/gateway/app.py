@@ -20,6 +20,12 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from seldon_core_tpu.caching import (
+    PredictionCache,
+    SingleFlight,
+    config_from_annotations,
+    raw_key,
+)
 from seldon_core_tpu.gateway.firehose import NullFirehose, make_firehose
 from seldon_core_tpu.gateway.oauth import OAuthProvider, default_token_store
 from seldon_core_tpu.gateway.store import DeploymentStore
@@ -53,6 +59,13 @@ class Gateway:
         self.retry_backoff_s = retry_backoff_s
         self._session: Optional[aiohttp.ClientSession] = None
         self._grpc_channels: dict[str, object] = {}
+        # deployment-level prediction cache (docs/caching.md gateway tier):
+        # content-addressed over the RAW request body — the forward path
+        # still never parses — keyed per deployment, enabled by the
+        # seldon.io/prediction-cache annotation on the deployment record.
+        # Concurrent identical bodies coalesce onto one engine forward.
+        self._caches: dict[str, Optional[PredictionCache]] = {}
+        self._flight = SingleFlight()
 
     # ------------------------------------------------------------------
     # shared forwarding client (pooled, apife parity: 150 conns)
@@ -123,46 +136,44 @@ class Gateway:
                 status=404,
             )
         body = await request.read()
-        sess = await self.session()
-        # Retry with backoff on connection-level failures (reference apife
-        # HttpRetryHandler.java: 3 attempts).  POST predict is safe to retry
-        # ONLY when the request never reached the engine — connection errors
-        # qualify; once a response (any status) arrives we pass it through.
-        last_err: Optional[Exception] = None
-        out_body, out_status = b"", 0
-        for attempt in range(self.retries + 1):
-            if attempt:
-                await asyncio.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
-                self.registry.counter_inc(
-                    "seldon_api_gateway_retries_total",
-                    {"deployment": rec.name, "path": path},
+        content_type = request.headers.get("Content-Type", "application/json")
+        # Prediction cache (annotation seldon.io/prediction-cache on the
+        # deployment record): a byte-identical repeat of a /predictions
+        # body never re-traverses gateway→engine→model; concurrent
+        # identical bodies coalesce onto ONE in-flight engine forward.
+        # The response advertises what happened in X-Seldon-Cache.
+        # Feedback is stateful (MAB rewards) and never cached.
+        cache_state: Optional[str] = None
+        cache = (
+            self._dep_cache(rec) if path.endswith("/predictions") else None
+        )
+        if cache is not None:
+            key = raw_key(rec.name, path, body)
+            hit = cache.get(key)
+            if hit is not None:
+                out_status, out_body = hit
+                cache_state = "hit"
+            else:
+
+                async def compute():
+                    st, bd = await self._forward_engine(
+                        rec, path, body, content_type
+                    )
+                    if st == 200:
+                        cache.put(key, (st, bd), len(bd) + len(key))
+                    return st, bd
+
+                (out_status, out_body), coalesced = await self._flight.run(
+                    key, compute
                 )
-            try:
-                async with sess.post(
-                    rec.engine_url.rstrip("/") + path,
-                    data=body,
-                    headers={"Content-Type": request.headers.get(
-                        "Content-Type", "application/json")},
-                ) as resp:
-                    out_body = await resp.read()
-                    out_status = resp.status
-                last_err = None
-                break
-            except aiohttp.ClientConnectorError as e:
-                # connection never established — the request cannot have
-                # reached the engine, so replaying it is safe
-                last_err = e
-            except aiohttp.ClientError as e:
-                # includes ServerDisconnectedError: the engine may have
-                # executed the (non-idempotent) request before dying — a
-                # replay could e.g. apply a MAB feedback reward twice
-                last_err = e
-                break
-        if last_err is not None:
-            return web.json_response(
-                {"status": {"code": 503, "status": "FAILURE",
-                            "info": f"engine unreachable: {last_err}"}},
-                status=503,
+                if coalesced:
+                    cache.note_coalesced(1)
+                    cache_state = "coalesced"
+                else:
+                    cache_state = "miss"
+        else:
+            out_status, out_body = await self._forward_engine(
+                rec, path, body, content_type
             )
         if path.endswith("/predictions") and not isinstance(
             self.firehose, NullFirehose
@@ -187,9 +198,81 @@ class Gateway:
             time.perf_counter() - t0,
             {"deployment": rec.name, "path": path},
         )
+        headers = {"X-Seldon-Cache": cache_state} if cache_state else None
         return web.Response(
-            body=out_body, status=out_status, content_type="application/json"
+            body=out_body, status=out_status, content_type="application/json",
+            headers=headers,
         )
+
+    async def _forward_engine(
+        self, rec, path: str, body: bytes, content_type: str
+    ) -> tuple[int, bytes]:
+        """One engine forward with connection-failure retries (reference
+        apife HttpRetryHandler.java: 3 attempts).  POST predict is safe to
+        retry ONLY when the request never reached the engine — connection
+        errors qualify; once a response (any status) arrives it passes
+        through.  Persistent unreachability becomes the 503 FAILURE body
+        (never cached: the caller only stores 200s)."""
+        sess = await self.session()
+        last_err: Optional[Exception] = None
+        out_body, out_status = b"", 0
+        for attempt in range(self.retries + 1):
+            if attempt:
+                await asyncio.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+                self.registry.counter_inc(
+                    "seldon_api_gateway_retries_total",
+                    {"deployment": rec.name, "path": path},
+                )
+            try:
+                async with sess.post(
+                    rec.engine_url.rstrip("/") + path,
+                    data=body,
+                    headers={"Content-Type": content_type},
+                ) as resp:
+                    out_body = await resp.read()
+                    out_status = resp.status
+                last_err = None
+                break
+            except aiohttp.ClientConnectorError as e:
+                # connection never established — the request cannot have
+                # reached the engine, so replaying it is safe
+                last_err = e
+            except aiohttp.ClientError as e:
+                # includes ServerDisconnectedError: the engine may have
+                # executed the (non-idempotent) request before dying — a
+                # replay could e.g. apply a MAB feedback reward twice
+                last_err = e
+                break
+        if last_err is not None:
+            return 503, json.dumps(
+                {"status": {"code": 503, "status": "FAILURE",
+                            "info": f"engine unreachable: {last_err}"}}
+            ).encode()
+        return out_status, out_body
+
+    def _dep_cache(self, rec) -> Optional[PredictionCache]:
+        """The deployment's gateway-tier cache, built (and rebuilt on
+        annotation change) from its ``seldon.io/prediction-cache*``
+        annotations.  Invalid values log once and leave the tier off —
+        admission rejects them upstream; the gateway must keep serving."""
+        try:
+            cfg = config_from_annotations(rec.annotations, rec.name)
+        except ValueError as e:
+            if rec.name not in self._caches or \
+                    self._caches[rec.name] is not None:
+                logger.warning("deployment %s: %s — cache disabled",
+                               rec.name, e)
+            self._caches[rec.name] = None
+            return None
+        if cfg is None:
+            self._caches.pop(rec.name, None)
+            return None
+        cur = self._caches.get(rec.name)
+        if cur is not None and cur.config == cfg:
+            return cur
+        cache = PredictionCache(cfg, metrics=self.registry)
+        self._caches[rec.name] = cache
+        return cache
 
     async def _handle_predict(self, request: web.Request) -> web.Response:
         return await self._forward(request, "/api/v0.1/predictions")

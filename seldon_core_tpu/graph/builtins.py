@@ -44,7 +44,16 @@ class SimpleRouter:
 
 class RandomABTest:
     """Random A/B split; parameter ``ratioA`` is the probability of branch 0
-    (``RandomABTestUnit.java:36-66``)."""
+    (``RandomABTestUnit.java:36-66``).
+
+    The ``seed`` graph parameter (INT) pins the RNG stream for
+    reproducible routing in tests/canaries; the router stays registered
+    non-deterministic in the signature registry (``models/__init__.py``)
+    either way — the stream still advances per request, so the
+    prediction cache must never capture a branch choice.
+    """
+
+    deterministic = False  # runtime mirror of the registry flag
 
     def __init__(self, ratioA: float = 0.5, seed: Optional[int] = None):
         self.ratio_a = float(ratioA)
@@ -82,7 +91,13 @@ class EpsilonGreedy:
     ``meta.routing`` (delivered here via the engine's ``routing=`` kwarg —
     the reference router re-parses it from the raw response dict).
     Thread-safe; state is checkpointable (see graph engine persistence).
+
+    The ``seed`` graph parameter (INT) pins the exploration RNG for
+    reproducible routing in tests; reward state still learns online, so
+    the router is registered non-deterministic (``models/__init__.py``).
     """
+
+    deterministic = False  # runtime mirror of the registry flag
 
     def __init__(
         self,

@@ -77,6 +77,8 @@ class GraphEngine:
         walk_timeout_s: Optional[float] = None,
         plan_mode: str = "walk",
         plan_batcher: Optional[Any] = None,
+        cache: Optional[Any] = None,
+        cache_version: str = "",
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -121,6 +123,30 @@ class GraphEngine:
                     name, self.plan.boundaries,
                 )
                 self.plan = None
+        # prediction cache (caching/store.py PredictionCache, annotation
+        # seldon.io/prediction-cache): walk mode memoises maximal
+        # deterministic-pure subtrees; plan mode caches per fused segment
+        # (a hit skips the whole compiled dispatch).  ``cache_version``
+        # folds the model/deployment version into every key so a weight
+        # rollout can never serve stale entries.  Concurrent identical
+        # requests coalesce through one SingleFlight table — N arrivals,
+        # 1 model invocation (and 1 dynamic-batcher row), N responses.
+        self.cache = cache
+        self.cache_version = cache_version
+        self._flight = None
+        self._cache_roots: set[int] = set()
+        if cache is not None:
+            from seldon_core_tpu.caching import SingleFlight
+
+            self._flight = SingleFlight()
+            if self.plan is None:
+                from seldon_core_tpu.caching.policy import (
+                    maximal_cacheable_roots,
+                )
+
+                self._cache_roots = {
+                    id(n) for n in maximal_cacheable_roots(self.root)
+                }
 
     def _build(self, unit: PredictiveUnit) -> _Node:
         impl: NodeImpl
@@ -214,6 +240,21 @@ class GraphEngine:
         return out
 
     async def _walk(self, node: _Node, msg: SeldonMessage, meta: Meta) -> SeldonMessage:
+        """Walk dispatcher: maximal cacheable subtree roots take the
+        memoised path (one key, one stored result, meta-delta replay);
+        everything else — including every node BELOW a cache root on its
+        cold computation — runs the plain per-node walk."""
+        if (
+            self.cache is not None
+            and id(node) in self._cache_roots
+            and msg.data is not None
+        ):
+            return await self._walk_cached(node, msg, meta)
+        return await self._walk_node(node, msg, meta)
+
+    async def _walk_node(
+        self, node: _Node, msg: SeldonMessage, meta: Meta
+    ) -> SeldonMessage:
         """One node of the recursive walk (``PredictiveUnitBean.java:94-167``).
 
         Order of operations preserved exactly: requestPath stamp →
@@ -330,6 +371,73 @@ class GraphEngine:
             self.metrics.observe_node(self.name, node_name, elapsed)
 
     # ------------------------------------------------------------------
+    # prediction cache (walk mode): maximal-subtree memoisation
+    # ------------------------------------------------------------------
+    async def _walk_cached(
+        self, node: _Node, msg: SeldonMessage, meta: Meta
+    ) -> SeldonMessage:
+        """Serve one maximal cacheable subtree from the cache.
+
+        An entry is ``(data, names, delta)`` where ``delta`` is the Meta
+        the subtree's cold walk produced (requestPath stamps in walk
+        order, component tags, custom metrics) — replayed into each
+        caller's request meta so hit/coalesced responses are
+        byte-identical to the cold path modulo per-request meta (puid).
+        Anything unhashable or erroring takes the cold path untouched —
+        uncacheable work silently bypasses, it never poisons the cache.
+        """
+        from seldon_core_tpu.caching.key import message_key
+
+        name = node.unit.name
+        key = message_key(
+            msg, node=name, graph=self.name, version=self.cache_version
+        )
+        if key is None:
+            return await self._walk_node(node, msg, meta)
+        t0 = time.perf_counter()
+        entry = self.cache.get(key)
+        if entry is not None:
+            with self.tracer.span(name, kind="CACHE_HIT"):
+                out = self._replay_entry(entry, meta, node)
+            self._observe(name, time.perf_counter() - t0)
+            return out
+
+        async def compute():
+            sub = Meta()
+            cold = await self._walk_node(node, msg, sub)
+            e = (cold.data, list(cold.names), sub)
+            self.cache.put(key, e, _entry_nbytes(cold.data, cold.names, sub))
+            return e
+
+        entry, coalesced = await self._flight.run(key, compute)
+        if coalesced:
+            self.cache.note_coalesced()
+            with self.tracer.span(name, kind="CACHE_COALESCED"):
+                out = self._replay_entry(entry, meta, node)
+        else:
+            out = self._replay_entry(entry, meta, node)
+        self._observe(name, time.perf_counter() - t0)
+        return out
+
+    def _replay_entry(
+        self, entry: tuple, meta: Meta, node: _Node
+    ) -> SeldonMessage:
+        """Materialize a cache entry as this request's response fragment.
+
+        The stored delta is copied before merging (callers own their
+        response meta); interior numpy payloads are copied too — a parent
+        duck component mutating its input in place must never reach the
+        shared cached buffer (jax.Arrays are immutable, so device-resident
+        entries hand out the HBM handle directly)."""
+        data, names, delta = entry
+        meta.merge(delta.copy())
+        import numpy as _np
+
+        if node is not self.root and isinstance(data, _np.ndarray):
+            data = data.copy()
+        return SeldonMessage(data=data, names=list(names))
+
+    # ------------------------------------------------------------------
     # plan mode: walk the segment DAG instead of the node tree
     # ------------------------------------------------------------------
     async def _plan_walk(self, pnode: Any, msg: SeldonMessage,
@@ -343,7 +451,9 @@ class GraphEngine:
                 # jsonData requests interpret this subtree per-node (the
                 # node tree is always intact beneath the plan)
                 return await self._walk(pnode.node, msg, meta)
-            out = await self._run_segment(pnode.segment, msg, meta)
+            out = await self._run_segment(
+                pnode.segment, msg, meta, interior=bool(pnode.children)
+            )
             if pnode.children:
                 # chain segment: fused prefix feeds the interpreted rest
                 return await self._plan_walk(pnode.children[0], out, meta)
@@ -361,20 +471,16 @@ class GraphEngine:
             return await self._walk_traced(node, msg, meta, child_walks=walks)
 
     async def _run_segment(self, seg: Any, msg: SeldonMessage,
-                           meta: Meta) -> SeldonMessage:
+                           meta: Meta, interior: bool = False) -> SeldonMessage:
         """Execute one fused segment: ONE device dispatch (optionally via
-        the segment's dynamic batcher, amortizing it across requests),
-        then replay the segment's meta script so requestPath/tags/custom
-        metrics are byte-identical to the interpreted walk.  Emits ONE
-        observe_node for the whole segment."""
+        the segment's dynamic batcher, amortizing it across requests) —
+        or ZERO when the prediction cache holds the segment's result for
+        this exact input.  Either way the segment's meta script replays
+        per request, so requestPath/tags/custom metrics are byte-identical
+        to the interpreted walk.  Emits ONE observe_node for the whole
+        segment."""
         t0 = time.perf_counter()
-        with self.tracer.span(seg.label, kind="FUSED_SEGMENT"):
-            x = msg.data
-            if seg.batcher is not None:
-                y = await seg.batcher(x)
-            else:
-                y = seg(x)
-            names = seg.out_names(x, msg.names)
+        y, names = await self._segment_result(seg, msg, interior)
         for ev in seg.meta_events:
             if ev.op == "stamp":
                 meta.request_path[ev.name] = ev.label
@@ -385,6 +491,63 @@ class GraphEngine:
                     self.metrics.merge_custom(ev.name, cm.metrics)
         self._observe(seg.label, time.perf_counter() - t0)
         return SeldonMessage(data=y, names=names)
+
+    async def _segment_result(
+        self, seg: Any, msg: SeldonMessage, interior: bool
+    ) -> tuple:
+        """``(y, names)`` for one segment input: cache hit → stored result
+        (zero dispatch; device-resident entries stay in HBM), in-flight
+        duplicate → coalesced onto the leader's future (one dispatch, one
+        batcher row for the whole group), else ONE fresh dispatch."""
+        x = msg.data
+        key = None
+        if self.cache is not None and seg.cacheable:
+            from seldon_core_tpu.caching.key import array_key
+
+            key = array_key(
+                x, msg.names, node=seg.label, graph=self.name,
+                version=self.cache_version,
+            )
+        if key is None:
+            return await self._dispatch_segment(seg, x, msg.names)
+        entry = self.cache.get(key)
+        if entry is not None:
+            with self.tracer.span(seg.label, kind="CACHE_HIT"):
+                pass
+            return self._segment_entry(entry, interior)
+
+        async def compute():
+            e = await self._dispatch_segment(seg, x, msg.names)
+            self.cache.put(key, e, _entry_nbytes(e[0], e[1]))
+            return e
+
+        entry, coalesced = await self._flight.run(key, compute)
+        if coalesced:
+            self.cache.note_coalesced()
+            with self.tracer.span(seg.label, kind="CACHE_COALESCED"):
+                pass
+        return self._segment_entry(entry, interior)
+
+    async def _dispatch_segment(self, seg: Any, x: Any, in_names) -> tuple:
+        with self.tracer.span(seg.label, kind="FUSED_SEGMENT"):
+            if seg.batcher is not None:
+                y = await seg.batcher(x)
+            else:
+                y = seg(x)
+            names = seg.out_names(x, in_names)
+        return y, list(names)
+
+    @staticmethod
+    def _segment_entry(entry: tuple, interior: bool) -> tuple:
+        """Chain segments feed an interpreted (possibly mutating)
+        remainder — hand interior consumers a private numpy copy so they
+        can never corrupt the shared cached buffer."""
+        y, names = entry
+        import numpy as _np
+
+        if interior and isinstance(y, _np.ndarray):
+            y = y.copy()
+        return y, list(names)
 
     # ------------------------------------------------------------------
     # feedback
@@ -456,6 +619,19 @@ class GraphEngine:
 
     def send_feedback_sync(self, fb: Feedback) -> SeldonMessage:
         return _run_sync(self.send_feedback(fb))
+
+
+def _entry_nbytes(data: Any, names, delta: Optional[Meta] = None) -> int:
+    """Byte cost of one cache entry for the store's budget.  ``nbytes``
+    is metadata-only on jax.Arrays (no device→host transfer); the meta
+    delta is charged a flat overhead per item."""
+    n = int(getattr(data, "nbytes", 0) or 0) + 64
+    n += sum(len(str(x)) + 8 for x in names or ())
+    if delta is not None:
+        n += 64 * (
+            len(delta.request_path) + len(delta.tags) + len(delta.metrics)
+        )
+    return n
 
 
 def _run_sync(coro):

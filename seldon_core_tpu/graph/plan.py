@@ -79,6 +79,10 @@ class _Stage:
     handle: Any                     # ComponentHandle (meta/tags/names source)
     class_names: Optional[list] = None
     feature_names: Optional[list] = None
+    # prediction-cache narrowing (caching/policy.py): node opted out via
+    # the `cacheable: false` parameter, or component declared
+    # `deterministic = False` — either poisons segment-level caching
+    cache_opt_out: bool = False
 
     def out_names(self, y_shape: tuple, in_names: list) -> list:
         """Replicate ComponentHandle name resolution for this stage's
@@ -147,6 +151,10 @@ def extract_stage(node: Any) -> Optional[_Stage]:
             feature_names=(list(user.feature_names)
                            if getattr(user, "feature_names", None) is not None
                            else None),
+            cache_opt_out=(
+                node.unit.parameters.get("cacheable") is False
+                or getattr(user, "deterministic", True) is False
+            ),
         )
 
     if kind == "COMBINER":
@@ -251,6 +259,10 @@ class FusedSegment:
         self.batcher = None  # set by compile_plan when batching is on
         self.n_calls = 0     # device dispatches issued (bench/CI smoke)
         self._names_cache: dict = {}
+        # prediction-cache eligibility: every member is a pure tensor fn by
+        # construction, so the segment caches unless a member opted out or
+        # declared itself non-deterministic (graph/engine.py consults this)
+        self.cacheable = not any(s.cache_opt_out for s in self.members)
 
     # -- compile-time ----------------------------------------------------
     def _collect(self, t: _SegTree) -> None:

@@ -37,6 +37,13 @@ class ModelSignature:
     function (``predict_fn``-style) with no per-request host state — the
     static precondition the graph-plan compiler (``graph/plan.py``) needs
     to fuse the node into a jitted segment; the GL6xx lint pass reads it.
+
+    ``deterministic`` declares that identical inputs always produce
+    identical outputs — False for RNG routers, learning/stateful
+    components, and anything with per-request-meta-dependent output.  The
+    prediction cache (``seldon_core_tpu/caching``) and its GL7xx
+    admission pass read it from HERE, not from hardcoded class names, so
+    third-party components opt out by registering a signature.
     """
 
     input_shape: Optional[Shape] = None
@@ -45,6 +52,7 @@ class ModelSignature:
     output_dtype: Optional[str] = None
     hbm_bytes: int = 0
     pure_fn: bool = False
+    deterministic: bool = True
 
 
 def _dense_bytes(sizes: tuple, dtype_bytes: int = 4) -> int:
@@ -81,22 +89,41 @@ SIGNATURES: dict[str, ModelSignature] = {
         hbm_bytes=25_600_000 * 1,
         pure_fn=True,
     ),
-    # token-in/token-out: ragged [batch, seq] int32 ids (runtime/llm.py)
+    # token-in/token-out: ragged [batch, seq] int32 ids (runtime/llm.py);
+    # non-deterministic for caching: generation metrics are time-derived
+    # and the continuous-batching engine holds per-request state
     "seldon_core_tpu.models.llm_demo:DemoLLM": ModelSignature(
         input_shape=(ANY, ANY), input_dtype="int32",
         output_shape=(ANY, ANY), output_dtype="int32",
         hbm_bytes=2 * 64 * (4 * 64 * 64 + 2 * 64 * 128) * 4,
+        deterministic=False,
     ),
-    # learning transformer: scores rows, passes data through unchanged
-    "seldon_core_tpu.models.outlier:MahalanobisOutlier": ModelSignature(),
+    # learning transformer: scores rows, passes data through unchanged —
+    # the running moments (and its tags) change with every request
+    "seldon_core_tpu.models.outlier:MahalanobisOutlier": ModelSignature(
+        deterministic=False,
+    ),
 }
 
-#: built-in implementations with a static output contract
+#: built-in implementations with a static contract.  The router entries
+#: exist for their ``deterministic`` flag: the GL7xx cacheability pass
+#: reads RNG/learned-state routers from the registry instead of
+#: hardcoding implementation names.
 BUILTIN_SIGNATURES: dict[str, ModelSignature] = {
     # fixed [[1.0, 2.0, 3.0]] broadcast per row (graph/builtins.py)
     "SIMPLE_MODEL": ModelSignature(
         output_shape=(ANY, 3), output_dtype="float64",
     ),
+    # always branch 0 — deterministic, but routers are still cache
+    # boundaries (control flow re-runs per request)
+    "SIMPLE_ROUTER": ModelSignature(),
+    # RNG split per request (graph/builtins.py RandomABTest; a `seed`
+    # graph parameter pins it for tests, but the stream still advances)
+    "RANDOM_ABTEST": ModelSignature(deterministic=False),
+    # epsilon-greedy MAB: RNG exploration + reward state learned online
+    "EPSILON_GREEDY": ModelSignature(deterministic=False),
+    # element-wise mean over children, pure on-device
+    "AVERAGE_COMBINER": ModelSignature(pure_fn=True),
 }
 
 

@@ -30,6 +30,11 @@ class MahalanobisOutlier:
     covariance yet).
     """
 
+    # learning component: scores depend on the running moments, so the
+    # prediction cache must always bypass (also registered in
+    # models/__init__.py BUILTIN/model signatures as deterministic=False)
+    deterministic = False
+
     def __init__(self, warmup: int = 10, shrinkage: float = 1e-2):
         self.warmup = int(warmup)
         self.shrinkage = float(shrinkage)

@@ -271,6 +271,22 @@ def graph_plan_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     return mode
 
 
+def prediction_cache_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/prediction-cache*`` annotations → a validated
+    :class:`~seldon_core_tpu.caching.CacheConfig` (or None when the tier
+    is off).  Invalid values reject at admission — graphlint's GL701 pass
+    reports the same defect, this is the hard stop for callers that skip
+    linting (``seldon.io/graphlint: off``)."""
+    from seldon_core_tpu.caching import config_from_annotations
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

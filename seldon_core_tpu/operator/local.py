@@ -135,7 +135,10 @@ class LocalPredictor:
         self.spec = pred
         self.metrics = metrics or EngineMetrics(deployment=dep.name)
         ann = {**dep.annotations, **pred.annotations}
-        from seldon_core_tpu.operator.compile import graph_plan_mode
+        from seldon_core_tpu.operator.compile import (
+            graph_plan_mode,
+            prediction_cache_config,
+        )
 
         plan_mode = graph_plan_mode(dep, pred)
         # fused segments batch END-TO-END: the whole segment is the
@@ -145,6 +148,18 @@ class LocalPredictor:
             _batcher_config(ann)
             if plan_mode == "fused" and _batching_enabled(ann) else None
         )
+        # prediction cache (seldon.io/prediction-cache): engine-tier
+        # memoisation + single-flight coalescing over deterministic pure
+        # subtrees/segments (docs/caching.md); the CR's spec-hash rides in
+        # every key so a weight rollout invalidates by construction
+        cache_cfg = prediction_cache_config(dep, pred)
+        self.cache = None
+        if cache_cfg is not None:
+            from seldon_core_tpu.caching import PredictionCache
+
+            self.cache = PredictionCache(
+                cache_cfg, metrics=self.metrics.registry
+            )
         self.engine = GraphEngine(
             pred.graph,
             resolver=lambda u: resolve_component(u, ann, self.metrics.registry),
@@ -156,6 +171,8 @@ class LocalPredictor:
             ),
             plan_mode=plan_mode,
             plan_batcher=plan_batcher,
+            cache=self.cache,
+            cache_version=str(ann.get("seldon.io/spec-hash", "")),
         )
         if (self.engine.plan is not None
                 and ann.get("seldon.io/graph-plan-warmup", "").lower()

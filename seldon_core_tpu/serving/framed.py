@@ -377,16 +377,41 @@ class AsyncFramedClient:
 
 
 class FramedClient:
-    """Blocking client for the framed protocol (one connection)."""
+    """Blocking client for the framed protocol (one connection).
+
+    ``timeout`` bounds BOTH connect and every subsequent round trip —
+    the same knob :class:`AsyncFramedClient` applies per request — so a
+    hung component surfaces as a ``TimeoutError`` instead of blocking the
+    caller forever.  ``None`` restores the old block-forever behavior
+    (explicitly, never by default).  Per-call override via
+    ``predict(msg, timeout=...)``.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout: float = 30.0):
+                 timeout: Optional[float] = 30.0):
         self._codec = FrameCodec()
+        self._timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
-    def _roundtrip(self, payload: bytes) -> Frame:
-        frame = self._codec.decode(self.ping_raw(payload))
+    def _roundtrip(self, payload: bytes,
+                   timeout: Optional[float] = None) -> Frame:
+        eff = self._timeout if timeout is None else timeout
+        if eff != self._timeout:
+            self._sock.settimeout(eff)
+        try:
+            raw = self.ping_raw(payload)
+        except TimeoutError:
+            # the connection is now mid-frame and unusable; fail loudly
+            # with the deadline that fired rather than a bare socket error
+            raise TimeoutError(
+                f"framed RPC timed out after {eff}s (connection must be "
+                "discarded)"
+            ) from None
+        finally:
+            if eff != self._timeout:
+                self._sock.settimeout(self._timeout)
+        frame = self._codec.decode(raw)
         if frame.msg_type == MSG_ERROR:
             msg = decode_message(frame)
             info = msg.status.info if msg.status else "remote error"
@@ -403,13 +428,19 @@ class FramedClient:
             n -= len(b)
         return b"".join(chunks)
 
-    def predict(self, msg: SeldonMessage) -> SeldonMessage:
+    def predict(self, msg: SeldonMessage,
+                timeout: Optional[float] = None) -> SeldonMessage:
         return decode_message(
-            self._roundtrip(encode_message(self._codec, msg, MSG_PREDICT))
+            self._roundtrip(encode_message(self._codec, msg, MSG_PREDICT),
+                            timeout=timeout)
         )
 
-    def send_feedback(self, fb: Feedback) -> SeldonMessage:
-        return decode_message(self._roundtrip(encode_feedback(self._codec, fb)))
+    def send_feedback(self, fb: Feedback,
+                      timeout: Optional[float] = None) -> SeldonMessage:
+        return decode_message(
+            self._roundtrip(encode_feedback(self._codec, fb),
+                            timeout=timeout)
+        )
 
     def ping_raw(self, payload: bytes) -> bytes:
         """Raw frame round-trip (transport benchmarking)."""

@@ -1,0 +1,902 @@
+"""Prediction cache + single-flight coalescing (docs/caching.md).
+
+The contracts under test:
+
+- **byte parity**: for every shipped example graph, in both
+  ``plan_mode="walk"`` and ``"fused"``, a cache-enabled engine's
+  responses (miss AND hit) are byte-identical to a cache-free engine's —
+  data, requestPath, routing, tags, custom metrics (modulo per-request
+  meta and wall-clock-derived metric values, exactly like the walk↔fused
+  parity suite);
+- **dedup**: N concurrent identical requests → exactly 1 underlying
+  ``predict`` call and 1 dynamic-batcher row; a repeat after completion
+  → 0 further calls;
+- **bypass**: uncacheable nodes (RNG routers, stateful components)
+  silently bypass — they re-run per request and never poison the cache;
+- **bounds**: byte-budget LRU eviction and TTL expiry both re-invoke
+  the model;
+- **admission**: GL7xx rejects invalid annotation values and specs that
+  force-annotate uncacheable subtrees as cached.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.caching import (
+    CacheConfig,
+    PredictionCache,
+    SingleFlight,
+    config_from_annotations,
+    message_key,
+    raw_key,
+)
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.operator.local import (
+    LocalDeployment,
+    load_deployment_file,
+    resolve_component,
+)
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "graphs")
+
+NO_BATCH = {"seldon.io/batching": "false"}
+
+
+def resolver_for(ann=NO_BATCH):
+    return lambda u: resolve_component(u, ann)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mlp_node(name, seed=0, hidden=32):
+    return {
+        "name": name, "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+            {"name": "seed", "value": str(seed), "type": "INT"},
+            {"name": "hidden", "value": str(hidden), "type": "INT"},
+        ],
+    }
+
+
+def pinned(x, names=()):
+    msg = SeldonMessage.from_ndarray(np.asarray(x), names)
+    msg.meta.puid = "cache-pinned"
+    return msg
+
+
+def count_model_calls(eng) -> list:
+    """Wrap every node's compiled callable with a counter (the same hook
+    bench.py's smoke gates use)."""
+    counter = [0]
+    for node in eng._nodes.values():
+        handle = getattr(node.impl, "handle", node.impl)
+        fn = getattr(handle, "_compiled", None)
+        if fn is None:
+            continue
+
+        def counted(*a, _fn=fn, **kw):
+            counter[0] += 1
+            return _fn(*a, **kw)
+
+        handle._compiled = counted
+    return counter
+
+
+# ---- keys ---------------------------------------------------------------
+
+
+class TestKeys:
+    def test_shape_never_collides_with_flat_bytes(self):
+        a = pinned(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        b = pinned(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        assert message_key(a) != message_key(b)
+
+    def test_dtype_distinguishes_equal_bytes(self):
+        a = pinned(np.zeros(4, np.float32))
+        b = pinned(np.zeros(4, np.int32))
+        assert message_key(a) != message_key(b)
+
+    def test_node_graph_version_and_names_partition(self):
+        m = pinned(np.ones((1, 2), np.float32))
+        base = message_key(m, node="n", graph="g", version="v1")
+        assert base == message_key(m, node="n", graph="g", version="v1")
+        assert base != message_key(m, node="n2", graph="g", version="v1")
+        assert base != message_key(m, node="n", graph="g2", version="v1")
+        assert base != message_key(m, node="n", graph="g", version="v2")
+        named = pinned(np.ones((1, 2), np.float32), names=["a", "b"])
+        assert base != message_key(named, node="n", graph="g", version="v1")
+
+    def test_meta_is_excluded(self):
+        a = pinned(np.ones(3, np.float32))
+        b = SeldonMessage.from_ndarray(np.ones(3, np.float32))
+        b.meta.puid = "other"
+        b.meta.tags["t"] = 1
+        assert message_key(a) == message_key(b)
+
+    def test_json_payload_canonicalized(self):
+        a = SeldonMessage(json_data={"b": 1, "a": 2})
+        b = SeldonMessage(json_data={"a": 2, "b": 1})
+        assert message_key(a) == message_key(b) is not None
+
+    def test_empty_and_object_payloads_unkeyable(self):
+        assert message_key(SeldonMessage()) is None
+        assert message_key(
+            SeldonMessage(data=np.array([object()], dtype=object))
+        ) is None
+
+    def test_raw_key_over_bytes(self):
+        assert raw_key("dep", "/p", b"body") == raw_key("dep", "/p", b"body")
+        assert raw_key("dep", "/p", b"body") != raw_key("dep", "/p", b"body2")
+
+
+# ---- store --------------------------------------------------------------
+
+
+class TestStore:
+    def test_lru_eviction_under_byte_budget(self):
+        c = PredictionCache(CacheConfig(max_bytes=100))
+        c.put("a", 1, 60)
+        c.put("b", 2, 30)
+        assert c.get("a") == 1  # refresh a
+        c.put("c", 3, 60)       # over budget → evicts LRU (b), then a? no:
+        # bytes: a=60 b=30 → +c=60 = 150 → evict b (LRU) → 120 → evict a
+        assert c.get("b") is None
+        assert c.get("c") == 3
+        assert c.stats["bytes"] <= 100
+
+    def test_oversized_value_not_stored(self):
+        c = PredictionCache(CacheConfig(max_bytes=10))
+        assert c.put("k", 1, 11) is False
+        assert c.get("k") is None
+
+    def test_ttl_expiry_is_a_miss(self):
+        c = PredictionCache(CacheConfig(ttl_s=0.03))
+        c.put("k", 1, 1)
+        assert c.get("k") == 1
+        time.sleep(0.05)
+        assert c.get("k") is None
+        assert c.stats["evictions"] == 1
+
+    def test_counters(self):
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = PredictionCache(CacheConfig(name="t"), metrics=reg)
+        c.put("k", 1, 5)
+        c.get("k")
+        c.get("nope")
+        c.note_coalesced(3)
+        text = reg.render()
+        assert 'seldon_cache_hits_total{cache="t"} 1' in text
+        assert 'seldon_cache_misses_total{cache="t"} 1' in text
+        assert 'seldon_coalesced_requests_total{cache="t"} 3' in text
+        assert 'seldon_cache_bytes{cache="t"} 5' in text
+
+    def test_config_from_annotations(self):
+        assert config_from_annotations({}, "x") is None
+        cfg = config_from_annotations(
+            {"seldon.io/prediction-cache": "true",
+             "seldon.io/prediction-cache-bytes": "1024",
+             "seldon.io/prediction-cache-ttl-ms": "250"}, "x")
+        assert (cfg.max_bytes, cfg.ttl_s) == (1024, 0.25)
+        for bad in (
+            {"seldon.io/prediction-cache": "maybe"},
+            {"seldon.io/prediction-cache": "true",
+             "seldon.io/prediction-cache-bytes": "-1"},
+            {"seldon.io/prediction-cache": "true",
+             "seldon.io/prediction-cache-ttl-ms": "soon"},
+        ):
+            with pytest.raises(ValueError):
+                config_from_annotations(bad, "x")
+
+
+# ---- single-flight ------------------------------------------------------
+
+
+class TestSingleFlight:
+    def test_concurrent_identical_coalesce(self):
+        sf = SingleFlight()
+        calls = [0]
+
+        async def compute():
+            calls[0] += 1
+            await asyncio.sleep(0.02)
+            return "v"
+
+        async def drive():
+            return await asyncio.gather(
+                *(sf.run("k", compute) for _ in range(8))
+            )
+
+        results = run(drive())
+        assert calls[0] == 1
+        assert sum(1 for _, coalesced in results if coalesced) == 7
+        assert all(v == "v" for v, _ in results)
+
+    def test_leader_error_propagates_and_clears(self):
+        sf = SingleFlight()
+
+        async def boom():
+            await asyncio.sleep(0.01)
+            raise RuntimeError("nope")
+
+        async def drive():
+            outs = await asyncio.gather(
+                *(sf.run("k", boom) for _ in range(3)),
+                return_exceptions=True,
+            )
+            return outs
+
+        outs = run(drive())
+        assert all(isinstance(o, RuntimeError) for o in outs)
+        assert sf.leader_count() == 0  # next arrival retries cold
+
+
+# ---- engine, walk mode --------------------------------------------------
+
+
+def cached_engine(spec, max_bytes=1 << 20, ttl_s=0.0, ann=NO_BATCH, **kw):
+    cache = PredictionCache(CacheConfig(name="t", max_bytes=max_bytes,
+                                        ttl_s=ttl_s))
+    eng = GraphEngine(spec, resolver=resolver_for(ann), name="p",
+                      cache=cache, **kw)
+    return eng, cache
+
+
+class TestEngineWalkMode:
+    def test_hit_skips_model_and_is_byte_identical(self):
+        spec = mlp_node("m")
+        cold = GraphEngine(spec, resolver=resolver_for(), name="p")
+        eng, cache = cached_engine(spec)
+        calls = count_model_calls(eng)
+        x = np.random.default_rng(0).normal(size=(1, 784)).astype(np.float32)
+        ref = run(cold.predict(pinned(x)))
+        first = run(eng.predict(pinned(x)))
+        second = run(eng.predict(pinned(x)))
+        assert calls[0] == 1  # second request never reached the model
+        assert ref.to_dict() == first.to_dict() == second.to_dict()
+        assert cache.stats["hits"] == 1
+
+    def test_tags_and_custom_metrics_replayed_on_hit(self):
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.runtime.component import ComponentHandle
+
+        class Tagged:
+            class_names = ["a", "b"]
+
+            def predict_fn(self, X):
+                return jnp.asarray(X) * 2.0
+
+            def tags(self):
+                return {"version": "v7"}
+
+            def metrics(self):
+                return [{"key": "hits", "type": "COUNTER", "value": 1}]
+
+        def resolve(u):
+            return ComponentHandle(Tagged(), name="m")
+
+        cold = GraphEngine({"name": "m", "type": "MODEL"}, resolver=resolve)
+        eng = GraphEngine({"name": "m", "type": "MODEL"}, resolver=resolve,
+                          cache=PredictionCache(CacheConfig()))
+        x = np.ones((1, 2), np.float32)
+        ref = run(cold.predict(pinned(x)))
+        run(eng.predict(pinned(x)))
+        hit = run(eng.predict(pinned(x)))
+        assert hit.to_dict() == ref.to_dict()
+        assert hit.meta.tags == {"version": "v7"}
+        assert [m.key for m in hit.meta.metrics] == ["hits"]
+        assert hit.names == ["a", "b"]
+
+    def test_distinct_payloads_distinct_entries(self):
+        eng, cache = cached_engine(mlp_node("m"))
+        calls = count_model_calls(eng)
+        a = np.zeros((1, 784), np.float32)
+        b = np.ones((1, 784), np.float32)
+        run(eng.predict(pinned(a)))
+        run(eng.predict(pinned(b)))
+        assert calls[0] == 2
+        assert cache.stats["entries"] == 2
+
+    def test_ttl_expiry_reinvokes_model(self):
+        eng, _ = cached_engine(mlp_node("m"), ttl_s=0.03)
+        calls = count_model_calls(eng)
+        x = np.zeros((1, 784), np.float32)
+        run(eng.predict(pinned(x)))
+        run(eng.predict(pinned(x)))
+        assert calls[0] == 1
+        time.sleep(0.05)
+        run(eng.predict(pinned(x)))
+        assert calls[0] == 2
+
+    def test_eviction_under_byte_budget_reinvokes(self):
+        a = np.zeros((1, 784), np.float32)
+        b = np.ones((1, 784), np.float32)
+        # measure one entry's charged size, then budget for 1.5 entries
+        probe_eng, probe_cache = cached_engine(mlp_node("m"))
+        run(probe_eng.predict(pinned(a)))
+        entry_bytes = probe_cache.stats["bytes"]
+        assert entry_bytes > 0
+        eng, cache = cached_engine(mlp_node("m"),
+                                   max_bytes=int(entry_bytes * 1.5))
+        calls = count_model_calls(eng)
+        run(eng.predict(pinned(a)))
+        run(eng.predict(pinned(b)))  # evicts a's entry (LRU under budget)
+        assert cache.stats["evictions"] >= 1
+        run(eng.predict(pinned(a)))  # must recompute
+        assert calls[0] == 3
+
+    def test_rng_router_bypasses_but_branches_cache(self):
+        """Uncacheable nodes silently bypass: an unseeded RANDOM_ABTEST
+        keeps routing randomly (both branches observed over 40 identical
+        requests) while each branch's model computes exactly once."""
+        spec = {
+            "name": "ab", "implementation": "RANDOM_ABTEST",
+            "children": [mlp_node("a", seed=0), mlp_node("b", seed=1)],
+        }
+        eng, cache = cached_engine(spec)
+        calls = count_model_calls(eng)
+        x = np.zeros((1, 784), np.float32)
+        routes = set()
+        for _ in range(40):
+            out = run(eng.predict(pinned(x)))
+            routes.add(out.meta.routing["ab"])
+        assert routes == {0, 1}       # the router really re-ran per request
+        assert calls[0] == 2          # one cold compute per branch
+        assert cache.stats["entries"] == 2
+
+    def test_stateful_outlier_never_cached(self):
+        """The learning Mahalanobis transformer is non-deterministic (its
+        tags carry the observation count) — it must run per request even
+        under the cache, while the pure model below it caches."""
+        dep = load_deployment_file(
+            os.path.join(EXAMPLES, "iris-with-outlier.json"))
+        dep.annotations["seldon.io/prediction-cache"] = "true"
+        local = LocalDeployment(dep, seed=0)
+        eng = local.predictors[0].engine
+        assert eng.cache is not None
+        x = np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)
+        a = run(eng.predict(pinned(x)))
+        b = run(eng.predict(pinned(x)))
+        # observation count advanced → the transformer really re-ran
+        assert a.meta.tags["observed"] == 1
+        assert b.meta.tags["observed"] == 2
+        # the iris classifier below it served the repeat from the cache
+        assert eng.cache.stats["hits"] == 1
+
+    def test_unhashable_payload_takes_cold_path(self):
+        eng, _cache = cached_engine(mlp_node("m"))
+        msg = SeldonMessage(json_data={"rows": [[0.0] * 784]})
+        cold = GraphEngine(mlp_node("m"), resolver=resolver_for(), name="p")
+        out = run(eng.predict(msg))
+        ref = run(cold.predict(SeldonMessage(json_data={"rows": [[0.0] * 784]})))
+        assert (out.status.status == ref.status.status
+                and out.status.code == ref.status.code)
+
+
+# ---- cache ↔ batcher interplay (single-flight composition) --------------
+
+
+class TestCacheBatcherInterplay:
+    def _batched_engine(self):
+        ann = {"seldon.io/batching": "true",
+               "seldon.io/batch-max-size": "8",
+               "seldon.io/batch-max-delay-ms": "5.0",
+               "seldon.io/batch-max-queue-rows": "0"}
+        cache = PredictionCache(CacheConfig(name="t"))
+        eng = GraphEngine(mlp_node("m"), resolver=resolver_for(ann),
+                          name="p", cache=cache)
+        node = next(iter(eng._nodes.values()))
+        batcher = node.impl._batcher
+        rows = []
+        orig = batcher._run_batch
+
+        def counted(items, nrows, _orig=orig):
+            rows.append(nrows)
+            return _orig(items, nrows)
+
+        batcher._run_batch = counted
+        return eng, cache, rows
+
+    def test_n_identical_one_predict_one_batch_row(self):
+        eng, cache, rows = self._batched_engine()
+        calls = count_model_calls(eng)
+        x = np.zeros((1, 784), np.float32)
+
+        async def storm():
+            return await asyncio.gather(
+                *(eng.predict(pinned(x)) for _ in range(16))
+            )
+
+        outs = run(storm())
+        assert calls[0] == 1          # ONE underlying predict call
+        assert rows == [1]            # the coalesced group = ONE batch row
+        assert cache.stats["coalesced"] == 15
+        ref = outs[0].to_dict()
+        assert all(o.to_dict() == ref for o in outs)
+
+    def test_distinct_payloads_still_batch_together(self):
+        eng, cache, rows = self._batched_engine()
+        xs = [np.full((1, 784), float(i), np.float32) for i in range(4)]
+
+        async def storm():
+            return await asyncio.gather(
+                *(eng.predict(pinned(x)) for x in xs)
+            )
+
+        run(storm())
+        # 4 distinct rows coalesce into fewer batches (the batcher's job),
+        # each of them a separate cache entry
+        assert sum(rows) == 4
+        assert len(rows) < 4
+        assert cache.stats["entries"] == 4
+
+
+# ---- engine, fused plan mode --------------------------------------------
+
+
+class TestEngineFusedMode:
+    def test_segment_hit_skips_whole_dispatch(self):
+        spec = {
+            "name": "ens", "type": "COMBINER",
+            "implementation": "AVERAGE_COMBINER",
+            "children": [mlp_node(f"m{i}", seed=i) for i in range(3)],
+        }
+        cold = GraphEngine(spec, resolver=resolver_for(), name="p",
+                           plan_mode="fused")
+        eng, _cache = cached_engine(spec, plan_mode="fused")
+        assert eng.plan is not None and eng.plan.fully_fused
+        seg = eng.plan.segments[0]
+        assert seg.cacheable
+        x = np.random.default_rng(1).normal(size=(2, 784)).astype(np.float32)
+        ref = run(cold.predict(pinned(x)))
+        first = run(eng.predict(pinned(x)))
+        n_after_first = seg.n_calls
+        second = run(eng.predict(pinned(x)))
+        assert seg.n_calls == n_after_first == 1  # hit: ZERO new dispatches
+        assert ref.to_dict() == first.to_dict() == second.to_dict()
+
+    def test_coalesced_segment_one_dispatch(self):
+        eng, cache = cached_engine(mlp_node("m"), plan_mode="fused")
+        seg = eng.plan.segments[0]
+        from seldon_core_tpu.runtime.batcher import (
+            BatcherConfig,
+            DynamicBatcher,
+        )
+
+        seg.batcher = DynamicBatcher(
+            seg, BatcherConfig(max_batch_size=8, max_delay_ms=5.0)
+        )
+        x = np.zeros((1, 784), np.float32)
+
+        async def storm():
+            return await asyncio.gather(
+                *(eng.predict(pinned(x)) for _ in range(10))
+            )
+
+        outs = run(storm())
+        assert seg.n_calls == 1
+        assert cache.stats["coalesced"] == 9
+        ref = outs[0].to_dict()
+        assert all(o.to_dict() == ref for o in outs)
+
+    def test_opted_out_segment_never_caches(self):
+        spec = mlp_node("m")
+        spec["parameters"].append(
+            {"name": "cacheable", "value": "false", "type": "BOOL"})
+        eng, cache = cached_engine(spec, plan_mode="fused")
+        seg = eng.plan.segments[0]
+        assert not seg.cacheable
+        x = np.zeros((1, 784), np.float32)
+        run(eng.predict(pinned(x)))
+        run(eng.predict(pinned(x)))
+        assert seg.n_calls == 2
+        assert cache.stats["entries"] == 0
+
+
+# ---- example-graph parity (the acceptance contract) ---------------------
+
+FAST_EXAMPLES = [
+    ("iris.json", np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)),
+    ("iris-with-outlier.json", np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)),
+    ("mnist.json", np.zeros((1, 784), np.float32)),
+    ("ensemble.json", np.zeros((1, 784), np.float32)),
+    ("epsilon-greedy-mab.json", np.zeros((1, 784), np.float32)),
+]
+
+SLOW_EXAMPLES = [
+    ("resnet50-v5e8.json", np.zeros((1, 224, 224, 3), np.float32)),
+    ("llm.json", np.array([[5, 9, 2, 7, 1]], np.int32)),
+]
+
+
+def _pin_router_seeds(dep) -> None:
+    for p in dep.predictors:
+        for u in p.graph.walk():
+            if u.implementation in ("EPSILON_GREEDY", "RANDOM_ABTEST"):
+                u.parameters["seed"] = 0
+
+
+#: wall-clock-derived metric values (identical only by coincidence)
+TIME_DERIVED_METRICS = {
+    "seldon_llm_generate_duration_seconds",
+    "seldon_llm_tokens_per_second",
+}
+
+
+def _canon(d: dict) -> dict:
+    for m in d.get("meta", {}).get("metrics", []):
+        if m.get("key") in TIME_DERIVED_METRICS:
+            m["value"] = None
+    return d
+
+
+def _example_cache_parity(fname: str, x, plan: str) -> None:
+    dep_cold = load_deployment_file(os.path.join(EXAMPLES, fname))
+    dep_cached = load_deployment_file(os.path.join(EXAMPLES, fname))
+    for dep in (dep_cold, dep_cached):
+        _pin_router_seeds(dep)
+        dep.annotations["seldon.io/graph-plan"] = plan
+    dep_cached.annotations["seldon.io/prediction-cache"] = "true"
+    cold = LocalDeployment(dep_cold, seed=0)
+    cached = LocalDeployment(dep_cached, seed=0)
+    assert cached.predictors[0].cache is not None
+    # iteration 1 exercises the miss path, iteration 2 the hit path;
+    # stateful nodes (outlier counts, MAB exploration) advance in
+    # lockstep because uncacheable nodes re-run per request
+    for _ in range(2):
+        a = run(cold.predictors[0].engine.predict(pinned(x)))
+        b = run(cached.predictors[0].engine.predict(pinned(x)))
+        assert a.status is None or a.status.status == "SUCCESS", a.status
+        assert _canon(a.to_dict()) == _canon(b.to_dict()), (fname, plan)
+
+
+@pytest.mark.parametrize("plan", ["walk", "fused"])
+@pytest.mark.parametrize("fname,x", FAST_EXAMPLES,
+                         ids=[f[0] for f in FAST_EXAMPLES])
+def test_example_graph_cache_parity(fname, x, plan):
+    _example_cache_parity(fname, x, plan)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("plan", ["walk", "fused"])
+@pytest.mark.parametrize("fname,x", SLOW_EXAMPLES,
+                         ids=[f[0] for f in SLOW_EXAMPLES])
+def test_example_graph_cache_parity_slow(fname, x, plan):
+    _example_cache_parity(fname, x, plan)
+
+
+# ---- GL7xx admission ----------------------------------------------------
+
+
+class TestAdmission:
+    def test_invalid_annotation_gl701(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph(mlp_node("m"),
+                        {"seldon.io/prediction-cache": "sometimes"})
+        assert any(f.code == "GL701" and f.severity == "ERROR" for f in fs)
+        fs = lint_graph(mlp_node("m"),
+                        {"seldon.io/prediction-cache": "true",
+                         "seldon.io/prediction-cache-bytes": "lots"})
+        assert any(f.code == "GL701" for f in fs)
+
+    def test_forced_rng_router_subtree_gl702_rejects(self):
+        from seldon_core_tpu.analysis.graphlint import GraphAnalysisError
+        from seldon_core_tpu.operator.compile import admission_lint
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+
+        spec = {
+            "name": "ab", "implementation": "RANDOM_ABTEST",
+            "parameters": [
+                {"name": "cacheable", "value": "true", "type": "BOOL"}],
+            "children": [mlp_node("a"), mlp_node("b")],
+        }
+        dep = SeldonDeployment.from_dict({
+            "metadata": {"name": "d"},
+            "spec": {
+                "annotations": {"seldon.io/prediction-cache": "true"},
+                "predictors": [{"name": "main", "graph": spec}],
+            },
+        })
+        with pytest.raises(GraphAnalysisError) as ei:
+            admission_lint(dep)
+        assert any(f.code == "GL702" for f in ei.value.findings)
+
+    def test_report_codes(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        spec = {
+            "name": "r", "implementation": "SIMPLE_ROUTER",
+            "children": [mlp_node("a"), {"name": "duck", "type": "MODEL"}],
+        }
+        fs = lint_graph(spec, {"seldon.io/prediction-cache": "true"})
+        by_code = {}
+        for f in fs:
+            by_code.setdefault(f.code, []).append(f)
+        assert "GL703" in by_code           # 'a' caches
+        assert any("a" in f.message for f in by_code["GL703"])
+        assert "GL704" in by_code           # router + duck bypass
+        assert "GL705" not in by_code
+
+    def test_nothing_cacheable_gl705(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph({"name": "m", "implementation": "SIMPLE_MODEL"},
+                        {"seldon.io/prediction-cache": "true"})
+        assert any(f.code == "GL705" and f.severity == "WARN" for f in fs)
+
+    def test_silent_without_annotation(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph(mlp_node("m"), {})
+        assert not [f for f in fs if f.code.startswith("GL7")]
+
+    def test_operator_rejects_bad_annotation_value(self):
+        from seldon_core_tpu.operator.compile import prediction_cache_config
+        from seldon_core_tpu.operator.spec import (
+            DeploymentValidationError,
+            SeldonDeployment,
+        )
+
+        dep = SeldonDeployment.from_dict({
+            "metadata": {"name": "d"},
+            "spec": {
+                "annotations": {"seldon.io/prediction-cache": "warp"},
+                "predictors": [{
+                    "name": "main",
+                    "graph": {"name": "m",
+                              "implementation": "SIMPLE_MODEL"},
+                }],
+            },
+        })
+        with pytest.raises(DeploymentValidationError):
+            prediction_cache_config(dep, dep.predictors[0])
+
+
+# ---- gateway tier -------------------------------------------------------
+
+
+class TestGatewayCache:
+    async def _gateway(self, engine_handler, annotations):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+
+        app = web.Application()
+        app.router.add_post("/api/v0.1/predictions", engine_handler)
+        app.router.add_post("/api/v0.1/feedback", engine_handler)
+        engine = TestClient(TestServer(app))
+        await engine.start_server()
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name="dep1", oauth_key="key1", oauth_secret="sec1",
+            engine_url=f"http://127.0.0.1:{engine.port}",
+            annotations=annotations,
+        ))
+        gw = Gateway(store)
+        client = TestClient(TestServer(gw.build_app()))
+        await client.start_server()
+        token, _ = gw.oauth.tokens.issue("key1")
+        return gw, client, engine, token
+
+    async def test_hit_miss_headers_and_engine_called_once(self):
+        from aiohttp import web
+
+        calls = [0]
+
+        async def engine(request):
+            calls[0] += 1
+            return web.json_response(
+                {"data": {"ndarray": [[1.0]]},
+                 "status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await self._gateway(
+            engine, {"seldon.io/prediction-cache": "true"})
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            body = {"data": {"ndarray": [[7.0]]}}
+            r1 = await client.post("/api/v0.1/predictions", json=body,
+                                   headers=hdr)
+            r2 = await client.post("/api/v0.1/predictions", json=body,
+                                   headers=hdr)
+            assert r1.headers["X-Seldon-Cache"] == "miss"
+            assert r2.headers["X-Seldon-Cache"] == "hit"
+            assert calls[0] == 1
+            assert await r1.json() == await r2.json()
+            # a different body is a different key
+            r3 = await client.post("/api/v0.1/predictions",
+                                   json={"data": {"ndarray": [[8.0]]}},
+                                   headers=hdr)
+            assert r3.headers["X-Seldon-Cache"] == "miss"
+            assert calls[0] == 2
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_concurrent_identical_coalesce(self):
+        from aiohttp import web
+
+        calls = [0]
+
+        async def engine(request):
+            calls[0] += 1
+            await asyncio.sleep(0.1)
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await self._gateway(
+            engine, {"seldon.io/prediction-cache": "true"})
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            body = {"data": {"ndarray": [[1.0]]}}
+            rs = await asyncio.gather(*(
+                client.post("/api/v0.1/predictions", json=body, headers=hdr)
+                for _ in range(5)
+            ))
+            states = sorted(r.headers["X-Seldon-Cache"] for r in rs)
+            assert calls[0] == 1
+            assert states == ["coalesced"] * 4 + ["miss"]
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_feedback_never_cached(self):
+        from aiohttp import web
+
+        calls = [0]
+
+        async def engine(request):
+            calls[0] += 1
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await self._gateway(
+            engine, {"seldon.io/prediction-cache": "true"})
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            for _ in range(2):
+                r = await client.post("/api/v0.1/feedback",
+                                      json={"reward": 1.0}, headers=hdr)
+                assert "X-Seldon-Cache" not in r.headers
+            assert calls[0] == 2
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_errors_not_cached(self):
+        from aiohttp import web
+
+        calls = [0]
+
+        async def engine(request):
+            calls[0] += 1
+            if calls[0] == 1:
+                return web.json_response(
+                    {"status": {"code": 500, "status": "FAILURE"}},
+                    status=500)
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await self._gateway(
+            engine, {"seldon.io/prediction-cache": "true"})
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            body = {"data": {"ndarray": [[1.0]]}}
+            r1 = await client.post("/api/v0.1/predictions", json=body,
+                                   headers=hdr)
+            assert r1.status == 500
+            r2 = await client.post("/api/v0.1/predictions", json=body,
+                                   headers=hdr)
+            assert r2.status == 200      # the failure was never cached
+            assert r2.headers["X-Seldon-Cache"] == "miss"
+            r3 = await client.post("/api/v0.1/predictions", json=body,
+                                   headers=hdr)
+            assert r3.headers["X-Seldon-Cache"] == "hit"
+            assert calls[0] == 2
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_disabled_without_annotation(self):
+        from aiohttp import web
+
+        async def engine(request):
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await self._gateway(engine, {})
+        try:
+            r = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": f"Bearer {token}"})
+            assert "X-Seldon-Cache" not in r.headers
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+
+# ---- sync FramedClient timeout (transport satellite) --------------------
+
+
+class TestFramedClientTimeout:
+    def test_hung_component_times_out(self):
+        import socket
+        import threading
+
+        from seldon_core_tpu.native import load
+        from seldon_core_tpu.serving.framed import FramedClient
+
+        if load() is None:
+            pytest.skip("native library unavailable")
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        held = []
+
+        def hold():
+            conn, _ = srv.accept()
+            held.append(conn)  # read nothing, answer nothing
+
+        t = threading.Thread(target=hold, daemon=True)
+        t.start()
+        c = FramedClient("127.0.0.1", port, timeout=0.15)
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError):
+            c.predict(SeldonMessage(data=np.zeros((1, 2), np.float32)))
+        assert time.perf_counter() - t0 < 5.0
+        c.close()
+        for conn in held:
+            conn.close()
+        srv.close()
+
+    def test_per_call_override(self):
+        import socket
+        import threading
+
+        from seldon_core_tpu.native import load
+        from seldon_core_tpu.serving.framed import FramedClient
+
+        if load() is None:
+            pytest.skip("native library unavailable")
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        held = []
+
+        def hold():
+            conn, _ = srv.accept()
+            held.append(conn)  # keep the connection open, never respond
+
+        threading.Thread(target=hold, daemon=True).start()
+        c = FramedClient("127.0.0.1", port, timeout=30.0)
+        with pytest.raises(TimeoutError):
+            c.predict(SeldonMessage(data=np.zeros((1, 2), np.float32)),
+                      timeout=0.1)
+        c.close()
+        for conn in held:
+            conn.close()
+        srv.close()
