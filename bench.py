@@ -456,6 +456,272 @@ def cache_smoke() -> int:
     return 1 if failures else 0
 
 
+class _SerialModel:
+    """Duck MODEL with a serialized service channel — the execution shape
+    of one accelerator: one request in service at a time, fixed service
+    time.  Unbounded arrivals therefore queue unboundedly unless
+    something sheds — exactly the failure mode the QoS subsystem exists
+    for."""
+
+    def __init__(self, service_ms: float = 2.0):
+        import numpy as np
+
+        self.name = "serial"
+        self.service_s = service_ms / 1000.0
+        self.calls = 0
+        self._lock = None  # created lazily inside the running loop
+        self._out = np.ones((1, 2), np.float32)
+
+    def has(self, method: str) -> bool:
+        return method == "predict"
+
+    async def predict(self, msg):
+        from seldon_core_tpu.messages import SeldonMessage
+
+        if self._lock is None:
+            self._lock = asyncio.Lock()
+        async with self._lock:
+            self.calls += 1
+            await asyncio.sleep(self.service_s)
+        return SeldonMessage(data=self._out, names=["a", "b"])
+
+    def queue_depth(self) -> int:
+        if self._lock is None:
+            return 0
+        waiters = getattr(self._lock, "_waiters", None)
+        return len(waiters) if waiters else 0
+
+
+def _qos_bench_engine(with_qos: bool, service_ms: float = 2.0,
+                      slo_ms: float = 50.0, seed: int = 0):
+    """(engine, model, chaos) — a chaos-wrapped serial backend behind the
+    graph engine, with or without the QoS tier.  Same seed → identical
+    burst schedules, so with/without runs see the same latency spikes."""
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.qos import EngineQos, QosConfig
+    from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+
+    model = _SerialModel(service_ms)
+    chaos = ChaosWrapper(model, ChaosPolicy(
+        burst_latency_ms=4 * service_ms, burst_duration_ms=200.0,
+        burst_period_ms=700.0, seed=seed,
+    ))
+    qos = (EngineQos(QosConfig(name="qosbench", slo_p95_ms=slo_ms))
+           if with_qos else None)
+    eng = GraphEngine({"name": "m", "type": "MODEL"},
+                      resolver=lambda u: chaos, name="qosbench", qos=qos)
+    return eng, model, chaos
+
+
+def bench_qos_overload(seconds: float = 3.0) -> dict:
+    """QoS under 2x-capacity overload (docs/qos.md): goodput and p95 of
+    admitted traffic, with vs. without the QoS tier, against the SAME
+    seeded chaos burst schedule.  Capacity = 1/service_time of the
+    serialized backend; offered = 2x that, 20% high / 80% low priority,
+    100ms deadline."""
+    from seldon_core_tpu.tools.loadtest import overload_drill
+
+    service_ms = 2.0
+    capacity = 1000.0 / service_ms
+    rate = 2.0 * capacity
+    mix = {"high": 0.2, "low": 0.8}
+
+    last_engine: list = []
+
+    async def drive(with_qos_tier: bool) -> tuple[dict, float]:
+        # engine built HERE so each run's chaos burst schedule is
+        # anchored at its own drive start — with/without see spikes at
+        # identical offsets into their windows
+        eng, _model, _chaos = _qos_bench_engine(with_qos_tier, service_ms)
+        last_engine.append(eng)
+        t0 = time.perf_counter()
+        res = await overload_drill(
+            eng.predict, _qos_payload, rate=rate, seconds=seconds,
+            priority_mix=mix, deadline_ms=100.0, seed=0,
+        )
+        # drain time past the offered window = the queue the run left
+        # behind (unbounded growth shows up here, not in the window)
+        drain_s = time.perf_counter() - t0 - seconds - 0.2
+        return res, max(drain_s, 0.0)
+
+    with_qos, drain_qos = asyncio.run(drive(True))
+    eng_qos = last_engine[0]
+    without, drain_plain = asyncio.run(drive(False))
+    hi_q = with_qos["priorities"]["high"]
+    hi_p = without["priorities"]["high"]
+    return {
+        "scenario": f"serial backend {service_ms}ms service "
+                    f"(capacity {capacity:.0f} rps), offered {rate:.0f} rps"
+                    f" (2x), bursts +{4 * service_ms:.0f}ms, deadline 100ms",
+        "with_qos": with_qos,
+        "without_qos": without,
+        "drain_s_with_qos": round(drain_qos, 2),
+        "drain_s_without_qos": round(drain_plain, 2),
+        "hi_goodput_with_qos": hi_q["goodput"],
+        "hi_goodput_without_qos": hi_p["goodput"],
+        "hi_p95_ms_with_qos": (hi_q["latency_ms"] or {}).get("p95"),
+        "shed_p95_ms": (
+            (with_qos["priorities"]["low"]["shed_latency_ms"] or {})
+            .get("p95")
+        ),
+        "admission": eng_qos.qos.admission.snapshot(),
+    }
+
+
+def _qos_payload():
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    return SeldonMessage(data=np.zeros((1, 2), np.float32))
+
+
+def qos_smoke() -> int:
+    """Fast CI gate (CPU-only, no jax needed on the hot path): under 2x
+    offered load with chaos-injected latency bursts, the QoS tier must
+    (1) sustain >= 95% high-priority goodput within the deadline,
+    (2) answer sheds with 429 in < 5ms p95,
+    (3) bound the queue (drain after the window in < 1.5s where the
+        unprotected engine's backlog takes several times that),
+    (4) serve byte-identical responses to the unthrottled path when NOT
+        overloaded (walk AND fused modes), and
+    (5) route breaker-open traffic to the seldon.io/qos-fallback
+        subgraph with meta.tags.degraded set.
+    Returns a process exit code."""
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    failures: list[str] = []
+    report: dict = {}
+
+    # -- (1)(2)(3): overload drill ------------------------------------
+    res = bench_qos_overload(seconds=2.0)
+    report["overload"] = {
+        "hi_goodput_with_qos": res["hi_goodput_with_qos"],
+        "hi_goodput_without_qos": res["hi_goodput_without_qos"],
+        "shed_p95_ms": res["shed_p95_ms"],
+        "drain_s_with_qos": res["drain_s_with_qos"],
+        "drain_s_without_qos": res["drain_s_without_qos"],
+        "limit": res["admission"]["limit"],
+    }
+    if (res["hi_goodput_with_qos"] or 0) < 0.95:
+        failures.append(
+            f"high-priority goodput {res['hi_goodput_with_qos']} < 0.95 "
+            "at 2x capacity with QoS on"
+        )
+    shed_p95 = res["shed_p95_ms"]
+    if shed_p95 is None:
+        failures.append("no low-priority sheds at 2x capacity — admission "
+                        "control is not engaging")
+    elif shed_p95 >= 5.0:
+        failures.append(f"shed answer p95 {shed_p95}ms >= 5ms — the 'no' "
+                        "must be fast")
+    if res["drain_s_with_qos"] > 1.5:
+        failures.append(
+            f"queue drain took {res['drain_s_with_qos']}s with QoS on — "
+            "queue growth is not bounded"
+        )
+
+    # -- (4): byte parity off-overload, walk AND fused ----------------
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.operator.local import resolve_component
+    from seldon_core_tpu.qos import EngineQos, QosConfig
+
+    spec = {
+        "name": "m", "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+        ],
+    }
+    ann = {"seldon.io/batching": "false"}
+    x = np.zeros((1, 784), np.float32)
+    for plan in ("walk", "fused"):
+        plain = GraphEngine(spec, resolver=lambda u: resolve_component(u, ann),
+                            name="p", plan_mode=plan)
+        qos_eng = GraphEngine(
+            spec, resolver=lambda u: resolve_component(u, ann), name="p",
+            plan_mode=plan,
+            qos=EngineQos(QosConfig(name="p", slo_p95_ms=1000.0)),
+        )
+        msg = SeldonMessage.from_ndarray(x)
+        msg.meta.puid = "qos-smoke"
+        ref = asyncio.run(plain.predict(msg))
+        msg2 = SeldonMessage.from_ndarray(x)
+        msg2.meta.puid = "qos-smoke"
+        out = asyncio.run(qos_eng.predict(msg2))
+        if ref.to_dict() != out.to_dict():
+            failures.append(f"admitted response NOT byte-identical to the "
+                            f"unthrottled path in {plan} mode")
+    report["parity_modes"] = ["walk", "fused"]
+
+    # -- (5): breaker-open traffic routes to the fallback -------------
+    from seldon_core_tpu.qos import BreakerWrapper
+    from seldon_core_tpu.qos.breaker import BreakerConfig
+
+    fb_spec = {
+        "name": "big", "type": "MODEL",
+        "endpoint": {"service_host": "127.0.0.1", "service_port": 1,
+                     "type": "REST"},
+        "children": [{
+            "name": "cheap", "type": "MODEL",
+            "parameters": [
+                {"name": "model_class",
+                 "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+                 "type": "STRING"},
+                {"name": "hidden", "value": "8", "type": "INT"},
+            ],
+        }],
+    }
+    qos = EngineQos(QosConfig(
+        name="fb", fallback_node="cheap",
+        breaker=BreakerConfig(min_calls=2, error_threshold=0.5,
+                              open_s=30.0),
+    ))
+
+    def _resolve(u):
+        if u.name == "big":
+            return BreakerWrapper(resolve_component(u, ann),
+                                  qos.make_breaker(u.name), name=u.name)
+        return resolve_component(u, ann)
+
+    eng = GraphEngine(fb_spec, resolver=_resolve, name="fb", qos=qos)
+
+    async def trip_and_degrade():
+        # the unreachable remote fails fast → breaker opens → next
+        # request must route to the fallback subtree, degraded-stamped
+        try:
+            for _ in range(4):
+                await eng.predict(SeldonMessage.from_ndarray(x))
+            return await eng.predict(SeldonMessage.from_ndarray(x))
+        finally:
+            await eng.node_impl("big").inner.close()
+
+    out = asyncio.run(trip_and_degrade())
+    report["breaker"] = qos.breakers[0].snapshot()
+    report["degraded_tags"] = dict(out.meta.tags)
+    if qos.breakers[0].state != "open":
+        failures.append(
+            f"breaker did not open after repeated transport failures "
+            f"(state {qos.breakers[0].state})"
+        )
+    if out.meta.tags.get("degraded") != "breaker_open":
+        failures.append(
+            f"breaker-open traffic did not degrade to the fallback "
+            f"(tags {out.meta.tags})"
+        )
+    elif list(out.meta.request_path) != ["cheap"]:
+        failures.append(
+            f"degraded request walked {list(out.meta.request_path)}, "
+            "expected only the fallback subtree ['cheap']"
+        )
+
+    print(json.dumps({"qos_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 RESNET50_GFLOPS = 8.2  # fwd FLOPs per 224x224 image: 4.1 GMACs x 2 FLOPs/MAC
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak, TPU v5e
 
@@ -1733,6 +1999,14 @@ def main() -> None:
                          "single-flight dedupe (100 concurrent identical "
                          "requests -> 1 model invocation, hit p50 >=5x "
                          "faster than cold), then exit")
+    ap.add_argument("--qos-smoke", action="store_true",
+                    help="fast CI gate: at 2x offered load with chaos "
+                         "bursts, high-priority goodput >= 95%%, sheds "
+                         "answer 429 in < 5ms p95, queue growth bounded, "
+                         "admitted responses byte-identical to the "
+                         "unthrottled path (walk+fused), breaker-open "
+                         "traffic degrades to the qos-fallback subgraph; "
+                         "then exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -1740,6 +2014,8 @@ def main() -> None:
         sys.exit(plan_smoke())
     if args.cache_smoke:
         sys.exit(cache_smoke())
+    if args.qos_smoke:
+        sys.exit(qos_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice
@@ -1763,6 +2039,10 @@ def main() -> None:
         )
     except Exception as e:
         extras["prediction_cache_error"] = f"{type(e).__name__}: {e}"
+    try:
+        extras["qos_overload"] = bench_qos_overload(min(args.seconds, 3.0))
+    except Exception as e:
+        extras["qos_overload_error"] = f"{type(e).__name__}: {e}"
     # headline wire tier: native servers + Python engine + native loadgen
     try:
         rest = bench_rest_socket_native(args.seconds)
@@ -1912,6 +2192,12 @@ def main() -> None:
     _pick(extras, ["prediction_cache", "hit_speedup"], "cache_speedup", 2)
     _pick(extras, ["prediction_cache", "hit_rate"], "cache_hit_rate", 3)
     _pick(extras, ["prediction_cache", "coalesced"], "cache_coalesced", 0)
+    _pick(extras, ["qos_overload", "hi_goodput_with_qos"],
+          "qos_hi_goodput", 3)
+    _pick(extras, ["qos_overload", "hi_goodput_without_qos"],
+          "qos_hi_goodput_off", 3)
+    _pick(extras, ["qos_overload", "hi_p95_ms_with_qos"], "qos_hi_p95_ms", 1)
+    _pick(extras, ["qos_overload", "shed_p95_ms"], "qos_shed_p95_ms", 2)
     _pick(extras, ["resnet50", "mfu_pct"], "resnet_mfu_pct")
     _pick(extras, ["resnet50", "img_per_s"], "resnet_img_per_s")
     _pick(extras, ["llm_decode", "bf16_tokens_per_s"], "llm_tok_per_s")

@@ -14,6 +14,10 @@ greps, and operator status all key on it), a severity, the unit path or
   cacheability: RNG routers, stateful components, and
   per-request-meta-dependent nodes are uncacheable; forcing them cached
   is an error)
+- ``GL8xx`` — QoS admission (``seldon.io/slo-p95-ms`` /
+  ``seldon.io/qos-*`` annotation validation, fallback-subgraph
+  resolution and robustness against the signature registry, SLO
+  feasibility vs per-node budgets)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -55,6 +59,12 @@ CACHE_FORCED_UNCACHEABLE = "GL702"  # node forced `cacheable` but unsafe
 CACHE_SUBTREE_CACHEABLE = "GL703"   # cache report: subtree serves from cache
 CACHE_NODE_UNCACHEABLE = "GL704"    # cache report: node always bypasses
 CACHE_NOTHING_CACHEABLE = "GL705"   # cache enabled but nothing cacheable
+QOS_ANNOTATION_INVALID = "GL801"    # seldon.io/slo-p95-ms / qos-* value invalid
+QOS_FALLBACK_UNKNOWN = "GL802"      # qos-fallback names a node not in the graph
+QOS_FALLBACK_IS_ROOT = "GL803"      # qos-fallback names the graph root
+QOS_FALLBACK_REPORT = "GL804"       # qos report: the fallback subtree
+QOS_FALLBACK_FRAGILE = "GL805"      # fallback subtree itself remote/unproven
+QOS_SLO_INFEASIBLE = "GL806"        # node budgets cannot fit the p95 SLO
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -88,6 +98,12 @@ CODE_SEVERITY = {
     CACHE_SUBTREE_CACHEABLE: INFO,
     CACHE_NODE_UNCACHEABLE: INFO,
     CACHE_NOTHING_CACHEABLE: WARN,
+    QOS_ANNOTATION_INVALID: ERROR,
+    QOS_FALLBACK_UNKNOWN: ERROR,
+    QOS_FALLBACK_IS_ROOT: ERROR,
+    QOS_FALLBACK_REPORT: INFO,
+    QOS_FALLBACK_FRAGILE: WARN,
+    QOS_SLO_INFEASIBLE: WARN,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

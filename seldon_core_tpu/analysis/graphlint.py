@@ -47,6 +47,12 @@ from seldon_core_tpu.analysis.findings import (
     PLAN_NODE_BOUNDARY,
     PLAN_NOTHING_FUSED,
     PLAN_SEGMENT_FUSED,
+    QOS_ANNOTATION_INVALID,
+    QOS_FALLBACK_FRAGILE,
+    QOS_FALLBACK_IS_ROOT,
+    QOS_FALLBACK_REPORT,
+    QOS_FALLBACK_UNKNOWN,
+    QOS_SLO_INFEASIBLE,
     ROUTER_BRANCH_MISMATCH,
     ROUTER_NO_CHILDREN,
     SHAPE_MISMATCH,
@@ -156,6 +162,7 @@ def lint_graph(
         findings.extend(_hbm_pass(unit, ann, path_prefix))
         findings.extend(_plan_pass(unit, ann, path_prefix))
         findings.extend(_cache_pass(unit, ann, path_prefix))
+        findings.extend(_qos_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -731,6 +738,109 @@ def _cache_pass(root: PredictiveUnit, ann: dict,
             f"{CACHE_ANNOTATION} enabled but no subtree is cacheable — "
             "only the gateway tier (raw-body dedup) will cache",
         ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# QoS pass (GL8xx)
+# ---------------------------------------------------------------------------
+
+SLO_ANNOTATION = "seldon.io/slo-p95-ms"
+QOS_FALLBACK_ANNOTATION = "seldon.io/qos-fallback"
+
+
+def _fallback_fragility(u: PredictiveUnit) -> Optional[str]:
+    """Why this fallback-subtree node may not survive the overload that
+    triggered degraded mode — checked against the signature registry,
+    like the plan/cache passes.  A fallback that is itself remote, or
+    whose latency/purity the registry cannot vouch for, is a WARN: the
+    degraded path exists precisely for when the expensive path is sick,
+    so it should be provably local and cheap."""
+    if u.endpoint.service_host and u.endpoint.type != "LOCAL":
+        return ("remote endpoint: the fallback would depend on another "
+                "pod exactly when the system is degraded")
+    mc = u.parameters.get("model_class")
+    if isinstance(mc, str) and mc:
+        sig = signature_for(mc)
+        if sig is None:
+            return (f"model_class {mc!r} has no registered signature; the "
+                    "fallback's cost cannot be proven cheap")
+    return None
+
+
+def _qos_pass(root: PredictiveUnit, ann: dict,
+              prefix: str) -> list[Finding]:
+    """QoS admission (GL8xx, active when any ``seldon.io/slo-p95-ms`` /
+    ``seldon.io/qos-*`` annotation is set): validates annotation values
+    (GL801), resolves the ``seldon.io/qos-fallback`` subgraph (GL802
+    unknown node / GL803 root are ERRORs — a deployment whose degraded
+    mode can never engage must reject at admission, not discover it
+    during its first overload), reports the fallback subtree (GL804),
+    warns when that subtree is itself fragile under overload per the
+    signature registry (GL805), and warns when per-node ``timeout_ms``
+    budgets already exceed the p95 SLO target (GL806 — the limit
+    controller would shed forever chasing an unreachable target)."""
+    from seldon_core_tpu.qos import qos_from_annotations
+
+    qos_keys = [k for k in ann
+                if k == SLO_ANNOTATION or k.startswith("seldon.io/qos-")]
+    if not qos_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = qos_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(QOS_ANNOTATION_INVALID, path0, str(e))]
+    if cfg is None:
+        return []
+    findings: list[Finding] = []
+    nodes = {u.name: u for u in root.walk()}
+    if cfg.fallback_node:
+        target = nodes.get(cfg.fallback_node)
+        if target is None:
+            findings.append(make_finding(
+                QOS_FALLBACK_UNKNOWN, path0,
+                f"{QOS_FALLBACK_ANNOTATION}={cfg.fallback_node!r} names a "
+                f"node that is not in the graph (nodes: "
+                f"{sorted(nodes)})",
+            ))
+        elif target is root:
+            findings.append(make_finding(
+                QOS_FALLBACK_IS_ROOT, path0,
+                f"{QOS_FALLBACK_ANNOTATION}={cfg.fallback_node!r} names "
+                "the graph root: falling back to the primary is not a "
+                "degraded mode",
+            ))
+        else:
+            sub = [n.name for n in target.walk()]
+            findings.append(make_finding(
+                QOS_FALLBACK_REPORT, path0,
+                f"degraded mode serves the {len(sub)}-node subtree "
+                f"{' -> '.join(sub)} when a breaker opens or shed level "
+                f">= {cfg.degrade_shed_level}",
+            ))
+            for n in target.walk():
+                reason = _fallback_fragility(n)
+                if reason is not None:
+                    findings.append(make_finding(
+                        QOS_FALLBACK_FRAGILE,
+                        _join(path0, n.name),
+                        f"fallback subtree node {n.name!r}: {reason}",
+                    ))
+    if cfg.slo_p95_ms:
+        def critical(u: PredictiveUnit) -> float:
+            own = _num(u.parameters.get("timeout_ms")) or 0.0
+            return own + max((critical(c) for c in u.children), default=0.0)
+
+        worst = critical(root)
+        if worst > cfg.slo_p95_ms:
+            findings.append(make_finding(
+                QOS_SLO_INFEASIBLE, path0,
+                f"per-node timeout_ms budgets allow a {worst:g}ms critical "
+                f"path but {SLO_ANNOTATION} targets {cfg.slo_p95_ms:g}ms — "
+                "the admission controller would shed towards an "
+                "unreachable p95",
+            ))
     return findings
 
 

@@ -29,6 +29,14 @@ from seldon_core_tpu.caching import (
 from seldon_core_tpu.gateway.firehose import NullFirehose, make_firehose
 from seldon_core_tpu.gateway.oauth import OAuthProvider, default_token_store
 from seldon_core_tpu.gateway.store import DeploymentStore
+from seldon_core_tpu.qos import (
+    AdmissionController,
+    QosContext,
+    qos_from_annotations,
+    qos_from_headers,
+)
+from seldon_core_tpu.qos.admission import AdmissionConfig
+from seldon_core_tpu.qos.context import forward_headers
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
@@ -66,6 +74,12 @@ class Gateway:
         # Concurrent identical bodies coalesce onto one engine forward.
         self._caches: dict[str, Optional[PredictionCache]] = {}
         self._flight = SingleFlight()
+        # per-deployment QoS admission (docs/qos.md): adaptive AIMD
+        # concurrency limit against the seldon.io/slo-p95-ms annotation,
+        # priority classes from X-Seldon-Priority — low sheds first, 429 +
+        # Retry-After, in microseconds (the shed path never queues).
+        # Keyed like _caches; rebuilt when the annotation changes.
+        self._admission: dict[str, tuple[float, Optional[AdmissionController]]] = {}
 
     # ------------------------------------------------------------------
     # shared forwarding client (pooled, apife parity: 150 conns)
@@ -137,12 +151,23 @@ class Gateway:
             )
         body = await request.read()
         content_type = request.headers.get("Content-Type", "application/json")
+        # QoS (docs/qos.md): priority + deadline ride in from the client's
+        # X-Seldon-Priority / X-Seldon-Deadline-Ms headers and out to the
+        # engine hop (remaining budget restamped at send).
+        qctx = qos_from_headers(request.headers)
+        admission = (
+            self._dep_admission(rec) if path.endswith("/predictions")
+            else None
+        )
         # Prediction cache (annotation seldon.io/prediction-cache on the
         # deployment record): a byte-identical repeat of a /predictions
         # body never re-traverses gateway→engine→model; concurrent
         # identical bodies coalesce onto ONE in-flight engine forward.
         # The response advertises what happened in X-Seldon-Cache.
         # Feedback is stateful (MAB rewards) and never cached.
+        # Cache hits and coalesced followers never consume an admission
+        # slot — they cost no engine work, so refusing (or charging) them
+        # under overload would throw away the cheapest capacity there is.
         cache_state: Optional[str] = None
         cache = (
             self._dep_cache(rec) if path.endswith("/predictions") else None
@@ -156,8 +181,8 @@ class Gateway:
             else:
 
                 async def compute():
-                    st, bd = await self._forward_engine(
-                        rec, path, body, content_type
+                    st, bd = await self._admitted_forward(
+                        rec, path, body, content_type, qctx, admission
                     )
                     if st == 200:
                         cache.put(key, (st, bd), len(bd) + len(key))
@@ -172,8 +197,8 @@ class Gateway:
                 else:
                     cache_state = "miss"
         else:
-            out_status, out_body = await self._forward_engine(
-                rec, path, body, content_type
+            out_status, out_body = await self._admitted_forward(
+                rec, path, body, content_type, qctx, admission
             )
         if path.endswith("/predictions") and not isinstance(
             self.firehose, NullFirehose
@@ -198,36 +223,121 @@ class Gateway:
             time.perf_counter() - t0,
             {"deployment": rec.name, "path": path},
         )
-        headers = {"X-Seldon-Cache": cache_state} if cache_state else None
+        headers: dict[str, str] = {}
+        if cache_state:
+            headers["X-Seldon-Cache"] = cache_state
+        if out_status == 429:
+            # every 429 leaving the gateway carries a Retry-After —
+            # admission sheds (ours) and engine queue-full sheds alike
+            retry_s = (
+                admission.retry_after_s() if admission is not None else 1.0
+            )
+            headers["Retry-After"] = str(max(1, round(retry_s)))
         return web.Response(
             body=out_body, status=out_status, content_type="application/json",
-            headers=headers,
+            headers=headers or None,
         )
 
+    async def _admitted_forward(
+        self,
+        rec,
+        path: str,
+        body: bytes,
+        content_type: str,
+        qctx: Optional[QosContext] = None,
+        admission: Optional[AdmissionController] = None,
+    ) -> tuple[int, bytes]:
+        """Admission gate around one engine forward.
+
+        A refused request answers 429 ADMISSION_SHED immediately — the
+        whole point of shedding at the gateway is that the "no" costs
+        microseconds and zero engine work.  Admitted requests release
+        their slot with the observed latency, feeding the AIMD limit."""
+        if admission is None:
+            return await self._forward_engine(rec, path, body, content_type,
+                                              qctx)
+        priority = qctx.priority if qctx is not None else "normal"
+        if not admission.try_acquire(priority):
+            return 429, json.dumps(
+                {"status": {
+                    "code": 429, "status": "FAILURE",
+                    "reason": "ADMISSION_SHED",
+                    "info": f"shed at gateway admission (priority "
+                            f"{priority}, concurrency limit "
+                            f"{admission.limit}); retry after "
+                            f"{admission.retry_after_s():.1f}s"}}
+            ).encode()
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            st, bd = await self._forward_engine(rec, path, body,
+                                                content_type, qctx)
+            ok = st == 200
+            return st, bd
+        finally:
+            admission.release(time.perf_counter() - t0, ok)
+
     async def _forward_engine(
-        self, rec, path: str, body: bytes, content_type: str
+        self, rec, path: str, body: bytes, content_type: str,
+        qctx: Optional[QosContext] = None,
     ) -> tuple[int, bytes]:
         """One engine forward with connection-failure retries (reference
         apife HttpRetryHandler.java: 3 attempts).  POST predict is safe to
         retry ONLY when the request never reached the engine — connection
         errors qualify; once a response (any status) arrives it passes
         through.  Persistent unreachability becomes the 503 FAILURE body
-        (never cached: the caller only stores 200s)."""
+        (never cached: the caller only stores 200s).
+
+        Retries live inside the request's deadline budget: each attempt's
+        timeout is the REMAINING budget (not a fixed per-attempt window),
+        and when backoff + a further attempt cannot fit, the retry is
+        skipped and the 504 answers immediately — three 30s attempts
+        against a 100ms deadline helped nobody."""
         sess = await self.session()
+        deadline = qctx.deadline if qctx is not None else None
         last_err: Optional[Exception] = None
         out_body, out_status = b"", 0
         for attempt in range(self.retries + 1):
             if attempt:
-                await asyncio.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+                backoff = self.retry_backoff_s * (2 ** (attempt - 1))
+                if (deadline is not None
+                        and deadline.remaining_s() <= backoff):
+                    # budget exhausted: the retry could never answer in
+                    # time — stop burning engine capacity on it
+                    return 504, json.dumps(
+                        {"status": {
+                            "code": 504, "status": "FAILURE",
+                            "reason": "DEADLINE_EXCEEDED",
+                            "info": "deadline budget exhausted before "
+                                    f"retry {attempt} (engine error: "
+                                    f"{last_err})"}}
+                    ).encode()
+                await asyncio.sleep(backoff)
                 self.registry.counter_inc(
                     "seldon_api_gateway_retries_total",
                     {"deployment": rec.name, "path": path},
                 )
+            hop_headers = {"Content-Type": content_type}
+            kwargs = {}
+            if qctx is not None:
+                hop_headers.update(forward_headers(qctx))
+            if deadline is not None:
+                rem = deadline.remaining_s()
+                if rem <= 0:
+                    return 504, json.dumps(
+                        {"status": {
+                            "code": 504, "status": "FAILURE",
+                            "reason": "DEADLINE_EXCEEDED",
+                            "info": "deadline budget exhausted at the "
+                                    "gateway"}}
+                    ).encode()
+                kwargs["timeout"] = aiohttp.ClientTimeout(total=rem)
             try:
                 async with sess.post(
                     rec.engine_url.rstrip("/") + path,
                     data=body,
-                    headers={"Content-Type": content_type},
+                    headers=hop_headers,
+                    **kwargs,
                 ) as resp:
                     out_body = await resp.read()
                     out_status = resp.status
@@ -237,6 +347,16 @@ class Gateway:
                 # connection never established — the request cannot have
                 # reached the engine, so replaying it is safe
                 last_err = e
+            except asyncio.TimeoutError:
+                # the deadline budget expired mid-forward: the engine may
+                # still be computing, but the answer is already worthless
+                return 504, json.dumps(
+                    {"status": {
+                        "code": 504, "status": "FAILURE",
+                        "reason": "DEADLINE_EXCEEDED",
+                        "info": "deadline budget exhausted while "
+                                "forwarding to the engine"}}
+                ).encode()
             except aiohttp.ClientError as e:
                 # includes ServerDisconnectedError: the engine may have
                 # executed the (non-idempotent) request before dying — a
@@ -249,6 +369,33 @@ class Gateway:
                             "info": f"engine unreachable: {last_err}"}}
             ).encode()
         return out_status, out_body
+
+    def _dep_admission(self, rec) -> Optional[AdmissionController]:
+        """The deployment's gateway-tier admission controller, built (and
+        rebuilt on annotation change) from ``seldon.io/slo-p95-ms``.
+        Invalid values log once and leave admission off — the gateway must
+        keep serving; admission rejects them upstream."""
+        try:
+            cfg = qos_from_annotations(rec.annotations, rec.name)
+        except ValueError as e:
+            if rec.name not in self._admission or \
+                    self._admission[rec.name][1] is not None:
+                logger.warning("deployment %s: %s — admission disabled",
+                               rec.name, e)
+            self._admission[rec.name] = (0.0, None)
+            return None
+        if cfg is None or not cfg.admission_enabled:
+            self._admission.pop(rec.name, None)
+            return None
+        cur = self._admission.get(rec.name)
+        if cur is not None and cur[0] == cfg.slo_p95_ms:
+            return cur[1]
+        ctl = AdmissionController(
+            AdmissionConfig(target_p95_ms=cfg.slo_p95_ms),
+            name=rec.name, metrics=self.registry,
+        )
+        self._admission[rec.name] = (cfg.slo_p95_ms, ctl)
+        return ctl
 
     def _dep_cache(self, rec) -> Optional[PredictionCache]:
         """The deployment's gateway-tier cache, built (and rebuilt on

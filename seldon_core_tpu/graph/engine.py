@@ -79,6 +79,7 @@ class GraphEngine:
         plan_batcher: Optional[Any] = None,
         cache: Optional[Any] = None,
         cache_version: str = "",
+        qos: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -147,6 +148,30 @@ class GraphEngine:
                 self._cache_roots = {
                     id(n) for n in maximal_cacheable_roots(self.root)
                 }
+        # QoS (qos/policy.py EngineQos, docs/qos.md): admission control
+        # against the seldon.io/slo-p95-ms target, deadline enforcement,
+        # and degraded-mode routing — when the fallback subgraph's breaker
+        # or shed-level trigger fires, requests walk the
+        # seldon.io/qos-fallback subtree instead of the primary root and
+        # carry meta.tags.degraded.  The fallback is resolved against the
+        # INTERPRETED node tree (always intact beneath a fused plan).
+        self.qos = qos
+        self._fallback_node: Optional[_Node] = None
+        if qos is not None and qos.config.fallback_node:
+            node = self._nodes.get(qos.config.fallback_node)
+            if node is None:
+                raise ValueError(
+                    f"qos fallback node {qos.config.fallback_node!r} not in "
+                    f"graph {name!r} (admission should have rejected this "
+                    "spec — GL802)"
+                )
+            if node is self.root:
+                raise ValueError(
+                    f"qos fallback node {qos.config.fallback_node!r} is the "
+                    f"graph root of {name!r}: falling back to the primary "
+                    "is not a degraded mode (GL803)"
+                )
+            self._fallback_node = node
 
     def _build(self, unit: PredictiveUnit) -> _Node:
         impl: NodeImpl
@@ -179,33 +204,106 @@ class GraphEngine:
     async def predict(self, request: SeldonMessage) -> SeldonMessage:
         """Entry point (reference ``PredictionService.predict``
         ``engine/.../service/PredictionService.java:69-88``): assign puid,
+        enforce QoS (admission / deadline budget / degraded routing),
         walk the graph, stamp merged meta onto the response."""
+        from seldon_core_tpu.qos.context import (
+            current_qos,
+            qos_from_meta,
+            qos_scope,
+            stamp_meta,
+        )
+
         meta = request.meta.copy()
         if not meta.puid:
             meta.puid = new_puid()
+        # QoS context: the wire channel (meta tags, stamped by the
+        # gateway/REST layer) wins; in-process callers inherit the ambient
+        # contextvar.  Restamped onto the request so remote hops see the
+        # remaining budget (the response meta was copied above, so a
+        # client that sent no QoS tags gets none back).
+        qctx = qos_from_meta(request.meta) or current_qos()
+        if qctx is not None:
+            stamp_meta(request.meta, qctx)
+            if qctx.deadline is not None and qctx.deadline.expired:
+                return SeldonMessage(
+                    status=Status.failure(
+                        504,
+                        "deadline budget exhausted before the graph walk "
+                        "started",
+                        "DEADLINE_EXCEEDED",
+                    ),
+                    meta=meta,
+                )
+        admission = self.qos.admission if self.qos is not None else None
+        if admission is not None:
+            pri = qctx.priority if qctx is not None else "normal"
+            if not admission.try_acquire(pri):
+                return SeldonMessage(
+                    status=Status.failure(
+                        429,
+                        f"shed at admission (priority {pri}, "
+                        f"concurrency limit {admission.limit}); retry "
+                        f"after {admission.retry_after_s():.1f}s",
+                        "ADMISSION_SHED",
+                    ),
+                    meta=meta,
+                )
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            with qos_scope(qctx):
+                out = await self._predict_qos(request, meta, qctx)
+            ok = out.status is None or out.status.status == "SUCCESS"
+        finally:
+            if admission is not None:
+                admission.release(time.perf_counter() - t0, ok)
+        return out
+
+    async def _predict_qos(
+        self, request: SeldonMessage, meta: Meta, qctx: Optional[Any]
+    ) -> SeldonMessage:
+        """The walk under an already-admitted request's QoS scope."""
+        from seldon_core_tpu.qos.context import DEGRADED_TAG
+
+        # effective walk deadline: the tighter of the static annotation
+        # and the request's remaining propagated budget
+        timeout_s = self.walk_timeout_s or None
+        if qctx is not None and qctx.deadline is not None:
+            rem = qctx.deadline.remaining_s()
+            timeout_s = rem if timeout_s is None else min(timeout_s, rem)
+        degrade = (
+            self.qos.should_degrade()
+            if self.qos is not None and self._fallback_node is not None
+            else None
+        )
         try:
             with self.tracer.trace(meta.puid, graph=self.name):
-                if self.plan is not None:
+                if degrade is not None:
+                    # degraded-mode serving: the primary subgraph is sick
+                    # (breaker open) or shedding past the configured level
+                    # — serve the cheap fallback subtree and say so
+                    meta.tags[DEGRADED_TAG] = degrade
+                    reg = getattr(self.metrics, "registry", None)
+                    if reg is not None:
+                        reg.counter_inc(
+                            "seldon_qos_degraded_total",
+                            {"graph": self.name, "reason": degrade},
+                        )
+                    coro = self._walk(self._fallback_node, request, meta)
+                elif self.plan is not None:
                     coro = self._plan_walk(self.plan.root, request, meta)
                 else:
                     coro = self._walk(self.root, request, meta)
-                if self.walk_timeout_s:
-                    # asyncio.timeout + expired(): only the WALK deadline
-                    # maps to the 504 below — a TimeoutError leaking out
-                    # of a component is that component's bug and takes
-                    # the generic 500 path like any other exception
-                    cm = asyncio.timeout(self.walk_timeout_s)
-                    try:
-                        async with cm:
-                            out = await coro
-                    except TimeoutError:
-                        if not cm.expired():
-                            raise
+                if timeout_s is not None:
+                    out, timed_out = await self._await_with_deadline(
+                        coro, timeout_s
+                    )
+                    if timed_out:
                         return SeldonMessage(
                             status=Status.failure(
                                 504,
-                                f"graph walk exceeded "
-                                f"{self.walk_timeout_s}s deadline",
+                                f"graph walk exceeded {timeout_s:.3f}s "
+                                "deadline",
                                 "DEADLINE_EXCEEDED",
                             ),
                             meta=meta,
@@ -238,6 +336,35 @@ class GraphEngine:
         if out.status is None:
             out.status = Status()
         return out
+
+    @staticmethod
+    async def _await_with_deadline(coro, timeout_s: float) -> tuple:
+        """``(result, timed_out)`` — run the walk under a deadline.
+
+        Only the WALK deadline maps to ``timed_out=True`` — a
+        TimeoutError leaking out of a component is that component's bug
+        and takes the generic 500 path like any other exception.  On
+        Python 3.11+ ``asyncio.timeout``'s ``expired()`` makes that
+        distinction exactly; the 3.10 fallback uses ``wait_for`` and the
+        wall clock (a component TimeoutError *after* the budget elapsed
+        is indistinguishable there, and classifying it as the deadline is
+        the honest answer anyway)."""
+        if hasattr(asyncio, "timeout"):  # py3.11+
+            cm = asyncio.timeout(timeout_s)
+            try:
+                async with cm:
+                    return await coro, False
+            except TimeoutError:
+                if not cm.expired():
+                    raise
+                return None, True
+        t0 = time.perf_counter()
+        try:
+            return await asyncio.wait_for(coro, timeout_s), False
+        except asyncio.TimeoutError:
+            if time.perf_counter() - t0 < timeout_s:
+                raise
+            return None, True
 
     async def _walk(self, node: _Node, msg: SeldonMessage, meta: Meta) -> SeldonMessage:
         """Walk dispatcher: maximal cacheable subtree roots take the

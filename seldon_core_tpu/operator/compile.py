@@ -287,6 +287,38 @@ def prediction_cache_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def qos_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/slo-p95-ms`` / ``seldon.io/qos-*`` annotations → a
+    validated :class:`~seldon_core_tpu.qos.QosConfig` (or None when the
+    subsystem is off).  Invalid values — and a ``seldon.io/qos-fallback``
+    naming a node that is not in the graph, or the root — reject at
+    admission; graphlint's GL8xx pass reports the same defects, this is
+    the hard stop for callers that skip linting."""
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+    from seldon_core_tpu.qos import qos_from_annotations
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        cfg = qos_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+    if cfg is not None and cfg.fallback_node:
+        names = [u.name for u in p.graph.walk()]
+        if cfg.fallback_node not in names:
+            raise DeploymentValidationError(
+                f"annotation seldon.io/qos-fallback names node "
+                f"{cfg.fallback_node!r} which is not in predictor "
+                f"{p.name!r}'s graph (nodes: {names})"
+            )
+        if cfg.fallback_node == names[0]:
+            raise DeploymentValidationError(
+                f"annotation seldon.io/qos-fallback names the graph root "
+                f"{cfg.fallback_node!r}: falling back to the primary is "
+                "not a degraded mode"
+            )
+    return cfg
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

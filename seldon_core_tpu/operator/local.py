@@ -38,6 +38,7 @@ def resolve_component(
     unit: PredictiveUnit,
     annotations: Optional[dict] = None,
     metrics: Optional[MetricsRegistry] = None,
+    qos=None,  # qos.policy.EngineQos: breakers around remote clients
 ):
     """Instantiate one graph node's implementation.
 
@@ -45,7 +46,11 @@ def resolve_component(
     1. ``model_class`` parameter ``pkg.module:Class`` → import + construct
        with the node's remaining parameters (the in-process analog of the
        reference's s2i `MODEL_NAME` boot, ``microservice.py:209-216``).
-    2. remote endpoint → pooled RemoteComponent client.
+    2. remote endpoint → pooled RemoteComponent client, circuit-broken
+       when the QoS subsystem is on (docs/qos.md: rolling error/latency
+       windows + half-open probing replace blind retries; an open breaker
+       answers 503 CIRCUIT_OPEN in-process and can trigger the
+       ``seldon.io/qos-fallback`` subgraph).
     """
     ann = annotations or {}
     model_class = unit.parameters.get("model_class")
@@ -71,23 +76,30 @@ def resolve_component(
         if unit.endpoint.type == "GRPC":
             from seldon_core_tpu.serving.grpc_api import GrpcComponentClient
 
-            return GrpcComponentClient(
+            client = GrpcComponentClient(
                 f"{unit.endpoint.service_host}:{unit.endpoint.service_port or 5000}",
                 methods=unit.methods,
                 timeout_s=_timeout_s(ann, "seldon.io/grpc-read-timeout", 30.0),
             )
-        from seldon_core_tpu.serving.client import RemoteComponent
+        else:
+            from seldon_core_tpu.serving.client import RemoteComponent
 
-        scheme_port = unit.endpoint.service_port or 8000
-        return RemoteComponent(
-            f"http://{unit.endpoint.service_host}:{scheme_port}",
-            name=unit.name,
-            methods=unit.methods,
-            timeout_s=_timeout_s(ann, "seldon.io/rest-read-timeout", 30.0),
-            connect_timeout_s=_timeout_s(
-                ann, "seldon.io/rest-connection-timeout", None
-            ),
-        )
+            scheme_port = unit.endpoint.service_port or 8000
+            client = RemoteComponent(
+                f"http://{unit.endpoint.service_host}:{scheme_port}",
+                name=unit.name,
+                methods=unit.methods,
+                timeout_s=_timeout_s(ann, "seldon.io/rest-read-timeout", 30.0),
+                connect_timeout_s=_timeout_s(
+                    ann, "seldon.io/rest-connection-timeout", None
+                ),
+            )
+        if qos is not None and qos.config.breakers_enabled:
+            from seldon_core_tpu.qos import BreakerWrapper
+
+            return BreakerWrapper(client, qos.make_breaker(unit.name),
+                                  name=unit.name)
+        return client
     raise ValueError(
         f"node {unit.name!r}: no implementation, model_class, or endpoint"
     )
@@ -138,6 +150,7 @@ class LocalPredictor:
         from seldon_core_tpu.operator.compile import (
             graph_plan_mode,
             prediction_cache_config,
+            qos_config,
         )
 
         plan_mode = graph_plan_mode(dep, pred)
@@ -160,9 +173,20 @@ class LocalPredictor:
             self.cache = PredictionCache(
                 cache_cfg, metrics=self.metrics.registry
             )
+        # QoS tier (docs/qos.md): admission control against the
+        # seldon.io/slo-p95-ms SLO, circuit breakers around remote graph
+        # nodes, and the seldon.io/qos-fallback degraded-mode subgraph
+        qos_cfg = qos_config(dep, pred)
+        self.qos = None
+        if qos_cfg is not None:
+            from seldon_core_tpu.qos import EngineQos
+
+            self.qos = EngineQos(qos_cfg, metrics=self.metrics.registry)
         self.engine = GraphEngine(
             pred.graph,
-            resolver=lambda u: resolve_component(u, ann, self.metrics.registry),
+            resolver=lambda u: resolve_component(
+                u, ann, self.metrics.registry, qos=self.qos
+            ),
             name=pred.name,
             metrics_sink=self.metrics,
             tracer=_tracer_from_config(ann),
@@ -173,6 +197,7 @@ class LocalPredictor:
             plan_batcher=plan_batcher,
             cache=self.cache,
             cache_version=str(ann.get("seldon.io/spec-hash", "")),
+            qos=self.qos,
         )
         if (self.engine.plan is not None
                 and ann.get("seldon.io/graph-plan-warmup", "").lower()
@@ -203,6 +228,21 @@ class LocalDeployment:
         self.spec = dep
         self.metrics = EngineMetrics(MetricsRegistry(), deployment=dep.name)
         self.predictors = [LocalPredictor(dep, p, self.metrics) for p in dep.predictors]
+        # surface live QoS posture (limits, shed level, open breakers) to
+        # the reconcile loop's status.qos block via the process-local
+        # registry (qos/registry.py) — only when some predictor runs QoS
+        if any(p.qos is not None for p in self.predictors):
+            from seldon_core_tpu.qos import publish
+
+            def _qos_snapshot(preds=self.predictors):
+                return {
+                    "predictors": [
+                        {"name": p.spec.name, **p.qos.snapshot()}
+                        for p in preds if p.qos is not None
+                    ]
+                }
+
+            publish(dep.name, _qos_snapshot)
         self._rng = random.Random(seed)
         weights = [max(p.spec.replicas, 0) * max(p.spec.traffic, 0)
                    for p in self.predictors]

@@ -403,10 +403,22 @@ class SeldonDeploymentController:
             )
             if avail < want or not found:
                 all_available = False
-        return {
+        status = {
             "state": "Available" if all_available else "Creating",
             "predictorStatus": predictor_status,
         }
+        # QoS posture (docs/qos.md): current concurrency limit, shed
+        # level, and open breakers, refreshed on the same reconcile tick
+        # as replica availability.  The process-local registry
+        # (qos/registry.py) serves it when an engine runtime lives in
+        # this process (dev harness / colocated operator); otherwise the
+        # block is omitted, never invented.
+        from seldon_core_tpu.qos import snapshot as qos_snapshot
+
+        qos = qos_snapshot(owner)
+        if qos is not None:
+            status["qos"] = qos
+        return status
 
     # -- internals -------------------------------------------------------
     def _owner_ref(self, cr: dict) -> Optional[dict]:

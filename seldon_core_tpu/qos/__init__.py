@@ -1,0 +1,80 @@
+"""Quality-of-service subsystem (docs/qos.md).
+
+The overload story the reference stack never had (SURVEY.md §5.3: k8s
+probes + a 3-attempt gateway retry): when offered load exceeds capacity,
+every tier here sheds *deliberately* — by priority, at admission, with a
+bounded answer — instead of queueing unboundedly and timing everything
+out at once.  Four cooperating mechanisms:
+
+1. **Admission control** (:mod:`~seldon_core_tpu.qos.admission`):
+   per-deployment adaptive concurrency limits — AIMD on observed p95
+   against the ``seldon.io/slo-p95-ms`` target — with DAGOR-style
+   priority fractions so ``X-Seldon-Priority: low`` traffic sheds first
+   (429 + ``Retry-After``).
+2. **Deadline propagation + budget-aware queueing**
+   (:mod:`~seldon_core_tpu.qos.context`): the request deadline rides
+   every hop (``X-Seldon-Deadline-Ms`` header + meta tag + contextvar);
+   queued work is earliest-deadline-first and work whose remaining
+   budget cannot cover the node's observed latency is rejected at
+   dequeue instead of burning a model invocation.
+3. **Circuit breakers** (:mod:`~seldon_core_tpu.qos.breaker`): rolling
+   error-and-latency windows with half-open probing around remote/duck
+   component clients, replacing blind retries.
+4. **Degraded-mode serving** (:mod:`~seldon_core_tpu.qos.policy`): a
+   graph's ``seldon.io/qos-fallback`` subgraph serves when the primary's
+   breaker is open or the shed level passes the configured threshold,
+   stamping ``meta.tags.degraded``.
+
+Design lineage: InferLine's latency-aware pipeline provisioning and
+DAGOR ("Overload Control for Scaling WeChat Microservices"), which sheds
+by priority at admission rather than deep in the call graph.
+"""
+
+from seldon_core_tpu.qos.admission import AdmissionController, AdmissionShedError
+from seldon_core_tpu.qos.breaker import (
+    BreakerOpenError,
+    BreakerWrapper,
+    CircuitBreaker,
+)
+from seldon_core_tpu.qos.context import (
+    DEADLINE_HEADER,
+    DEADLINE_TAG,
+    PRIORITIES,
+    PRIORITY_HEADER,
+    PRIORITY_TAG,
+    Deadline,
+    QosContext,
+    current_qos,
+    qos_from_headers,
+    qos_from_meta,
+    qos_scope,
+    stamp_meta,
+)
+from seldon_core_tpu.qos.policy import EngineQos, QosConfig, qos_from_annotations
+from seldon_core_tpu.qos.registry import publish, snapshot, unpublish
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionShedError",
+    "BreakerOpenError",
+    "BreakerWrapper",
+    "CircuitBreaker",
+    "DEADLINE_HEADER",
+    "DEADLINE_TAG",
+    "PRIORITIES",
+    "PRIORITY_HEADER",
+    "PRIORITY_TAG",
+    "Deadline",
+    "QosContext",
+    "EngineQos",
+    "QosConfig",
+    "current_qos",
+    "qos_from_annotations",
+    "qos_from_headers",
+    "qos_from_meta",
+    "qos_scope",
+    "stamp_meta",
+    "publish",
+    "snapshot",
+    "unpublish",
+]

@@ -1,0 +1,174 @@
+"""Adaptive admission control: AIMD concurrency limits + priority shed.
+
+The limit is *learned*, not configured: the operator annotation
+``seldon.io/slo-p95-ms`` states the latency objective, and the controller
+searches for the largest concurrency the backend sustains within it —
+additive increase while observed p95 is under target, multiplicative
+decrease the moment it is not (the TCP congestion-control shape; Netflix
+concurrency-limits uses the same family).  A static limit would be wrong
+twice a day: too low off-peak (wasted capacity), too high when a
+neighbour steals the accelerator (collapse).
+
+Priority shed order is DAGOR-style — admission is the *one* place load is
+refused, and it refuses the lowest class first: each priority class may
+only occupy a fraction of the current limit (low 50%, normal 90%, high
+100%), so as utilization climbs, ``low`` 429s first, then ``normal``,
+and ``high`` keeps its full share of the learned limit.  Sheds answer
+immediately (429 + ``Retry-After``) — an overloaded system's most
+valuable output is a *fast no*.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from seldon_core_tpu.qos.context import DEFAULT_PRIORITY, priority_rank
+from seldon_core_tpu.runtime.component import SeldonComponentError
+
+__all__ = ["AdmissionConfig", "AdmissionController", "AdmissionShedError"]
+
+
+class AdmissionShedError(SeldonComponentError):
+    """Request refused at admission — HTTP 429 with a Retry-After hint."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message, status_code=429, reason="ADMISSION_SHED")
+        self.retry_after_s = retry_after_s
+
+
+#: fraction of the current limit each priority class may occupy
+PRIORITY_FRACTION = {"low": 0.5, "normal": 0.9, "high": 1.0}
+
+
+@dataclass
+class AdmissionConfig:
+    target_p95_ms: float = 0.0     # 0 = admission control disabled
+    min_limit: int = 4
+    max_limit: int = 1024
+    initial_limit: int = 32
+    #: latency samples per AIMD adjustment step
+    window: int = 32
+    #: multiplicative-decrease factor when p95 overshoots the target
+    backoff: float = 0.75
+    #: additive-increase step when p95 is within target
+    step: int = 2
+
+
+class AdmissionController:
+    """Per-deployment admission gate.  Thread-safe; hot path is O(1).
+
+    ``try_acquire`` never blocks: the whole point is that refusing load
+    must cost microseconds, not a queue slot."""
+
+    def __init__(self, config: AdmissionConfig, name: str = "",
+                 metrics=None):
+        self.config = config
+        self.name = name
+        self.metrics = metrics  # MetricsRegistry or None
+        self._lock = threading.Lock()
+        self.limit = max(config.min_limit,
+                         min(config.initial_limit, config.max_limit))
+        self.inflight = 0
+        self._window: list[float] = []
+        # lifetime counters (tests/bench read these without scraping)
+        self.admitted = 0
+        self.shed = 0
+        self._gauges()
+
+    # ------------------------------------------------------------------
+    def try_acquire(self, priority: str = DEFAULT_PRIORITY) -> bool:
+        """Admit or refuse, by priority fraction of the current limit."""
+        frac = PRIORITY_FRACTION.get(priority,
+                                     PRIORITY_FRACTION[DEFAULT_PRIORITY])
+        with self._lock:
+            cap = max(self.config.min_limit * frac, self.limit * frac)
+            if self.inflight + 1 > cap:
+                self.shed += 1
+                if self.metrics is not None:
+                    self.metrics.counter_inc(
+                        "seldon_qos_shed_total",
+                        {"deployment": self.name, "priority": priority,
+                         "reason": "admission"},
+                    )
+                return False
+            self.inflight += 1
+            self.admitted += 1
+        if self.metrics is not None:
+            self.metrics.counter_inc(
+                "seldon_qos_admitted_total",
+                {"deployment": self.name, "priority": priority},
+            )
+            self._gauges()
+        return True
+
+    def release(self, latency_s: float, ok: bool = True) -> None:
+        """Return a slot and feed the AIMD loop one latency observation.
+
+        Failures release the slot but do NOT feed the latency window — an
+        instant 500 would otherwise read as "fast" and open the limit
+        while the backend burns."""
+        with self._lock:
+            self.inflight = max(self.inflight - 1, 0)
+            if ok:
+                self._window.append(latency_s * 1000.0)
+                if len(self._window) >= self.config.window:
+                    self._adjust_locked()
+        if self.metrics is not None:
+            self._gauges()
+
+    def _adjust_locked(self) -> None:
+        window, self._window = self._window, []
+        if not self.config.target_p95_ms:
+            return
+        window.sort()
+        p95 = window[min(int(len(window) * 0.95), len(window) - 1)]
+        if p95 > self.config.target_p95_ms:
+            self.limit = max(self.config.min_limit,
+                             int(self.limit * self.config.backoff))
+        else:
+            self.limit = min(self.config.max_limit,
+                             self.limit + self.config.step)
+
+    # ------------------------------------------------------------------
+    @property
+    def shed_level(self) -> int:
+        """0 = nothing sheds, 1 = ``low`` sheds, 2 = ``normal`` sheds,
+        3 = even ``high`` sheds (full saturation)."""
+        with self._lock:
+            limit, inflight = self.limit, self.inflight
+        level = 0
+        for pri in ("low", "normal", "high"):
+            cap = max(self.config.min_limit * PRIORITY_FRACTION[pri],
+                      limit * PRIORITY_FRACTION[pri])
+            if inflight + 1 > cap:
+                level = priority_rank(pri) + 1
+        return level
+
+    def retry_after_s(self) -> float:
+        """Retry-After hint: roughly one target-latency's worth of drain
+        (bounded to whole-second wire semantics by the caller)."""
+        t = self.config.target_p95_ms / 1000.0
+        return min(max(t, 0.05), 10.0) if t else 1.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "limit": self.limit,
+                "inflight": self.inflight,
+                "admitted": self.admitted,
+                "shed": self.shed,
+                "targetP95Ms": self.config.target_p95_ms,
+            }
+
+    def _gauges(self) -> None:
+        if self.metrics is None:
+            return
+        labels = {"deployment": self.name}
+        self.metrics.gauge_set("seldon_qos_concurrency_limit",
+                               self.limit, labels)
+        self.metrics.gauge_set("seldon_qos_inflight", self.inflight, labels)
+        self.metrics.gauge_set("seldon_qos_shed_level", self.shed_level,
+                               labels)

@@ -1,0 +1,180 @@
+"""Request QoS context: priority class + deadline, propagated everywhere.
+
+One request's QoS facts have to survive three very different transports:
+
+- **wire hops** (client → gateway → engine): HTTP headers
+  ``X-Seldon-Priority`` / ``X-Seldon-Deadline-Ms`` — the deadline header
+  carries the *remaining budget in milliseconds at send time* (gRPC-style
+  timeout propagation; absolute wall-clock deadlines would require
+  synchronized clocks across pods);
+- **message hops** (engine → remote graph node): ``meta.tags`` entries
+  (``priority`` / ``deadline-ms``), the proto-visible channel;
+- **in-process call stacks** (engine walk → dynamic batcher →
+  single-flight): a :data:`contextvars.ContextVar`, so deeply nested
+  components (the batcher's ``__call__`` receives a bare array, not a
+  message) still see the caller's budget without any signature change —
+  asyncio tasks inherit the context at creation.
+
+Every layer reads whichever channel it can reach and restamps the rest.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional
+
+__all__ = [
+    "PRIORITIES",
+    "PRIORITY_HEADER",
+    "PRIORITY_TAG",
+    "DEADLINE_HEADER",
+    "DEADLINE_TAG",
+    "DEGRADED_TAG",
+    "Deadline",
+    "QosContext",
+    "current_qos",
+    "qos_scope",
+    "qos_from_headers",
+    "qos_from_meta",
+    "stamp_meta",
+    "priority_rank",
+]
+
+PRIORITY_HEADER = "X-Seldon-Priority"
+DEADLINE_HEADER = "X-Seldon-Deadline-Ms"
+PRIORITY_TAG = "priority"
+DEADLINE_TAG = "deadline-ms"
+#: stamped on responses served by the ``seldon.io/qos-fallback`` subgraph
+DEGRADED_TAG = "degraded"
+
+#: shedding order: lowest rank sheds first
+PRIORITIES = ("low", "normal", "high")
+_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+DEFAULT_PRIORITY = "normal"
+
+
+def priority_rank(priority: str) -> int:
+    return _RANK.get(priority, _RANK[DEFAULT_PRIORITY])
+
+
+def _parse_priority(raw: Any) -> str:
+    p = str(raw or "").strip().lower()
+    return p if p in _RANK else DEFAULT_PRIORITY
+
+
+@dataclass(frozen=True)
+class Deadline:
+    """A request deadline as a monotonic-clock expiry instant."""
+
+    expires_at: float  # time.monotonic() instant
+
+    @classmethod
+    def after_ms(cls, budget_ms: float) -> "Deadline":
+        return cls(time.monotonic() + max(float(budget_ms), 0.0) / 1000.0)
+
+    def remaining_s(self) -> float:
+        return max(self.expires_at - time.monotonic(), 0.0)
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+
+@dataclass(frozen=True)
+class QosContext:
+    priority: str = DEFAULT_PRIORITY
+    deadline: Optional[Deadline] = None
+
+    @property
+    def rank(self) -> int:
+        return priority_rank(self.priority)
+
+
+_current: contextvars.ContextVar[Optional[QosContext]] = contextvars.ContextVar(
+    "qos_request_context", default=None
+)
+
+
+def current_qos() -> Optional[QosContext]:
+    """The ambient request QoS context (None outside any request scope)."""
+    return _current.get()
+
+
+@contextmanager
+def qos_scope(ctx: Optional[QosContext]):
+    """Bind ``ctx`` as the ambient QoS context for the enclosed block.
+
+    ``None`` passes the existing ambient context through unchanged, so
+    callers can wrap unconditionally."""
+    if ctx is None:
+        yield
+        return
+    token = _current.set(ctx)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# codecs: headers <-> meta tags <-> context
+# ---------------------------------------------------------------------------
+
+def _parse_budget_ms(raw: Any) -> Optional[float]:
+    try:
+        v = float(str(raw).strip())
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else 0.0
+
+
+def qos_from_headers(headers: Mapping[str, str]) -> Optional[QosContext]:
+    """Context from wire headers; None when neither QoS header is set
+    (so the plain non-QoS path stays entirely untouched)."""
+    raw_p = headers.get(PRIORITY_HEADER)
+    raw_d = headers.get(DEADLINE_HEADER)
+    if raw_p is None and raw_d is None:
+        return None
+    deadline = None
+    if raw_d is not None:
+        budget = _parse_budget_ms(raw_d)
+        if budget is not None:
+            deadline = Deadline.after_ms(budget)
+    return QosContext(priority=_parse_priority(raw_p), deadline=deadline)
+
+
+def qos_from_meta(meta: Any) -> Optional[QosContext]:
+    """Context from a SeldonMessage's ``meta.tags`` (the proto channel)."""
+    tags = getattr(meta, "tags", None) or {}
+    raw_p = tags.get(PRIORITY_TAG)
+    raw_d = tags.get(DEADLINE_TAG)
+    if raw_p is None and raw_d is None:
+        return None
+    deadline = None
+    if raw_d is not None:
+        budget = _parse_budget_ms(raw_d)
+        if budget is not None:
+            deadline = Deadline.after_ms(budget)
+    return QosContext(priority=_parse_priority(raw_p), deadline=deadline)
+
+
+def stamp_meta(meta: Any, ctx: QosContext) -> None:
+    """Restamp the context onto ``meta.tags`` for the next hop — the
+    deadline as the *remaining* budget, so every hop's stamp shrinks."""
+    meta.tags[PRIORITY_TAG] = ctx.priority
+    if ctx.deadline is not None:
+        meta.tags[DEADLINE_TAG] = round(ctx.deadline.remaining_ms(), 3)
+
+
+def forward_headers(ctx: QosContext) -> dict:
+    """Hop headers for the next wire forward (remaining budget at send)."""
+    out = {PRIORITY_HEADER: ctx.priority}
+    if ctx.deadline is not None:
+        out[DEADLINE_HEADER] = f"{ctx.deadline.remaining_ms():.3f}"
+    return out

@@ -1,0 +1,190 @@
+"""QoS policy: annotation parsing + per-predictor runtime state.
+
+``seldon.io/qos-*`` / ``seldon.io/slo-p95-ms`` annotations (validated at
+admission by ``operator/compile.py`` + graphlint GL8xx) compile to a
+:class:`QosConfig`; the engine/gateway instantiate an :class:`EngineQos`
+from it — the object that owns the admission controller, the component
+breakers, and the degrade decision, and that publishes the ``status.qos``
+snapshot the reconcile loop surfaces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from seldon_core_tpu.qos.admission import AdmissionConfig, AdmissionController
+from seldon_core_tpu.qos.breaker import BreakerConfig, CircuitBreaker
+
+__all__ = [
+    "SLO_P95_ANNOTATION",
+    "FALLBACK_ANNOTATION",
+    "DEGRADE_LEVEL_ANNOTATION",
+    "BREAKER_ANNOTATION",
+    "QosConfig",
+    "EngineQos",
+    "qos_from_annotations",
+]
+
+SLO_P95_ANNOTATION = "seldon.io/slo-p95-ms"
+FALLBACK_ANNOTATION = "seldon.io/qos-fallback"
+#: shed level at which the fallback subgraph takes over (1=low sheds,
+#: 2=normal sheds, 3=high sheds)
+DEGRADE_LEVEL_ANNOTATION = "seldon.io/qos-degrade-shed-level"
+BREAKER_ANNOTATION = "seldon.io/qos-breaker"
+BREAKER_MIN_CALLS_ANNOTATION = "seldon.io/qos-breaker-min-calls"
+BREAKER_OPEN_MS_ANNOTATION = "seldon.io/qos-breaker-open-ms"
+BREAKER_SLOW_MS_ANNOTATION = "seldon.io/qos-breaker-slow-ms"
+
+_TRUE = ("1", "true", "yes")
+_FALSE = ("", "0", "false", "no")
+
+
+@dataclass
+class QosConfig:
+    name: str = ""
+    slo_p95_ms: float = 0.0          # 0 = no adaptive admission control
+    fallback_node: str = ""          # "" = no degraded-mode subgraph
+    degrade_shed_level: int = 2      # degrade when `normal` starts shedding
+    breakers_enabled: bool = True
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)
+
+    @property
+    def admission_enabled(self) -> bool:
+        return self.slo_p95_ms > 0
+
+
+def _num(ann: dict, key: str, kind=float):
+    raw = ann.get(key)
+    if raw is None or str(raw).strip() == "":
+        return None
+    try:
+        return kind(str(raw).strip())
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"annotation {key} must be a {kind.__name__}, got {raw!r}"
+        ) from None
+
+
+def qos_from_annotations(ann: dict, name: str) -> Optional[QosConfig]:
+    """``seldon.io/slo-p95-ms`` / ``seldon.io/qos-*`` annotations → a
+    validated :class:`QosConfig`, or None when the subsystem is off.
+
+    QoS is ON when an SLO target, a fallback subgraph, or an explicit
+    ``seldon.io/qos-breaker: "true"`` is declared.  Raises ``ValueError``
+    on invalid values (admission wraps this into a rejected spec;
+    graphlint GL801 reports the same defect)."""
+    slo = _num(ann, SLO_P95_ANNOTATION)
+    if slo is not None and slo <= 0:
+        raise ValueError(
+            f"annotation {SLO_P95_ANNOTATION} must be > 0, got {slo:g}"
+        )
+    fallback = str(ann.get(FALLBACK_ANNOTATION, "") or "").strip()
+    raw_breaker = str(ann.get(BREAKER_ANNOTATION, "")).strip().lower()
+    if raw_breaker not in _TRUE + _FALSE:
+        raise ValueError(
+            f"annotation {BREAKER_ANNOTATION} must be a boolean, "
+            f"got {raw_breaker!r}"
+        )
+    explicit_breaker = raw_breaker in _TRUE
+    if slo is None and not fallback and not explicit_breaker:
+        return None
+    level = _num(ann, DEGRADE_LEVEL_ANNOTATION, int)
+    if level is not None and not 1 <= level <= 3:
+        raise ValueError(
+            f"annotation {DEGRADE_LEVEL_ANNOTATION} must be 1..3, "
+            f"got {level}"
+        )
+    breaker = BreakerConfig()
+    min_calls = _num(ann, BREAKER_MIN_CALLS_ANNOTATION, int)
+    if min_calls is not None:
+        if min_calls < 1:
+            raise ValueError(
+                f"annotation {BREAKER_MIN_CALLS_ANNOTATION} must be >= 1, "
+                f"got {min_calls}"
+            )
+        breaker.min_calls = min_calls
+    open_ms = _num(ann, BREAKER_OPEN_MS_ANNOTATION)
+    if open_ms is not None:
+        if open_ms <= 0:
+            raise ValueError(
+                f"annotation {BREAKER_OPEN_MS_ANNOTATION} must be > 0, "
+                f"got {open_ms:g}"
+            )
+        breaker.open_s = open_ms / 1000.0
+    slow_ms = _num(ann, BREAKER_SLOW_MS_ANNOTATION)
+    if slow_ms is not None:
+        if slow_ms < 0:
+            raise ValueError(
+                f"annotation {BREAKER_SLOW_MS_ANNOTATION} must be >= 0, "
+                f"got {slow_ms:g}"
+            )
+        breaker.slow_ms = slow_ms
+    return QosConfig(
+        name=name,
+        slo_p95_ms=slo or 0.0,
+        fallback_node=fallback,
+        degrade_shed_level=level if level is not None else 2,
+        breakers_enabled=raw_breaker not in ("0", "false", "no"),
+        breaker=breaker,
+    )
+
+
+class EngineQos:
+    """One predictor's live QoS state: admission + breakers + degrade.
+
+    Owned by the engine (or the dev harness); the gateway keeps its own
+    :class:`AdmissionController` per deployment — two tiers, same policy,
+    so a request refused at the gateway never reaches the engine and a
+    request the gateway admitted can still shed at the engine if the
+    picture changed in flight."""
+
+    def __init__(self, config: QosConfig, metrics=None):
+        self.config = config
+        self.metrics = metrics
+        self.admission: Optional[AdmissionController] = None
+        if config.admission_enabled:
+            self.admission = AdmissionController(
+                AdmissionConfig(target_p95_ms=config.slo_p95_ms),
+                name=config.name, metrics=metrics,
+            )
+        self.breakers: list[CircuitBreaker] = []
+
+    def make_breaker(self, component: str) -> CircuitBreaker:
+        """A breaker for one component client, tracked for degrade/status."""
+        b = CircuitBreaker(self.config.breaker, name=component,
+                           metrics=self.metrics)
+        self.breakers.append(b)
+        return b
+
+    def open_breakers(self) -> list[str]:
+        return [b.name for b in self.breakers if b.state != "closed"]
+
+    @property
+    def shed_level(self) -> int:
+        return self.admission.shed_level if self.admission else 0
+
+    def should_degrade(self) -> Optional[str]:
+        """The degrade reason (``breaker_open`` / ``shed_level``) when the
+        fallback subgraph should serve, else None."""
+        if not self.config.fallback_node:
+            return None
+        if self.open_breakers():
+            return "breaker_open"
+        if (self.admission is not None
+                and self.shed_level >= self.config.degrade_shed_level):
+            return "shed_level"
+        return None
+
+    def snapshot(self) -> dict:
+        """The ``status.qos`` block the reconcile loop surfaces."""
+        out: dict = {"shedLevel": self.shed_level}
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        if self.breakers:
+            out["breakers"] = [b.snapshot() for b in self.breakers]
+            out["openBreakers"] = self.open_breakers()
+        if self.config.fallback_node:
+            out["fallback"] = self.config.fallback_node
+            out["degraded"] = self.should_degrade() or ""
+        return out

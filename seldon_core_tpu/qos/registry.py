@@ -1,0 +1,59 @@
+"""Process-local QoS state registry: live QoS facts → control plane.
+
+The reconcile loop wants to surface each deployment's *current* QoS
+posture (concurrency limit, shed level, open breakers) on the CR's
+``status.qos`` block, refreshed on the same tick as replica availability.
+Admission controllers and breakers are runtime objects inside engine or
+gateway processes; this registry is the seam between them and the
+operator: each :class:`~seldon_core_tpu.qos.policy.EngineQos` publishes a
+snapshot provider keyed by deployment name, and
+``operator/reconcile.py`` reads :func:`snapshot` when computing status.
+
+In the colocated dev/test harness (LocalDeployment + FakeKubeApi in one
+process) this is live state; in a real cluster each engine pod exposes
+the same snapshot via its ``/metrics`` gauges and the operator-side
+registry stays empty — ``status.qos`` is then omitted rather than
+invented.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+__all__ = ["publish", "unpublish", "snapshot", "clear"]
+
+_lock = threading.Lock()
+#: deployment name → snapshot provider () -> dict
+_providers: dict[str, Callable[[], dict]] = {}
+
+
+def publish(deployment: str, provider: Callable[[], dict]) -> None:
+    """Register (or replace) the snapshot provider for a deployment."""
+    with _lock:
+        _providers[deployment] = provider
+
+
+def unpublish(deployment: str) -> None:
+    with _lock:
+        _providers.pop(deployment, None)
+
+
+def snapshot(deployment: str) -> Optional[dict]:
+    """The deployment's current QoS posture, or None when no runtime in
+    this process serves it.  Provider errors surface as None — status
+    must never fail because a snapshot did."""
+    with _lock:
+        provider = _providers.get(deployment)
+    if provider is None:
+        return None
+    try:
+        return provider()
+    except Exception:
+        return None
+
+
+def clear() -> None:
+    """Test helper: forget every provider."""
+    with _lock:
+        _providers.clear()

@@ -28,18 +28,37 @@ Design for XLA semantics:
   DEADLINE_EXCEEDED), and at most ``max_inflight`` batches are in flight on
   the device at once — further flushes wait for a completion, so a slow
   model fills the queue and sheds instead of ballooning memory.
+- **Deadline-aware queueing** (docs/qos.md): requests carrying a QoS
+  deadline (``seldon_core_tpu.qos.context`` contextvar, stamped by the
+  gateway/engine from ``X-Seldon-Deadline-Ms``) are queued
+  earliest-deadline-first ahead of deadline-less work, and a request
+  whose remaining budget cannot cover the batcher's observed batch
+  latency (EWMA) is rejected at dequeue — a guaranteed-late answer must
+  not burn a device dispatch slot some on-time request needs.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 import numpy as np
 
 from seldon_core_tpu.runtime.component import SeldonComponentError
+
+
+def _qos_deadline() -> Optional[float]:
+    """Ambient QoS deadline as a loop-clock expiry (the default asyncio
+    loop clock IS time.monotonic, the clock Deadline uses)."""
+    from seldon_core_tpu.qos.context import current_qos
+
+    ctx = current_qos()
+    if ctx is None or ctx.deadline is None:
+        return None
+    return ctx.deadline.expires_at
 
 logger = logging.getLogger(__name__)
 
@@ -91,6 +110,8 @@ class _Pending:
     nrows: int
     future: asyncio.Future = field(compare=False, default=None)
     t_enqueue: float = 0.0
+    # QoS deadline as a loop-clock expiry instant; None = no deadline
+    deadline: Optional[float] = None
 
 
 class _Lane:
@@ -147,6 +168,10 @@ class DynamicBatcher:
         self.max_lanes = 64
         self._inflight = 0
         self._slot_waiters: list[asyncio.Future] = []
+        # EWMA of dispatch→delivery batch latency (s): the service-time
+        # estimate the budget-aware dequeue compares remaining deadlines
+        # against.  0 until the first batch completes (no shedding blind).
+        self.latency_ewma_s = 0.0
 
     # ------------------------------------------------------------------
     def bucket_for(self, rows: int) -> int:
@@ -216,7 +241,9 @@ class DynamicBatcher:
                 f"{self.max_queue_rows})"
             )
         fut: asyncio.Future = loop.create_future()
-        lane.pending.append(_Pending(arr, nrows, fut, t_enqueue=loop.time()))
+        p = _Pending(arr, nrows, fut, t_enqueue=loop.time(),
+                     deadline=_qos_deadline())
+        self._edf_insert(lane, p)
         lane.pending_rows += nrows
         if lane.pending_rows >= self.config.max_batch_size:
             self._flush(lane)
@@ -226,29 +253,51 @@ class DynamicBatcher:
             )
         return await fut
 
+    def _edf_insert(self, lane: _Lane, p: _Pending) -> None:
+        """Earliest-deadline-first enqueue: deadline-carrying requests sort
+        by expiry ahead of deadline-less ones; ties and the deadline-less
+        tail stay FIFO (stable insert)."""
+        if p.deadline is None:
+            lane.pending.append(p)
+            return
+        for i, q in enumerate(lane.pending):
+            if q.deadline is None or q.deadline > p.deadline:
+                lane.pending.insert(i, p)
+                return
+        lane.pending.append(p)
+
+    def _shed(self, p: _Pending, reason: str, message: str) -> None:
+        if not p.future.done():
+            p.future.set_exception(DeadlineExceededError(message))
+        if self.metrics is not None:
+            self.metrics.counter_inc(
+                "seldon_batcher_shed_total",
+                {"batcher": self.config.name, "reason": reason},
+            )
+
     # ------------------------------------------------------------------
     def _flush(self, lane: _Lane) -> None:
         if lane.flush_handle is not None:
             lane.flush_handle.cancel()
             lane.flush_handle = None
         loop = asyncio.get_running_loop()
+        now = loop.time()
         if self.config.shed_after_ms > 0:
-            cutoff = loop.time() - self.config.shed_after_ms / 1000.0
-            while lane.pending and lane.pending[0].t_enqueue < cutoff:
-                p = lane.pending.pop(0)
-                lane.pending_rows -= p.nrows
-                if not p.future.done():
-                    p.future.set_exception(
-                        DeadlineExceededError(
-                            f"batcher {self.config.name!r}: request queued "
-                            f"longer than {self.config.shed_after_ms}ms"
-                        )
+            # EDF reordering means the oldest request is no longer
+            # necessarily at the head — scan the whole queue
+            cutoff = now - self.config.shed_after_ms / 1000.0
+            keep: list[_Pending] = []
+            for p in lane.pending:
+                if p.t_enqueue < cutoff:
+                    lane.pending_rows -= p.nrows
+                    self._shed(
+                        p, "deadline",
+                        f"batcher {self.config.name!r}: request queued "
+                        f"longer than {self.config.shed_after_ms}ms",
                     )
-                if self.metrics is not None:
-                    self.metrics.counter_inc(
-                        "seldon_batcher_shed_total",
-                        {"batcher": self.config.name, "reason": "deadline"},
-                    )
+                else:
+                    keep.append(p)
+            lane.pending = keep
         if (
             self.config.materialize == "host"
             and self.config.max_inflight
@@ -258,10 +307,29 @@ class DynamicBatcher:
             return
         batch_items: list[_Pending] = []
         rows = 0
-        while lane.pending and rows + lane.pending[0].nrows <= self.config.max_batch_size:
-            p = lane.pending.pop(0)
-            rows += p.nrows
-            batch_items.append(p)
+        est = self.latency_ewma_s
+        while lane.pending:
+            head = lane.pending[0]
+            if (head.deadline is not None and est > 0.0
+                    and head.deadline - now < est):
+                # budget-aware dequeue (docs/qos.md): the remaining budget
+                # cannot cover the observed batch latency — answering 504
+                # NOW costs nothing; dispatching would burn device time
+                # producing a response the deadline already invalidated
+                lane.pending.pop(0)
+                lane.pending_rows -= head.nrows
+                self._shed(
+                    head, "budget",
+                    f"batcher {self.config.name!r}: remaining deadline "
+                    f"budget {max(head.deadline - now, 0) * 1000:.1f}ms "
+                    f"below observed batch latency {est * 1000:.1f}ms",
+                )
+                continue
+            if rows + head.nrows > self.config.max_batch_size:
+                break
+            lane.pending.pop(0)
+            rows += head.nrows
+            batch_items.append(head)
         lane.pending_rows -= rows
         if not batch_items:
             return
@@ -274,6 +342,14 @@ class DynamicBatcher:
             for p in batch_items:
                 if not p.future.done():
                     p.future.set_exception(e)
+
+    def _note_latency(self, elapsed_s: float) -> None:
+        if self.latency_ewma_s <= 0.0:
+            self.latency_ewma_s = elapsed_s
+        else:
+            self.latency_ewma_s = (
+                0.8 * self.latency_ewma_s + 0.2 * elapsed_s
+            )
 
     def _run_batch(self, items: list[_Pending], rows: int) -> None:
         bucket = self.bucket_for(rows)
@@ -302,6 +378,7 @@ class DynamicBatcher:
                 {"batcher": self.config.name},
                 bucket - rows,
             )
+        t_dispatch = time.monotonic()
         out = self.fn(batch)  # async dispatch: returns before TPU finishes
         aux = None
         if self.returns_aux:
@@ -314,7 +391,7 @@ class DynamicBatcher:
                 loop = asyncio.get_running_loop()
                 fetch = loop.run_in_executor(None, _fetch_host, out)
                 fetch.add_done_callback(
-                    lambda f: self._on_batch_done(f, items, aux)
+                    lambda f: self._on_batch_done(f, items, aux, t_dispatch)
                 )
             except BaseException:
                 # a leaked slot would eventually wedge every flush at the
@@ -322,6 +399,7 @@ class DynamicBatcher:
                 self._release_slot()
                 raise
             return
+        self._note_latency(time.monotonic() - t_dispatch)
         self._deliver(out, items, aux)
 
     def _deliver(self, out: Any, items: list[_Pending], aux: Any) -> None:
@@ -332,7 +410,8 @@ class DynamicBatcher:
                 p.future.set_result((sl, aux) if self.returns_aux else sl)
             off += p.nrows
 
-    def _on_batch_done(self, fetch: asyncio.Future, items, aux) -> None:
+    def _on_batch_done(self, fetch: asyncio.Future, items, aux,
+                       t_dispatch: float = 0.0) -> None:
         """Runs on the event loop when a batch's host fetch finishes."""
         try:
             try:
@@ -342,6 +421,8 @@ class DynamicBatcher:
                     if not p.future.done():
                         p.future.set_exception(e)
             else:
+                if t_dispatch:
+                    self._note_latency(time.monotonic() - t_dispatch)
                 self._deliver(host, items, aux)
         finally:
             self._release_slot()

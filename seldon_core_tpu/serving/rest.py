@@ -65,8 +65,13 @@ def _msg_response(msg: SeldonMessage) -> web.Response:
     code = 200
     if msg.status is not None and msg.status.status == "FAILURE":
         code = msg.status.code if 400 <= msg.status.code < 600 else 500
+    headers = None
+    if code == 429:
+        # shed answers (admission / queue-full) always carry a retry hint
+        headers = {"Retry-After": "1"}
     return web.Response(
-        text=msg.to_json(), content_type="application/json", status=code
+        text=msg.to_json(), content_type="application/json", status=code,
+        headers=headers,
     )
 
 
@@ -225,7 +230,12 @@ class EngineServer:
         try:
             payload = await _payload_json(request)
             msg = _parse_msg(payload)
-            out = await self.engine.predict(msg)
+            # QoS headers (docs/qos.md) bind the ambient context for the
+            # whole walk — the engine, batcher, and breakers all read it
+            from seldon_core_tpu.qos.context import qos_from_headers, qos_scope
+
+            with qos_scope(qos_from_headers(request.headers)):
+                out = await self.engine.predict(msg)
         finally:
             self._inflight -= 1
         code = out.status.code if out.status and out.status.status == "FAILURE" else 200

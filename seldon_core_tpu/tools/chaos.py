@@ -22,13 +22,14 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from seldon_core_tpu.runtime.component import SeldonComponentError
 from seldon_core_tpu.utils import maybe_await
 
-__all__ = ["ChaosPolicy", "ChaosWrapper", "ChaosError"]
+__all__ = ["ChaosPolicy", "ChaosWrapper", "ChaosError", "BurstSchedule"]
 
 
 @dataclass
@@ -43,9 +44,64 @@ class ChaosPolicy:
     # probability a call hangs for hang_ms (timeout / deadline testing)
     hang_rate: float = 0.0
     hang_ms: float = 1000.0
+    # -- burst mode: deterministic latency spikes over a seeded schedule
+    # (overload drills, docs/qos.md): every call landing inside a burst
+    # window pays burst_latency_ms EXTRA.  Windows are drawn once from
+    # `seed` (BurstSchedule), so a drill's capacity dips reproduce
+    # exactly; 0 on either knob disables the mode.
+    burst_latency_ms: float = 0.0
+    burst_duration_ms: float = 0.0
+    # mean gap between burst-window starts (±50% seeded jitter)
+    burst_period_ms: float = 1000.0
     # apply faults only to these methods (None = all)
     methods: Optional[set] = None
     seed: Optional[int] = None
+
+    @property
+    def burst_enabled(self) -> bool:
+        return self.burst_latency_ms > 0 and self.burst_duration_ms > 0
+
+
+class BurstSchedule:
+    """Deterministic burst windows from a seed.
+
+    Window k starts ``period * (0.5 + u_k)`` after window k-1 ends
+    (``u_k`` from the seeded stream) and lasts ``duration`` — the whole
+    schedule is a pure function of (seed, period, duration), so an
+    overload drill's latency spikes land at identical offsets every run.
+    Windows materialize lazily as time advances."""
+
+    def __init__(self, seed: Optional[int], period_ms: float,
+                 duration_ms: float):
+        self._rng = random.Random(seed)
+        self.period_s = period_ms / 1000.0
+        self.duration_s = duration_ms / 1000.0
+        self._windows: list[tuple[float, float]] = []
+        self._next_start = self.period_s * (0.5 + self._rng.random())
+
+    def _extend_to(self, t: float) -> None:
+        while self._next_start <= t:
+            start = self._next_start
+            self._windows.append((start, start + self.duration_s))
+            self._next_start = (
+                start + self.duration_s
+                + self.period_s * (0.5 + self._rng.random())
+            )
+
+    def active(self, elapsed_s: float) -> bool:
+        """Is ``elapsed_s`` (seconds since the schedule's origin) inside
+        a burst window?"""
+        self._extend_to(elapsed_s)
+        for start, end in reversed(self._windows):
+            if start <= elapsed_s < end:
+                return True
+            if end <= elapsed_s:
+                break
+        return False
+
+    def windows_until(self, elapsed_s: float) -> list[tuple[float, float]]:
+        self._extend_to(elapsed_s)
+        return [w for w in self._windows if w[0] < elapsed_s]
 
 
 class ChaosError(SeldonComponentError):
@@ -65,14 +121,30 @@ class ChaosWrapper:
     _METHODS = ("predict", "route", "aggregate", "transform_input",
                 "transform_output", "send_feedback")
 
-    def __init__(self, inner: Any, policy: ChaosPolicy):
+    def __init__(self, inner: Any, policy: ChaosPolicy,
+                 clock: Callable[[], float] = time.monotonic):
         self.inner = inner
         self.policy = policy
         self._rng = random.Random(policy.seed)
         self.injected_errors = 0
         self.injected_delays = 0
+        self.injected_bursts = 0
         self.calls = 0
         self.name = getattr(inner, "name", type(inner).__name__)
+        # burst schedule: its own seeded stream (per-call draws above stay
+        # byte-identical whether or not bursts are enabled) anchored at
+        # construction; `clock` is injectable so tests pin the timeline
+        self._clock = clock
+        self._origin = clock()
+        self.bursts: Optional[BurstSchedule] = None
+        if policy.burst_enabled:
+            self.bursts = BurstSchedule(
+                policy.seed, policy.burst_period_ms, policy.burst_duration_ms
+            )
+
+    def burst_active(self) -> bool:
+        return (self.bursts is not None
+                and self.bursts.active(self._clock() - self._origin))
 
     def has(self, method: str) -> bool:
         inner_has = getattr(self.inner, "has", None)
@@ -96,6 +168,10 @@ class ChaosWrapper:
             jitter = self._rng.random() if pol.jitter_ms else 0.0
             fail = bool(pol.error_rate
                         and self._rng.random() < pol.error_rate)
+            # burst check BEFORE any await too: activity is a pure
+            # function of the (deterministic) schedule and the call's
+            # arrival time, not of coroutine wakeup order
+            burst = self.burst_active()
             if hang:
                 self.injected_delays += 1
                 await asyncio.sleep(pol.hang_ms / 1000.0)
@@ -104,6 +180,9 @@ class ChaosWrapper:
                 await asyncio.sleep(
                     (pol.latency_ms + jitter * pol.jitter_ms) / 1000.0
                 )
+            if burst:
+                self.injected_bursts += 1
+                await asyncio.sleep(pol.burst_latency_ms / 1000.0)
             if fail:
                 self.injected_errors += 1
                 raise ChaosError(

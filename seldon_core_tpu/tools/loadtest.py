@@ -478,3 +478,158 @@ async def run_open_loop(
 
 def run_load_sync(driver, **kw) -> LoadResult:
     return asyncio.run(run_load(driver, **kw))
+
+
+# ---------------------------------------------------------------------------
+# QoS overload drill (docs/qos.md)
+# ---------------------------------------------------------------------------
+
+
+async def overload_drill(
+    predict: Any,
+    payload: Any,
+    rate: float,
+    seconds: float = 3.0,
+    priority_mix: Optional[Dict[str, float]] = None,
+    deadline_ms: float = 0.0,
+    seed: int = 0,
+    warmup_s: float = 0.2,
+    max_inflight: int = 10_000,
+) -> dict:
+    """Open-loop overload drill against an in-process async
+    ``predict(msg) -> SeldonMessage`` (a GraphEngine / LocalDeployment,
+    typically chaos-wrapped) at a FIXED offered rate, with a priority mix
+    and a per-request deadline — the reproducible harness the QoS
+    subsystem is tested and benchmarked with.
+
+    Per priority class it reports offered/completed counts, **goodput**
+    (completions within the deadline / offered — the number overload
+    control exists to protect), shed counts and the shed answer's
+    latency percentiles (a shed must be a *fast* no), and completion
+    latency percentiles.  Arrivals are seeded Poisson; latency is
+    measured from the scheduled arrival (no coordinated omission).
+
+    ``payload`` is a SeldonMessage or a zero-arg factory returning one.
+    """
+    from seldon_core_tpu.qos.context import Deadline, QosContext, qos_scope
+
+    rng = np.random.default_rng(seed)
+    pri_rng = np.random.default_rng(seed + 1)
+    mix = priority_mix or {"normal": 1.0}
+    names = sorted(mix)
+    weights = np.asarray([mix[n] for n in names], dtype=np.float64)
+    weights /= weights.sum()
+
+    class _Tally:
+        __slots__ = ("offered", "completed", "good", "shed", "expired",
+                     "failed", "lat_ms", "shed_ms")
+
+        def __init__(self):
+            self.offered = 0
+            self.completed = 0
+            self.good = 0
+            self.shed = 0
+            self.expired = 0
+            self.failed = 0
+            self.lat_ms: List[float] = []
+            self.shed_ms: List[float] = []
+
+    tallies = {n: _Tally() for n in names}
+    inflight = 0
+    tasks: set = set()
+    t_origin = time.perf_counter()
+    t_start = t_origin + warmup_s
+    t_end = t_start + seconds
+
+    def _payload():
+        return payload() if callable(payload) else payload
+
+    async def one(sched: float, priority: str) -> None:
+        nonlocal inflight
+        tally = tallies[priority] if sched >= t_start else None
+        if tally is not None:
+            tally.offered += 1
+        ctx = QosContext(
+            priority=priority,
+            deadline=Deadline.after_ms(deadline_ms) if deadline_ms else None,
+        )
+        try:
+            with qos_scope(ctx):
+                out = await predict(_payload())
+        except Exception:
+            if tally is not None:
+                tally.failed += 1
+            return
+        finally:
+            inflight -= 1
+        lat = (time.perf_counter() - sched) * 1000.0
+        if tally is None:
+            return
+        code = out.status.code if out.status is not None else 200
+        ok = out.status is None or out.status.status == "SUCCESS"
+        if ok:
+            tally.completed += 1
+            tally.lat_ms.append(lat)
+            if not deadline_ms or lat <= deadline_ms:
+                tally.good += 1
+        elif code == 429:
+            tally.shed += 1
+            tally.shed_ms.append(lat)
+        elif code == 504:
+            tally.expired += 1
+        else:
+            tally.failed += 1
+
+    loop = asyncio.get_running_loop()
+    next_t = time.perf_counter()
+    dropped = 0
+    while True:
+        now = time.perf_counter()
+        if now >= t_end:
+            break
+        if next_t > now:
+            await asyncio.sleep(next_t - now)
+        sched = next_t
+        priority = names[int(pri_rng.choice(len(names), p=weights))]
+        if inflight >= max_inflight:
+            if sched >= t_start:
+                dropped += 1
+        else:
+            inflight += 1
+            t = loop.create_task(one(sched, priority))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+        next_t += rng.exponential(1.0 / rate)
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    def _pcts(vals: List[float]) -> dict:
+        if not vals:
+            return {}
+        arr = np.asarray(vals)
+        return {
+            "p50": round(float(np.percentile(arr, 50)), 3),
+            "p95": round(float(np.percentile(arr, 95)), 3),
+            "p99": round(float(np.percentile(arr, 99)), 3),
+        }
+
+    out: dict = {
+        "offered_rate": rate,
+        "seconds": seconds,
+        "deadline_ms": deadline_ms,
+        "dropped": dropped,
+        "priorities": {},
+    }
+    for n in names:
+        t = tallies[n]
+        out["priorities"][n] = {
+            "offered": t.offered,
+            "completed": t.completed,
+            "goodput": round(t.good / t.offered, 4) if t.offered else None,
+            "shed": t.shed,
+            "expired": t.expired,
+            "failed": t.failed,
+            "latency_ms": _pcts(t.lat_ms),
+            "shed_latency_ms": _pcts(t.shed_ms),
+        }
+    return out

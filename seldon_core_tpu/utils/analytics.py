@@ -119,6 +119,53 @@ CATALOG: tuple[MetricInfo, ...] = (
         ("cache",),
     ),
     MetricInfo(
+        "seldon_qos_admitted_total", "counter",
+        "Requests admitted by the QoS admission controller "
+        "(docs/qos.md; no reference counterpart — the reference has no "
+        "overload story beyond probes and retries)",
+        ("deployment", "priority"),
+    ),
+    MetricInfo(
+        "seldon_qos_shed_total", "counter",
+        "Requests refused by QoS (429 + Retry-After); priority=low sheds "
+        "first (DAGOR-style fractions of the adaptive limit)",
+        ("deployment", "priority", "reason"),
+    ),
+    MetricInfo(
+        "seldon_qos_concurrency_limit", "gauge",
+        "Current AIMD concurrency limit per deployment (learned against "
+        "the seldon.io/slo-p95-ms target)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_qos_inflight", "gauge",
+        "Requests currently holding an admission slot",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_qos_shed_level", "gauge",
+        "Current shed level: 0 none, 1 low sheds, 2 normal sheds, 3 all "
+        "shed (the seldon.io/qos-degrade-shed-level trigger reads this)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_qos_breaker_state", "gauge",
+        "Circuit-breaker state per remote/duck component: 0 closed, "
+        "1 half-open, 2 open",
+        ("component",),
+    ),
+    MetricInfo(
+        "seldon_qos_breaker_transitions_total", "counter",
+        "Breaker state transitions (to=closed|half_open|open)",
+        ("component", "to"),
+    ),
+    MetricInfo(
+        "seldon_qos_degraded_total", "counter",
+        "Requests served by the seldon.io/qos-fallback subgraph "
+        "(meta.tags.degraded set; reason=breaker_open|shed_level)",
+        ("graph", "reason"),
+    ),
+    MetricInfo(
         "seldon_llm_tokens_generated_total", "counter",
         "Tokens generated by the continuous-batching LLM engine "
         "(runtime/llm.py; no reference counterpart)",
@@ -288,6 +335,34 @@ def alert_rules() -> dict:
                         },
                     },
                     {
+                        "alert": "SeldonQosHighPriorityShedding",
+                        "expr": (
+                            "sum(rate(seldon_qos_shed_total"
+                            '{priority="high"}[5m])) by (deployment) > 0'
+                        ),
+                        "for": "2m",
+                        "labels": {"severity": "critical"},
+                        "annotations": {
+                            "summary":
+                                "HIGH-priority traffic shedding on "
+                                "{{ $labels.deployment }} — capacity "
+                                "exhausted past the protected tier",
+                        },
+                    },
+                    {
+                        "alert": "SeldonQosBreakerOpen",
+                        "expr": "max_over_time(seldon_qos_breaker_state[5m])"
+                                " == 2",
+                        "for": "1m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "circuit open for component "
+                                "{{ $labels.component }} — traffic routing "
+                                "to fallback/failing fast",
+                        },
+                    },
+                    {
                         "alert": "SeldonGatewayRetrying",
                         "expr": (
                             "sum(rate(seldon_api_gateway_retries_total[5m])) "
@@ -378,6 +453,15 @@ def grafana_dashboard() -> dict:
                ["sum(rate(seldon_coalesced_requests_total[5m])) by (cache)",
                 "sum(rate(seldon_cache_evictions_total[5m])) "
                 "by (cache, reason)"], y=32, x=12),
+        _panel(11, "QoS admission: limit, in-flight, shed rate",
+               ["seldon_qos_concurrency_limit",
+                "seldon_qos_inflight",
+                "sum(rate(seldon_qos_shed_total[5m])) "
+                "by (deployment, priority, reason)"], y=40, x=0),
+        _panel(12, "QoS breakers + degraded traffic",
+               ["seldon_qos_breaker_state",
+                "sum(rate(seldon_qos_degraded_total[5m])) "
+                "by (graph, reason)"], y=40, x=12),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",

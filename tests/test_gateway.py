@@ -247,7 +247,11 @@ class TestFirehose:
                 headers={"Authorization": f"Bearer {token}"},
             )
             target = tmp_path / "key1.jsonl"
-            assert await poll(target.exists)
+            # poll for CONTENT, not existence: the executor-thread publish
+            # opens the file before the line lands, so exists() alone races
+            assert await poll(
+                lambda: target.exists() and target.read_text().strip()
+            )
             lines = target.read_text().strip().splitlines()
             assert len(lines) == 1
             assert json.loads(lines[0])["request"]["data"]["ndarray"] == [[1.0]]

@@ -837,6 +837,174 @@ class TestGatewayCache:
             await gw.close()
 
 
+# ---- cache ↔ QoS interplay (docs/qos.md) --------------------------------
+
+
+class TestCacheQosInterplay:
+    """Admission control and the prediction cache meet at the gateway:
+    a cache (or coalescing) hit costs no engine work, so it must never
+    consume an admission-limit slot; and a shed answer must never poison
+    the single-flight table (the next arrival retries cold)."""
+
+    async def _qos_gateway(self, engine_handler):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+
+        app = web.Application()
+        app.router.add_post("/api/v0.1/predictions", engine_handler)
+        engine = TestClient(TestServer(app))
+        await engine.start_server()
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name="dep1", oauth_key="key1", oauth_secret="sec1",
+            engine_url=f"http://127.0.0.1:{engine.port}",
+            annotations={"seldon.io/prediction-cache": "true",
+                         "seldon.io/slo-p95-ms": "50"},
+        ))
+        gw = Gateway(store)
+        client = TestClient(TestServer(gw.build_app()))
+        await client.start_server()
+        token, _ = gw.oauth.tokens.issue("key1")
+        ctl = gw._dep_admission(store.by_oauth_key("key1"))
+        assert ctl is not None
+        return gw, client, engine, token, ctl
+
+    async def test_cache_hit_consumes_no_admission_slot(self):
+        from aiohttp import web
+
+        async def engine(request):
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token, ctl = await self._qos_gateway(engine)
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            body = {"data": {"ndarray": [[1.0]]}}
+            r1 = await client.post("/api/v0.1/predictions", json=body,
+                                   headers=hdr)
+            assert r1.headers["X-Seldon-Cache"] == "miss"
+            admitted_after_miss = ctl.admitted
+            # zero admission capacity from here on: hits must still serve
+            ctl.config.min_limit = 0
+            ctl.limit = 0
+            for _ in range(3):
+                r = await client.post("/api/v0.1/predictions", json=body,
+                                      headers=hdr)
+                assert r.status == 200
+                assert r.headers["X-Seldon-Cache"] == "hit"
+            assert ctl.admitted == admitted_after_miss  # no slots consumed
+            assert ctl.inflight == 0
+            # a NEW body needs a slot and sheds at the closed gate
+            r = await client.post("/api/v0.1/predictions",
+                                  json={"data": {"ndarray": [[2.0]]}},
+                                  headers=hdr)
+            assert r.status == 429
+            assert "Retry-After" in r.headers
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_coalesced_followers_consume_one_slot_total(self):
+        from aiohttp import web
+
+        calls = [0]
+
+        async def engine(request):
+            calls[0] += 1
+            await asyncio.sleep(0.1)
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token, ctl = await self._qos_gateway(engine)
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            body = {"data": {"ndarray": [[1.0]]}}
+            rs = await asyncio.gather(*(
+                client.post("/api/v0.1/predictions", json=body, headers=hdr)
+                for _ in range(8)
+            ))
+            assert all(r.status == 200 for r in rs)
+            assert calls[0] == 1
+            # the whole coalesced group charged ONE admission
+            assert ctl.admitted == 1
+            assert ctl.inflight == 0
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_shed_never_poisons_single_flight_or_cache(self):
+        from aiohttp import web
+
+        calls = [0]
+
+        async def engine(request):
+            calls[0] += 1
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token, ctl = await self._qos_gateway(engine)
+        try:
+            hdr = {"Authorization": f"Bearer {token}"}
+            body = {"data": {"ndarray": [[1.0]]}}
+            # close the gate: the leader itself sheds
+            ctl.config.min_limit = 0
+            ctl.limit = 0
+            r = await client.post("/api/v0.1/predictions", json=body,
+                                  headers=hdr)
+            assert r.status == 429
+            assert calls[0] == 0
+            # the 429 was NOT cached and the flight table is empty
+            assert gw._flight.leader_count() == 0
+            # reopen the gate: the same body computes cold and caches
+            ctl.limit = 8
+            r = await client.post("/api/v0.1/predictions", json=body,
+                                  headers=hdr)
+            assert r.status == 200
+            assert r.headers["X-Seldon-Cache"] == "miss"
+            assert calls[0] == 1
+            r = await client.post("/api/v0.1/predictions", json=body,
+                                  headers=hdr)
+            assert r.headers["X-Seldon-Cache"] == "hit"
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    def test_engine_shed_request_never_poisons_walk_cache(self):
+        """Engine tier: a request refused at engine admission leaves no
+        cache entry and no single-flight residue — the next admitted
+        identical request computes cold, then caches normally."""
+        from seldon_core_tpu.qos import EngineQos, QosConfig
+
+        qos = EngineQos(QosConfig(name="p", slo_p95_ms=50))
+        cache = PredictionCache(CacheConfig(name="t"))
+        eng = GraphEngine(mlp_node("m"), resolver=resolver_for(), name="p",
+                          cache=cache, qos=qos)
+        calls = count_model_calls(eng)
+        x = np.zeros((1, 784), np.float32)
+        qos.admission.config.min_limit = 0
+        qos.admission.limit = 0
+        out = run(eng.predict(pinned(x)))
+        assert out.status.code == 429
+        assert calls[0] == 0
+        assert cache.stats["entries"] == 0
+        assert eng._flight.leader_count() == 0
+        qos.admission.limit = 8
+        ok = run(eng.predict(pinned(x)))
+        assert ok.status is None or ok.status.status == "SUCCESS"
+        assert calls[0] == 1
+        run(eng.predict(pinned(x)))
+        assert calls[0] == 1  # served from cache
+
+
 # ---- sync FramedClient timeout (transport satellite) --------------------
 
 

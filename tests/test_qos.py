@@ -1,0 +1,903 @@
+"""QoS subsystem (docs/qos.md): admission control, deadline-aware
+queueing, circuit breaking, degraded-mode serving.
+
+The contracts under test:
+
+- **admission**: AIMD limit tracks the ``seldon.io/slo-p95-ms`` target
+  (multiplicative decrease when p95 overshoots, additive increase when
+  under); priority fractions shed ``low`` first; a shed answers 429
+  ADMISSION_SHED immediately;
+- **deadlines**: the budget rides headers ↔ meta tags ↔ contextvar,
+  shrinking per hop; an expired budget 504s before any model work; the
+  DynamicBatcher queues earliest-deadline-first and rejects at dequeue
+  when the remaining budget cannot cover its observed batch latency;
+- **breakers**: error-rate and latency-outlier trips, open short-circuits
+  with 503 CIRCUIT_OPEN, half-open probes close (or reopen) the circuit;
+  4xx caller errors never trip it;
+- **degraded mode**: breaker-open / shed-level triggers route requests to
+  the ``seldon.io/qos-fallback`` subtree, stamping ``meta.tags.degraded``;
+- **parity**: with QoS on but not triggered, responses stay
+  byte-identical to the QoS-free engine, in walk AND fused modes;
+- **gateway**: 429 + Retry-After; retries live inside the deadline
+  budget (the satellite fix: no fixed per-attempt timeouts);
+- **admission-time checks**: GL8xx findings + operator validation +
+  ``status.qos`` on reconcile.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.operator.local import (
+    LocalDeployment,
+    load_deployment_file,
+    resolve_component,
+)
+from seldon_core_tpu.qos import (
+    AdmissionController,
+    BreakerOpenError,
+    BreakerWrapper,
+    CircuitBreaker,
+    Deadline,
+    EngineQos,
+    QosConfig,
+    QosContext,
+    qos_from_annotations,
+    qos_from_headers,
+    qos_from_meta,
+    qos_scope,
+)
+from seldon_core_tpu.qos.admission import AdmissionConfig
+from seldon_core_tpu.qos.breaker import BreakerConfig
+from seldon_core_tpu.qos.context import stamp_meta
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "graphs")
+NO_BATCH = {"seldon.io/batching": "false"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mlp_node(name, seed=0, hidden=16):
+    return {
+        "name": name, "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+            {"name": "seed", "value": str(seed), "type": "INT"},
+            {"name": "hidden", "value": str(hidden), "type": "INT"},
+        ],
+    }
+
+
+def pinned(x):
+    msg = SeldonMessage.from_ndarray(np.asarray(x))
+    msg.meta.puid = "qos-pinned"
+    return msg
+
+
+X = np.zeros((1, 784), np.float32)
+
+
+# ---- context / codecs ---------------------------------------------------
+
+
+class TestContext:
+    def test_headers_roundtrip_and_budget_shrinks(self):
+        ctx = qos_from_headers({"X-Seldon-Priority": "HIGH",
+                                "X-Seldon-Deadline-Ms": "250"})
+        assert ctx.priority == "high"
+        assert 0 < ctx.deadline.remaining_ms() <= 250
+        from seldon_core_tpu.qos.context import forward_headers
+
+        time.sleep(0.02)
+        hop = forward_headers(ctx)
+        assert float(hop["X-Seldon-Deadline-Ms"]) < 250
+
+    def test_meta_tags_roundtrip(self):
+        from seldon_core_tpu.messages import Meta
+
+        meta = Meta()
+        stamp_meta(meta, QosContext(priority="low",
+                                    deadline=Deadline.after_ms(100)))
+        ctx = qos_from_meta(meta)
+        assert ctx.priority == "low"
+        assert 0 < ctx.deadline.remaining_ms() <= 100
+
+    def test_absent_channels_mean_no_context(self):
+        from seldon_core_tpu.messages import Meta
+
+        assert qos_from_headers({}) is None
+        assert qos_from_meta(Meta()) is None
+
+    def test_unknown_priority_defaults_normal(self):
+        ctx = qos_from_headers({"X-Seldon-Priority": "urgent!!"})
+        assert ctx.priority == "normal"
+
+    def test_scope_binds_and_restores(self):
+        from seldon_core_tpu.qos.context import current_qos
+
+        assert current_qos() is None
+        with qos_scope(QosContext(priority="high")):
+            assert current_qos().priority == "high"
+            with qos_scope(None):  # None passes through
+                assert current_qos().priority == "high"
+        assert current_qos() is None
+
+
+# ---- admission controller -----------------------------------------------
+
+
+class TestAdmission:
+    def test_priority_shed_order_low_first(self):
+        a = AdmissionController(AdmissionConfig(
+            target_p95_ms=50, min_limit=10, initial_limit=10))
+        # fill to 50% of the limit: low starts shedding, normal/high pass
+        for _ in range(5):
+            assert a.try_acquire("high")
+        assert not a.try_acquire("low")
+        assert a.try_acquire("normal")
+        # fill to 90%: normal sheds too, high still admitted
+        for _ in range(3):
+            assert a.try_acquire("high")
+        assert not a.try_acquire("normal")
+        assert a.try_acquire("high")
+        # full: even high sheds
+        assert not a.try_acquire("high")
+        assert a.shed_level == 3
+
+    def test_aimd_decrease_on_slow_p95_increase_on_fast(self):
+        cfg = AdmissionConfig(target_p95_ms=10, initial_limit=64, window=8)
+        a = AdmissionController(cfg)
+        for _ in range(8):
+            a.try_acquire("high")
+            a.release(0.050)  # 50ms >> 10ms target
+        assert a.limit < 64
+        shrunk = a.limit
+        for _ in range(16):
+            a.try_acquire("high")
+            a.release(0.001)  # 1ms << target
+        assert a.limit > shrunk
+
+    def test_failures_release_but_do_not_feed_aimd(self):
+        a = AdmissionController(AdmissionConfig(
+            target_p95_ms=10, initial_limit=16, window=4))
+        for _ in range(8):
+            a.try_acquire("high")
+            a.release(0.0001, ok=False)  # instant 500s
+        assert a.limit == 16        # no adjustment happened
+        assert a.inflight == 0
+
+    def test_snapshot_and_metrics(self):
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        a = AdmissionController(
+            AdmissionConfig(target_p95_ms=50, min_limit=1, initial_limit=1),
+            name="dep", metrics=reg)
+        assert a.try_acquire("high")
+        assert not a.try_acquire("low")
+        text = reg.render()
+        assert 'seldon_qos_admitted_total{deployment="dep",priority="high"} 1' \
+            in text
+        assert 'seldon_qos_shed_total{deployment="dep",priority="low"' in text
+        assert a.snapshot()["inflight"] == 1
+
+
+# ---- circuit breaker ----------------------------------------------------
+
+
+class TestBreaker:
+    def test_error_rate_trips_and_open_blocks(self):
+        b = CircuitBreaker(BreakerConfig(min_calls=4, error_threshold=0.5,
+                                         open_s=30.0))
+        for _ in range(4):
+            b.record(ok=False)
+        assert b.state == "open"
+        assert not b.allow()
+        assert b.short_circuits == 1
+
+    def test_volume_floor_before_tripping(self):
+        b = CircuitBreaker(BreakerConfig(min_calls=10))
+        for _ in range(9):
+            b.record(ok=False)
+        assert b.state == "closed"  # below the volume floor
+
+    def test_latency_outlier_ejection(self):
+        b = CircuitBreaker(BreakerConfig(
+            min_calls=4, slow_ms=10.0, slow_threshold=0.75, open_s=30.0))
+        for _ in range(4):
+            b.record(ok=True, latency_s=0.05)  # 50ms "successes"
+        assert b.state == "open"
+
+    def test_half_open_probes_then_close(self):
+        b = CircuitBreaker(BreakerConfig(min_calls=2, open_s=0.01, probes=2))
+        b.record(ok=False)
+        b.record(ok=False)
+        assert b.state == "open"
+        time.sleep(0.02)
+        assert b.state == "half_open"
+        assert b.allow() and b.allow()       # two probe slots
+        assert not b.allow()                 # third refused
+        b.record(ok=True)
+        b.record(ok=True)
+        assert b.state == "closed"
+
+    def test_half_open_failure_reopens(self):
+        b = CircuitBreaker(BreakerConfig(min_calls=2, open_s=0.01, probes=2))
+        b.record(ok=False)
+        b.record(ok=False)
+        time.sleep(0.02)
+        assert b.allow()
+        b.record(ok=False)
+        assert b.state == "open"
+
+    def test_wrapper_4xx_never_trips_5xx_does(self):
+        from seldon_core_tpu.runtime.component import SeldonComponentError
+
+        class Flaky:
+            code = 400
+
+            async def predict(self, msg):
+                raise SeldonComponentError("nope", self.code)
+
+        flaky = Flaky()
+        w = BreakerWrapper(flaky, CircuitBreaker(
+            BreakerConfig(min_calls=3, error_threshold=0.5, open_s=30.0)),
+            name="c")
+
+        async def hammer(n):
+            for _ in range(n):
+                with pytest.raises(SeldonComponentError):
+                    await w.predict(SeldonMessage())
+
+        run(hammer(6))
+        assert w.breaker.state == "closed"   # caller errors: not sickness
+        w2 = BreakerWrapper(flaky, CircuitBreaker(
+            BreakerConfig(min_calls=3, error_threshold=0.5, open_s=30.0)),
+            name="c2")
+        flaky.code = 503
+
+        async def hammer2(n):
+            for _ in range(n):
+                with pytest.raises(SeldonComponentError):
+                    await w2.predict(SeldonMessage())
+
+        run(hammer2(3))
+        assert w2.breaker.state == "open"
+        with pytest.raises(BreakerOpenError):
+            run(w2.predict(SeldonMessage()))
+
+
+# ---- batcher: EDF + budget-aware dequeue --------------------------------
+
+
+class TestDeadlineBatcher:
+    def test_edf_orders_pending_by_deadline(self):
+        from seldon_core_tpu.runtime.batcher import BatcherConfig, DynamicBatcher
+
+        batches = []
+
+        def fn(batch):
+            batches.append([float(v) for v in np.asarray(batch)[:, 0]])
+            return batch
+
+        b = DynamicBatcher(fn, BatcherConfig(max_batch_size=8,
+                                             max_delay_ms=10.0))
+
+        async def submit(tag, budget_ms):
+            ctx = (QosContext(deadline=Deadline.after_ms(budget_ms))
+                   if budget_ms else None)
+            with qos_scope(ctx):
+                out = await b(np.full((1, 2), tag, np.float32))
+            return tag, float(out[0, 0])
+
+        async def storm():
+            # enqueue the urgent request LAST and a deadline-less one
+            # FIRST — the flushed batch must still be deadline-sorted,
+            # with the deadline-less request at the tail
+            return await asyncio.gather(
+                submit(9.0, 0), submit(1.0, 10_000), submit(2.0, 5_000),
+                submit(3.0, 50),
+            )
+
+        outs = run(storm())
+        # every caller still receives its own rows back
+        assert all(tag == val for tag, val in outs)
+        # one batch, EDF order: 3 (50ms) < 2 (5s) < 1 (10s) < 9 (none)
+        assert batches == [[3.0, 2.0, 1.0, 9.0]]
+
+    def test_budget_reject_at_dequeue(self):
+        from seldon_core_tpu.runtime.batcher import (
+            BatcherConfig,
+            DeadlineExceededError,
+            DynamicBatcher,
+        )
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        b = DynamicBatcher(lambda x: x,
+                           BatcherConfig(name="t", max_batch_size=8,
+                                         max_delay_ms=1.0), metrics=reg)
+        b.latency_ewma_s = 0.050  # pretend batches take 50ms
+
+        async def doomed():
+            with qos_scope(QosContext(deadline=Deadline.after_ms(5))):
+                return await b(np.zeros((1, 2), np.float32))
+
+        async def fine():
+            with qos_scope(QosContext(deadline=Deadline.after_ms(5000))):
+                return await b(np.zeros((1, 2), np.float32))
+
+        with pytest.raises(DeadlineExceededError):
+            run(doomed())
+        out = run(fine())
+        assert out.shape == (1, 2)
+        assert 'reason="budget"' in reg.render()
+
+    def test_no_deadline_no_shedding(self):
+        from seldon_core_tpu.runtime.batcher import BatcherConfig, DynamicBatcher
+
+        b = DynamicBatcher(lambda x: x,
+                           BatcherConfig(max_batch_size=4, max_delay_ms=1.0))
+        b.latency_ewma_s = 10.0  # huge estimate, but no deadlines anywhere
+        out = run(b(np.zeros((2, 3), np.float32)))
+        assert out.shape == (2, 3)
+
+
+# ---- engine: admission, deadline, degraded mode -------------------------
+
+
+def qos_engine(spec, qos, ann=NO_BATCH, **kw):
+    return GraphEngine(
+        spec, resolver=lambda u: resolve_component(u, ann, qos=qos),
+        name="p", qos=qos, **kw)
+
+
+class TestEngineQos:
+    def test_admission_shed_is_429_with_reason(self):
+        qos = EngineQos(QosConfig(name="p", slo_p95_ms=50))
+        qos.admission.limit = 0  # force full shed (min_limit floor off)
+        qos.admission.config.min_limit = 0
+        eng = qos_engine(mlp_node("m"), qos)
+        out = run(eng.predict(pinned(X)))
+        assert out.status.code == 429
+        assert out.status.reason == "ADMISSION_SHED"
+        assert "retry after" in out.status.info
+
+    def test_expired_budget_504s_before_any_model_work(self):
+        eng = qos_engine(mlp_node("m"), None)
+        calls = []
+        orig = eng._walk
+
+        async def spy(*a, **kw):
+            calls.append(1)
+            return await orig(*a, **kw)
+
+        eng._walk = spy
+        ctx = QosContext(deadline=Deadline(time.monotonic() - 1.0))
+        with qos_scope(ctx):
+            out = run(eng.predict(pinned(X)))
+        assert out.status.code == 504
+        assert out.status.reason == "DEADLINE_EXCEEDED"
+        assert not calls
+
+    def test_deadline_bounds_walk_via_meta_tag(self):
+        class Slow:
+            def has(self, m):
+                return m == "predict"
+
+            async def predict(self, msg):
+                await asyncio.sleep(5.0)
+                return msg
+
+        eng = GraphEngine({"name": "slow", "type": "MODEL"},
+                          resolver=lambda u: Slow(), name="p")
+        msg = pinned(X)
+        stamp_meta(msg.meta, QosContext(deadline=Deadline.after_ms(80)))
+        t0 = time.perf_counter()
+        out = run(eng.predict(msg))
+        assert time.perf_counter() - t0 < 2.0
+        assert out.status.code == 504
+        assert out.status.reason == "DEADLINE_EXCEEDED"
+
+    def test_degrade_on_shed_level(self):
+        spec = {**mlp_node("big", seed=0), "children": [mlp_node("cheap",
+                                                                 seed=1)]}
+        qos = EngineQos(QosConfig(name="p", slo_p95_ms=50,
+                                  fallback_node="cheap",
+                                  degrade_shed_level=1))
+        eng = qos_engine(spec, qos)
+        # saturate low's fraction so shed_level >= 1 while slots are held
+        held = 0
+        while qos.admission.shed_level < 1:
+            assert qos.admission.try_acquire("high")
+            held += 1
+        out = run(eng.predict(pinned(X)))
+        for _ in range(held):
+            qos.admission.release(0.001)
+        assert out.meta.tags["degraded"] == "shed_level"
+        assert list(out.meta.request_path) == ["cheap"]
+
+    def test_fallback_unknown_node_raises_at_construction(self):
+        qos = EngineQos(QosConfig(name="p", fallback_node="ghost"))
+        with pytest.raises(ValueError, match="GL802"):
+            qos_engine(mlp_node("m"), qos)
+
+    def test_fallback_root_raises_at_construction(self):
+        qos = EngineQos(QosConfig(name="p", fallback_node="m"))
+        with pytest.raises(ValueError, match="GL803"):
+            qos_engine(mlp_node("m"), qos)
+
+    def test_breaker_open_routes_to_fallback_with_degraded_tag(self):
+        spec = {
+            "name": "big", "type": "MODEL",
+            "endpoint": {"service_host": "127.0.0.1", "service_port": 1,
+                         "type": "REST"},
+            "children": [mlp_node("cheap")],
+        }
+        qos = EngineQos(QosConfig(
+            name="p", fallback_node="cheap",
+            breaker=BreakerConfig(min_calls=2, error_threshold=0.5,
+                                  open_s=30.0)))
+        eng = qos_engine(spec, qos)
+
+        async def drive():
+            try:
+                # two transport failures trip the breaker (min_calls=2)...
+                for _ in range(2):
+                    out = await eng.predict(pinned(X))
+                    assert out.status.status == "FAILURE"
+                # ...and the next request degrades instead of failing
+                return await eng.predict(pinned(X))
+            finally:
+                await eng.node_impl("big").inner.close()
+
+        out = run(drive())
+        assert qos.breakers[0].state == "open"
+        assert out.status is None or out.status.status == "SUCCESS"
+        assert out.meta.tags["degraded"] == "breaker_open"
+        assert list(out.meta.request_path) == ["cheap"]
+        assert "breaker_open" in (qos.snapshot()["degraded"])
+
+
+# ---- gateway: retry budget + 429 + header propagation -------------------
+
+
+async def _gateway(engine_handler, annotations, **gw_kw):
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.gateway.app import Gateway
+    from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+
+    app = web.Application()
+    app.router.add_post("/api/v0.1/predictions", engine_handler)
+    engine = TestClient(TestServer(app))
+    await engine.start_server()
+    store = DeploymentStore()
+    store.put(DeploymentRecord(
+        name="dep1", oauth_key="key1", oauth_secret="sec1",
+        engine_url=f"http://127.0.0.1:{engine.port}",
+        annotations=annotations,
+    ))
+    gw = Gateway(store, **gw_kw)
+    client = TestClient(TestServer(gw.build_app()))
+    await client.start_server()
+    token, _ = gw.oauth.tokens.issue("key1")
+    return gw, client, engine, token
+
+
+class TestGatewayQos:
+    async def test_shed_answers_429_with_retry_after(self):
+        from aiohttp import web
+
+        async def engine(request):
+            await asyncio.sleep(0.2)
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await _gateway(
+            engine, {"seldon.io/slo-p95-ms": "50"})
+        try:
+            rec = gw.store.by_oauth_key("key1")
+            ctl = gw._dep_admission(rec)
+            assert ctl is not None
+            ctl.config.min_limit = 0
+            ctl.limit = 2  # low's fraction (0.5) admits exactly one
+            hdr = {"Authorization": f"Bearer {token}"}
+            rs = await asyncio.gather(*(
+                client.post("/api/v0.1/predictions",
+                            json={"data": {"ndarray": [[float(i)]]}},
+                            headers={**hdr, "X-Seldon-Priority": "low"})
+                for i in range(4)
+            ))
+            statuses = sorted(r.status for r in rs)
+            assert statuses[0] == 200 and statuses[-1] == 429
+            shed = [r for r in rs if r.status == 429]
+            assert all("Retry-After" in r.headers for r in shed)
+            body = await shed[0].json()
+            assert body["status"]["reason"] == "ADMISSION_SHED"
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_qos_headers_propagate_to_engine_hop(self):
+        from aiohttp import web
+
+        seen = {}
+
+        async def engine(request):
+            seen.update(request.headers)
+            return web.json_response(
+                {"status": {"code": 200, "status": "SUCCESS"}})
+
+        gw, client, eng, token = await _gateway(engine, {})
+        try:
+            await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": f"Bearer {token}",
+                         "X-Seldon-Priority": "high",
+                         "X-Seldon-Deadline-Ms": "500"})
+            assert seen["X-Seldon-Priority"] == "high"
+            # the hop stamp is the REMAINING budget, already decremented
+            assert 0 < float(seen["X-Seldon-Deadline-Ms"]) <= 500
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_retry_budget_skips_retry_when_exhausted(self):
+        """Satellite fix: connection-failure retries must fit inside the
+        request deadline — with an exhausted budget the gateway answers
+        504 instead of sleeping through backoff for a doomed retry."""
+        async def never_called(request):
+            raise AssertionError("unreachable")
+
+        gw, client, eng, token = await _gateway(never_called, {})
+        try:
+            rec = gw.store.by_oauth_key("key1")
+            rec.engine_url = "http://127.0.0.1:1"  # nothing listens
+            gw.retry_backoff_s = 0.2
+            t0 = time.perf_counter()
+            r = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": f"Bearer {token}",
+                         "X-Seldon-Deadline-Ms": "100"})
+            elapsed = time.perf_counter() - t0
+            assert r.status == 504
+            body = await r.json()
+            assert body["status"]["reason"] == "DEADLINE_EXCEEDED"
+            # no 0.2s+0.4s backoff sleeps happened
+            assert elapsed < 0.5
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+    async def test_without_deadline_retries_still_happen(self):
+        async def never_called(request):
+            raise AssertionError("unreachable")
+
+        gw, client, eng, token = await _gateway(never_called, {})
+        try:
+            rec = gw.store.by_oauth_key("key1")
+            rec.engine_url = "http://127.0.0.1:1"
+            r = await client.post(
+                "/api/v0.1/predictions", json={"data": {"ndarray": [[1]]}},
+                headers={"Authorization": f"Bearer {token}"})
+            assert r.status == 503
+            text = gw.registry.render()
+            assert "seldon_api_gateway_retries_total" in text
+        finally:
+            await client.close()
+            await eng.close()
+            await gw.close()
+
+
+# ---- graphlint GL8xx + operator admission -------------------------------
+
+
+class TestGL8xx:
+    def test_invalid_slo_gl801(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph(mlp_node("m"), {"seldon.io/slo-p95-ms": "fast"})
+        assert any(f.code == "GL801" and f.severity == "ERROR" for f in fs)
+
+    def test_unknown_fallback_gl802(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph(mlp_node("m"), {"seldon.io/qos-fallback": "ghost"})
+        assert any(f.code == "GL802" and f.severity == "ERROR" for f in fs)
+
+    def test_root_fallback_gl803(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph(mlp_node("m"), {"seldon.io/qos-fallback": "m"})
+        assert any(f.code == "GL803" for f in fs)
+
+    def test_fallback_report_and_fragility(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        spec = {**mlp_node("big"), "children": [{
+            "name": "cheap", "type": "MODEL",
+            "endpoint": {"service_host": "other-pod", "service_port": 9000},
+        }]}
+        fs = lint_graph(spec, {"seldon.io/qos-fallback": "cheap"})
+        codes = {f.code for f in fs}
+        assert "GL804" in codes          # the subtree report
+        assert "GL805" in codes          # remote fallback = fragile
+
+    def test_slo_infeasible_gl806(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        spec = mlp_node("m")
+        spec["parameters"].append(
+            {"name": "timeout_ms", "value": "200", "type": "INT"})
+        fs = lint_graph(spec, {"seldon.io/slo-p95-ms": "50"})
+        assert any(f.code == "GL806" and f.severity == "WARN" for f in fs)
+
+    def test_silent_without_annotations(self):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        fs = lint_graph(mlp_node("m"), {})
+        assert not [f for f in fs if f.code.startswith("GL8")]
+
+    def test_admission_rejects_bad_fallback(self):
+        from seldon_core_tpu.analysis.graphlint import GraphAnalysisError
+        from seldon_core_tpu.operator.compile import (
+            admission_lint,
+            qos_config,
+        )
+        from seldon_core_tpu.operator.spec import (
+            DeploymentValidationError,
+            SeldonDeployment,
+        )
+
+        dep = SeldonDeployment.from_dict({
+            "metadata": {"name": "d"},
+            "spec": {
+                "annotations": {"seldon.io/qos-fallback": "ghost"},
+                "predictors": [{"name": "main", "graph": mlp_node("m")}],
+            },
+        })
+        with pytest.raises(GraphAnalysisError) as ei:
+            admission_lint(dep)
+        assert any(f.code == "GL802" for f in ei.value.findings)
+        # the lint-off hard stop rejects too
+        with pytest.raises(DeploymentValidationError):
+            qos_config(dep, dep.predictors[0])
+
+    def test_annotation_parse_surface(self):
+        assert qos_from_annotations({}, "x") is None
+        cfg = qos_from_annotations(
+            {"seldon.io/slo-p95-ms": "25",
+             "seldon.io/qos-fallback": "n",
+             "seldon.io/qos-degrade-shed-level": "1",
+             "seldon.io/qos-breaker-min-calls": "3",
+             "seldon.io/qos-breaker-open-ms": "2500",
+             "seldon.io/qos-breaker-slow-ms": "80"}, "x")
+        assert cfg.slo_p95_ms == 25
+        assert cfg.degrade_shed_level == 1
+        assert cfg.breaker.min_calls == 3
+        assert cfg.breaker.open_s == 2.5
+        assert cfg.breaker.slow_ms == 80
+        for bad in (
+            {"seldon.io/slo-p95-ms": "0"},
+            {"seldon.io/qos-degrade-shed-level": "7",
+             "seldon.io/slo-p95-ms": "10"},
+            {"seldon.io/qos-breaker": "perhaps"},
+            {"seldon.io/slo-p95-ms": "10",
+             "seldon.io/qos-breaker-min-calls": "0"},
+        ):
+            with pytest.raises(ValueError):
+                qos_from_annotations(bad, "x")
+
+
+# ---- reconcile: status.qos ----------------------------------------------
+
+
+class TestStatusQos:
+    def test_status_gains_qos_block_from_live_runtime(self):
+        from seldon_core_tpu.operator.reconcile import (
+            FakeKubeApi,
+            SeldonDeploymentController,
+        )
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+        from seldon_core_tpu.qos import registry as qos_registry
+
+        qos_registry.clear()
+        dep_dict = {
+            "apiVersion": "machinelearning.seldon.io/v1alpha3",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "qd", "namespace": "default",
+                         "uid": "u1", "resourceVersion": "1"},
+            "spec": {
+                "annotations": {"seldon.io/slo-p95-ms": "50",
+                                "seldon.io/batching": "false"},
+                "predictors": [{"name": "main", "graph": mlp_node("m")}],
+            },
+        }
+        # boot the live runtime (publishes its QoS posture)
+        local = LocalDeployment(SeldonDeployment.from_dict(dep_dict))
+        assert local.predictors[0].qos is not None
+        api = FakeKubeApi()
+        api.create(dep_dict)
+        ctl = SeldonDeploymentController(api)
+        status = ctl.reconcile(api.get("SeldonDeployment", "default", "qd"))
+        assert "qos" in status
+        pred = status["qos"]["predictors"][0]
+        assert pred["name"] == "main"
+        assert pred["admission"]["limit"] > 0
+        assert "shedLevel" in pred
+        qos_registry.clear()
+
+    def test_status_omits_qos_without_runtime(self):
+        from seldon_core_tpu.operator.reconcile import (
+            FakeKubeApi,
+            SeldonDeploymentController,
+        )
+        from seldon_core_tpu.qos import registry as qos_registry
+
+        qos_registry.clear()
+        dep_dict = {
+            "apiVersion": "machinelearning.seldon.io/v1alpha3",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "plain", "namespace": "default",
+                         "uid": "u2", "resourceVersion": "1"},
+            "spec": {"predictors": [
+                {"name": "main", "graph": mlp_node("m")}]},
+        }
+        api = FakeKubeApi()
+        api.create(dep_dict)
+        ctl = SeldonDeploymentController(api)
+        status = ctl.reconcile(api.get("SeldonDeployment", "default",
+                                       "plain"))
+        assert "qos" not in status
+
+
+# ---- chaos burst determinism --------------------------------------------
+
+
+class TestChaosBurst:
+    def test_schedule_is_deterministic_under_seed(self):
+        from seldon_core_tpu.tools.chaos import BurstSchedule
+
+        a = BurstSchedule(7, period_ms=100, duration_ms=30)
+        b = BurstSchedule(7, period_ms=100, duration_ms=30)
+        assert a.windows_until(5.0) == b.windows_until(5.0)
+        c = BurstSchedule(8, period_ms=100, duration_ms=30)
+        assert a.windows_until(5.0) != c.windows_until(5.0)
+
+    def test_wrapper_injects_burst_latency_inside_windows(self):
+        from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+
+        class Echo:
+            async def predict(self, msg):
+                return msg
+
+        fake_now = [0.0]
+        w = ChaosWrapper(
+            Echo(),
+            ChaosPolicy(burst_latency_ms=1.0, burst_duration_ms=50.0,
+                        burst_period_ms=100.0, seed=0),
+            clock=lambda: fake_now[0],
+        )
+        # walk the pinned clock: find one instant inside and one outside
+        windows = w.bursts.windows_until(2.0)
+        assert windows
+        start, end = windows[0]
+        fake_now[0] = (start + end) / 2
+        run(w.predict(SeldonMessage()))
+        assert w.injected_bursts == 1
+        fake_now[0] = end + 1e-3
+        if not w.bursts.active(fake_now[0]):
+            run(w.predict(SeldonMessage()))
+            assert w.injected_bursts == 1  # unchanged outside a window
+
+    def test_per_call_rng_stream_unchanged_by_burst_mode(self):
+        """Burst windows draw from their own stream: the per-call
+        error/jitter draws stay byte-identical whether or not bursts are
+        configured (the seeded-repro contract)."""
+        from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+
+        class Echo:
+            async def predict(self, msg):
+                return msg
+
+        async def drive(policy):
+            w = ChaosWrapper(Echo(), policy)
+            outcomes = []
+            for _ in range(20):
+                try:
+                    await w.predict(SeldonMessage())
+                    outcomes.append("ok")
+                except Exception:
+                    outcomes.append("err")
+            return outcomes
+
+        plain = run(drive(ChaosPolicy(error_rate=0.4, seed=3)))
+        bursty = run(drive(ChaosPolicy(error_rate=0.4, seed=3,
+                                       burst_latency_ms=0.1,
+                                       burst_duration_ms=1.0,
+                                       burst_period_ms=5.0)))
+        assert plain == bursty
+
+
+# ---- overload drill (loadtest satellite) --------------------------------
+
+
+class TestOverloadDrill:
+    def test_drill_reports_per_priority_goodput(self):
+        from seldon_core_tpu.tools.loadtest import overload_drill
+
+        class Quick:
+            def has(self, m):
+                return m == "predict"
+
+            async def predict(self, msg):
+                await asyncio.sleep(0.001)
+                return SeldonMessage(data=np.ones((1, 1), np.float32))
+
+        eng = GraphEngine({"name": "m", "type": "MODEL"},
+                          resolver=lambda u: Quick(), name="p")
+        res = run(overload_drill(
+            eng.predict,
+            lambda: SeldonMessage(data=np.zeros((1, 1), np.float32)),
+            rate=200, seconds=0.5, deadline_ms=100,
+            priority_mix={"high": 0.5, "low": 0.5}, seed=1))
+        for pri in ("high", "low"):
+            p = res["priorities"][pri]
+            assert p["offered"] > 0
+            assert p["goodput"] == 1.0
+
+
+# ---- byte parity: QoS on (not triggered) == QoS off ---------------------
+
+FAST_EXAMPLES = [
+    ("iris.json", np.array([[5.1, 3.5, 1.4, 0.2]], np.float32)),
+    ("mnist.json", np.zeros((1, 784), np.float32)),
+    ("ensemble.json", np.zeros((1, 784), np.float32)),
+]
+
+
+def _pin_router_seeds(dep) -> None:
+    for p in dep.predictors:
+        for u in p.graph.walk():
+            if u.implementation in ("EPSILON_GREEDY", "RANDOM_ABTEST"):
+                u.parameters["seed"] = 0
+
+
+@pytest.mark.parametrize("plan", ["walk", "fused"])
+@pytest.mark.parametrize("fname,x", FAST_EXAMPLES,
+                         ids=[f[0] for f in FAST_EXAMPLES])
+def test_example_graph_qos_parity(fname, x, plan):
+    """With QoS enabled but never triggered (huge SLO, no bursts, no
+    breakers open), every admitted response must be byte-identical to
+    the QoS-free engine's — in walk AND fused modes."""
+    dep_plain = load_deployment_file(os.path.join(EXAMPLES, fname))
+    dep_qos = load_deployment_file(os.path.join(EXAMPLES, fname))
+    for dep in (dep_plain, dep_qos):
+        _pin_router_seeds(dep)
+        dep.annotations["seldon.io/graph-plan"] = plan
+    dep_qos.annotations["seldon.io/slo-p95-ms"] = "60000"
+    plain = LocalDeployment(dep_plain, seed=0)
+    qos = LocalDeployment(dep_qos, seed=0)
+    assert qos.predictors[0].qos is not None
+    for _ in range(2):
+        a = run(plain.predictors[0].engine.predict(pinned(x)))
+        b = run(qos.predictors[0].engine.predict(pinned(x)))
+        assert a.status is None or a.status.status == "SUCCESS", a.status
+        assert a.to_dict() == b.to_dict(), (fname, plan)
+    from seldon_core_tpu.qos import registry as qos_registry
+
+    qos_registry.clear()
