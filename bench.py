@@ -722,6 +722,256 @@ def qos_smoke() -> int:
     return 1 if failures else 0
 
 
+def trace_smoke() -> int:
+    """Fast CI gate for the tracing pipeline (CPU-only):
+    (1) one request through gateway -> engine -> node exports ONE trace
+        under the single 128-bit W3C trace ID the client supplied,
+    (2) the gateway ingress latency histogram carries that trace ID as an
+        OpenMetrics exemplar,
+    (3) a shed request exports a trace whose root span carries the shed
+        reason event,
+    (4) error and artificially-slow requests survive tail sampling at a
+        1%% head rate,
+    (5) N coalesced requests link to exactly ONE batch-execution span.
+    Returns a process exit code."""
+    import tempfile
+
+    import numpy as np
+
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.operator.local import resolve_component
+    from seldon_core_tpu.utils.tracing import (
+        FileSpanSink,
+        SpanCollector,
+        Tracer,
+    )
+
+    failures: list[str] = []
+    report: dict = {}
+    ann = {"seldon.io/batching": "false"}
+    spec = {
+        "name": "m", "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+        ],
+    }
+    x = np.zeros((1, 784), np.float32)
+    tid = "ab" * 16
+
+    def _spans(d: dict):
+        yield d
+        for c in d.get("children", []):
+            yield from _spans(c)
+
+    # -- (1)(2): gateway -> engine -> node over real sockets ----------
+    export = tempfile.mktemp(suffix=".jsonl")
+
+    async def end_to_end() -> dict:
+        import aiohttp
+        from aiohttp import web
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+        from seldon_core_tpu.serving.rest import build_app
+        from seldon_core_tpu.utils.metrics import EngineMetrics
+
+        eng_tracer = Tracer(collector=SpanCollector(
+            service="engine", sink=FileSpanSink(export)))
+        engine = GraphEngine(
+            spec, resolver=lambda u: resolve_component(u, ann),
+            name="dep-trace", tracer=eng_tracer)
+        eng_runner = web.AppRunner(
+            build_app(engine=engine, metrics=EngineMetrics()),
+            access_log=None)
+        await eng_runner.setup()
+        await web.TCPSite(eng_runner, "127.0.0.1", 0).start()
+        eng_port = eng_runner.addresses[0][1]
+
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name="dep-trace", oauth_key="k", oauth_secret="s",
+            engine_url=f"http://127.0.0.1:{eng_port}"))
+        gw = Gateway(store, tracer=Tracer(
+            collector=SpanCollector(service="gateway")))
+        gw_runner = web.AppRunner(gw.build_app(), access_log=None)
+        await gw_runner.setup()
+        await web.TCPSite(gw_runner, "127.0.0.1", 0).start()
+        base = f"http://127.0.0.1:{gw_runner.addresses[0][1]}"
+
+        out: dict = {}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"{base}/oauth/token",
+                    data={"grant_type": "client_credentials"},
+                    auth=aiohttp.BasicAuth("k", "s"),
+                ) as resp:
+                    token = (await resp.json())["access_token"]
+                async with sess.post(
+                    f"{base}/api/v0.1/predictions",
+                    json=SeldonMessage.from_ndarray(x).to_dict(),
+                    headers={
+                        "Authorization": f"Bearer {token}",
+                        "traceparent": f"00-{tid}-{'cd' * 8}-01",
+                    },
+                ) as resp:
+                    out["status"] = resp.status
+                    await resp.read()
+                async with sess.get(
+                    f"{base}/admin/traces?deployment=dep-trace"
+                ) as resp:
+                    out["admin"] = await resp.json()
+            out["gw_traces"] = gw.tracer.collector.query(n=10)
+            out["eng_traces"] = eng_tracer.collector.query(n=10)
+            out["metrics"] = gw.registry.render()
+        finally:
+            await gw.close()
+            await gw_runner.cleanup()
+            await eng_runner.cleanup()
+        return out
+
+    r = asyncio.run(end_to_end())
+    report["e2e_status"] = r["status"]
+    if r["status"] != 200:
+        failures.append(f"end-to-end predict returned HTTP {r['status']}")
+    gw_recs, eng_recs = r["gw_traces"], r["eng_traces"]
+    report["gw_traces"] = len(gw_recs)
+    report["eng_traces"] = len(eng_recs)
+    if len(gw_recs) != 1 or gw_recs[0]["trace_id"] != tid:
+        failures.append(
+            f"gateway collected {[t['trace_id'] for t in gw_recs]}, "
+            f"expected exactly the client-supplied trace ID {tid}")
+    if len(eng_recs) != 1 or eng_recs[0]["trace_id"] != tid:
+        failures.append(
+            f"engine collected {[t['trace_id'] for t in eng_recs]}, "
+            f"expected exactly the client-supplied trace ID {tid}")
+    if eng_recs:
+        node = [s for s in _spans(eng_recs[0]["root"])
+                if s.get("name") == "m"]
+        if not node or node[0].get("trace_id") != tid:
+            failures.append("engine trace has no node span 'm' under the "
+                            "propagated trace ID")
+        if not eng_recs[0]["root"].get("parent_span_id"):
+            failures.append("engine root span has no parent — the gateway "
+                            "hop did not propagate its span context")
+    if f'trace_id="{tid}"' not in r["metrics"]:
+        failures.append("gateway ingress histogram has no OpenMetrics "
+                        "exemplar carrying the request's trace ID")
+    admin = r.get("admin", {})
+    if not admin.get("traces") or admin["traces"][0]["trace_id"] != tid:
+        failures.append(f"/admin/traces did not return the trace: {admin}")
+    try:
+        with open(export) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        exported_tids = {
+            sp["traceId"]
+            for env in lines
+            for rs in env["resourceSpans"]
+            for ss in rs["scopeSpans"]
+            for sp in ss["spans"]
+        }
+        report["exported_traces"] = len(lines)
+        if tid not in exported_tids:
+            failures.append("OTLP export file does not contain the trace")
+        from seldon_core_tpu.tools.traceview import load_traces
+        with open(export) as f:
+            if not load_traces(f):
+                failures.append("traceview cannot parse the OTLP export")
+    except (OSError, ValueError, KeyError) as e:
+        failures.append(f"OTLP export file unreadable: {e}")
+
+    # -- (3): shed request exports a trace with the shed reason -------
+    from seldon_core_tpu.qos import EngineQos, QosConfig
+
+    shed_tracer = Tracer(sample_rate=0.01,
+                         collector=SpanCollector(service="engine"))
+    qos = EngineQos(QosConfig(name="t", slo_p95_ms=100.0))
+    eng2 = GraphEngine(spec, resolver=lambda u: resolve_component(u, ann),
+                       name="t", tracer=shed_tracer, qos=qos)
+    qos.admission.inflight = 10 ** 6  # saturate: next acquire must shed
+    out = asyncio.run(eng2.predict(SeldonMessage.from_ndarray(x)))
+    code = out.status.code if out.status is not None else 200
+    shed_recs = shed_tracer.collector.query(status="error", n=5)
+    report["shed_status"] = code
+    report["shed_traces"] = len(shed_recs)
+    if code != 429:
+        failures.append(f"saturated admission answered {code}, not 429")
+    shed_events = [
+        ev for rec in shed_recs for ev in rec["root"].get("events", [])
+        if ev.get("name") == "shed"
+        and ev.get("attributes", {}).get("reason") == "ADMISSION_SHED"
+    ]
+    if not shed_events:
+        failures.append("shed request did not export a trace whose root "
+                        "span carries the shed reason event")
+
+    # -- (4): error + slow traces survive 1% head sampling ------------
+    from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+
+    tail_tracer = Tracer(sample_rate=0.01, collector=SpanCollector(
+        service="engine", slow_ms=50.0))
+    err_eng = GraphEngine(
+        spec,
+        resolver=lambda u: ChaosWrapper(resolve_component(u, ann),
+                                        ChaosPolicy(error_rate=1.0, seed=0)),
+        name="t2", tracer=tail_tracer)
+    asyncio.run(err_eng.predict(SeldonMessage.from_ndarray(x)))
+    slow_eng = GraphEngine(
+        spec,
+        resolver=lambda u: ChaosWrapper(resolve_component(u, ann),
+                                        ChaosPolicy(latency_ms=80.0, seed=0)),
+        name="t2", tracer=tail_tracer)
+    asyncio.run(slow_eng.predict(SeldonMessage.from_ndarray(x)))
+    tail = tail_tracer.collector.stats()
+    report["tail_sampling"] = tail
+    if tail["offered"] != 2 or tail["kept_head"] + tail["kept_tail"] != 2:
+        failures.append(
+            f"error/slow traces did not survive 1%% head sampling: {tail}")
+
+    # -- (5): N coalesced requests -> links to ONE batch span ---------
+    from seldon_core_tpu.runtime.batcher import BatcherConfig
+
+    b_tracer = Tracer(collector=SpanCollector(service="engine"))
+    beng = GraphEngine(
+        spec, resolver=lambda u: resolve_component(u, ann), name="b",
+        plan_mode="fused", tracer=b_tracer,
+        plan_batcher=BatcherConfig(max_batch_size=8, max_delay_ms=25.0))
+
+    async def fan_out():
+        rng = np.random.default_rng(0)
+        msgs = [SeldonMessage.from_ndarray(
+            rng.normal(size=(1, 784)).astype(np.float32)) for _ in range(6)]
+        await asyncio.gather(*(beng.predict(m) for m in msgs))
+
+    asyncio.run(fan_out())
+    recs = b_tracer.collector.query(n=50)
+    batch = [rec for rec in recs
+             if rec["root"]["name"].startswith("batch:")]
+    reqs = {rec["trace_id"] for rec in recs
+            if not rec["root"]["name"].startswith("batch:")}
+    report["batch_spans"] = len(batch)
+    report["batch_links"] = sum(
+        len(rec["root"].get("links", [])) for rec in batch)
+    if len(batch) != 1:
+        failures.append(f"6 coalesced requests produced {len(batch)} batch "
+                        "spans, expected exactly 1")
+    else:
+        linked = {ln["trace_id"] for ln in batch[0]["root"].get("links", [])}
+        if linked != reqs or len(linked) != 6:
+            failures.append(
+                f"batch span links {len(linked)} traces, expected links to "
+                f"all 6 member request traces")
+
+    print(json.dumps({"trace_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 RESNET50_GFLOPS = 8.2  # fwd FLOPs per 224x224 image: 4.1 GMACs x 2 FLOPs/MAC
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak, TPU v5e
 
@@ -2007,6 +2257,14 @@ def main() -> None:
                          "unthrottled path (walk+fused), breaker-open "
                          "traffic degrades to the qos-fallback subgraph; "
                          "then exit")
+    ap.add_argument("--trace-smoke", action="store_true",
+                    help="fast CI gate: one request through gateway -> "
+                         "engine -> node exports one trace under a single "
+                         "W3C trace ID with an OpenMetrics exemplar on the "
+                         "ingress histogram; shed traces carry the shed "
+                         "reason; error/slow traces survive 1%% head "
+                         "sampling; batched requests link to exactly one "
+                         "batch span; then exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -2016,6 +2274,8 @@ def main() -> None:
         sys.exit(cache_smoke())
     if args.qos_smoke:
         sys.exit(qos_smoke())
+    if args.trace_smoke:
+        sys.exit(trace_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice

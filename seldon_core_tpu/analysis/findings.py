@@ -18,6 +18,9 @@ greps, and operator status all key on it), a severity, the unit path or
   ``seldon.io/qos-*`` annotation validation, fallback-subgraph
   resolution and robustness against the signature registry, SLO
   feasibility vs per-node budgets)
+- ``GL9xx`` — tracing admission (``seldon.io/tracing`` /
+  ``seldon.io/trace-*`` annotation validation, knobs set while the
+  subsystem is off, effective-config report)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -65,6 +68,9 @@ QOS_FALLBACK_IS_ROOT = "GL803"      # qos-fallback names the graph root
 QOS_FALLBACK_REPORT = "GL804"       # qos report: the fallback subtree
 QOS_FALLBACK_FRAGILE = "GL805"      # fallback subtree itself remote/unproven
 QOS_SLO_INFEASIBLE = "GL806"        # node budgets cannot fit the p95 SLO
+TRACE_ANNOTATION_INVALID = "GL901"  # seldon.io/trace-* value invalid
+TRACE_KNOBS_WITHOUT_TRACING = "GL902"  # trace-* knobs set, tracing off
+TRACE_CONFIG_REPORT = "GL903"       # trace report: effective config
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -104,6 +110,9 @@ CODE_SEVERITY = {
     QOS_FALLBACK_REPORT: INFO,
     QOS_FALLBACK_FRAGILE: WARN,
     QOS_SLO_INFEASIBLE: WARN,
+    TRACE_ANNOTATION_INVALID: ERROR,
+    TRACE_KNOBS_WITHOUT_TRACING: WARN,
+    TRACE_CONFIG_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

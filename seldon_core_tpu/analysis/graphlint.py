@@ -57,6 +57,9 @@ from seldon_core_tpu.analysis.findings import (
     ROUTER_NO_CHILDREN,
     SHAPE_MISMATCH,
     SPEC_INVALID,
+    TRACE_ANNOTATION_INVALID,
+    TRACE_CONFIG_REPORT,
+    TRACE_KNOBS_WITHOUT_TRACING,
     UNKNOWN_SIGNATURE,
     Finding,
     errors,
@@ -163,6 +166,7 @@ def lint_graph(
         findings.extend(_plan_pass(unit, ann, path_prefix))
         findings.extend(_cache_pass(unit, ann, path_prefix))
         findings.extend(_qos_pass(unit, ann, path_prefix))
+        findings.extend(_trace_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -842,6 +846,56 @@ def _qos_pass(root: PredictiveUnit, ann: dict,
                 "unreachable p95",
             ))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# tracing admission pass (GL9xx)
+# ---------------------------------------------------------------------------
+
+def _trace_pass(root: PredictiveUnit, ann: dict,
+                prefix: str) -> list[Finding]:
+    """Tracing admission (GL9xx, active when any ``seldon.io/tracing`` /
+    ``seldon.io/trace-*`` annotation is set): validates the annotation
+    values through the same parser the operator and engine use (GL901 —
+    an out-of-range ``trace-sample`` or non-numeric ``trace-slow-ms``
+    rejects here, before a deployment ships with silently-disabled
+    observability), warns when trace knobs are set while the subsystem
+    itself is off (GL902), and reports the effective head/tail sampling
+    configuration (GL903)."""
+    from seldon_core_tpu.utils.tracing import (
+        EXPORT_ANNOTATION,
+        SAMPLE_ANNOTATION,
+        SLOW_MS_ANNOTATION,
+        TRACING_ANNOTATION,
+        TRACING_MAX_ANNOTATION,
+        trace_config_from_annotations,
+    )
+
+    family = {TRACING_ANNOTATION, TRACING_MAX_ANNOTATION,
+              SAMPLE_ANNOTATION, EXPORT_ANNOTATION, SLOW_MS_ANNOTATION}
+    trace_keys = [k for k in ann if k in family]
+    if not trace_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = trace_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(TRACE_ANNOTATION_INVALID, path0, str(e))]
+    if not cfg.enabled:
+        knobs = sorted(k for k in trace_keys if k != TRACING_ANNOTATION)
+        if knobs:
+            return [make_finding(
+                TRACE_KNOBS_WITHOUT_TRACING, path0,
+                f"{', '.join(knobs)} set but {TRACING_ANNOTATION} is not "
+                "enabled — the knobs have no effect",
+            )]
+        return []
+    detail = (f"tracing on: head sample rate {cfg.sample_rate:g}; tail "
+              f"keeps error traces and traces >= {cfg.slow_ms:g}ms; "
+              f"ring {cfg.max_traces}")
+    if cfg.export_path:
+        detail += f"; OTLP JSON-lines export -> {cfg.export_path}"
+    return [make_finding(TRACE_CONFIG_REPORT, path0, detail)]
 
 
 def _join(prefix: str, name: str) -> str:

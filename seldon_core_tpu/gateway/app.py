@@ -12,6 +12,7 @@ port with a channel cache per deployment
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 import time
@@ -38,8 +39,29 @@ from seldon_core_tpu.qos import (
 from seldon_core_tpu.qos.admission import AdmissionConfig
 from seldon_core_tpu.qos.context import forward_headers
 from seldon_core_tpu.utils.metrics import MetricsRegistry
+from seldon_core_tpu.utils.tracing import (
+    FileSpanSink,
+    SpanCollector,
+    Tracer,
+    current_trace,
+    trace_config_from_annotations,
+    trace_from_headers,
+    trace_headers,
+    trace_scope,
+)
 
 logger = logging.getLogger(__name__)
+
+
+def _shed_reason(body: bytes) -> str:
+    """Best-effort extraction of the FAILURE reason from an error body so
+    the shed event on the gateway root span carries it (ADMISSION_SHED,
+    DEADLINE_EXCEEDED, ...)."""
+    try:
+        d = json.loads(body)
+        return str(d["status"]["reason"])
+    except Exception:
+        return "UNKNOWN"
 
 WATCH_INTERVAL_S = 5.0  # reference @Scheduled(fixedDelay=5000)
 
@@ -53,6 +75,7 @@ class Gateway:
         registry: Optional[MetricsRegistry] = None,
         retries: int = 2,
         retry_backoff_s: float = 0.05,
+        tracer: Optional[Tracer] = None,
     ):
         self.store = store
         # SELDON_TOKEN_SIGNING_KEY (chart Secret) selects stateless signed
@@ -80,6 +103,30 @@ class Gateway:
         # Retry-After, in microseconds (the shed path never queues).
         # Keyed like _caches; rebuilt when the annotation changes.
         self._admission: dict[str, tuple[float, Optional[AdmissionController]]] = {}
+        # Distributed tracing (docs/observability.md): the gateway is the
+        # ingress — it accepts inbound W3C traceparent or mints a fresh
+        # 128-bit context with the head-sampling decision, opens the root
+        # "gateway" span, and stamps the context onto the engine hop.
+        # Env knobs: SELDON_TRACING / SELDON_TRACE_SAMPLE /
+        # SELDON_TRACE_EXPORT.  Served from /admin/traces.
+        if tracer is not None:
+            self.tracer: Optional[Tracer] = tracer
+        else:
+            self.tracer = None
+            try:
+                tcfg = trace_config_from_annotations({}, "gateway")
+            except ValueError as e:
+                logger.warning("tracing disabled (bad env config): %s", e)
+                tcfg = None
+            if tcfg is not None and tcfg.enabled:
+                sink = (FileSpanSink(tcfg.export_path)
+                        if tcfg.export_path else None)
+                self.tracer = Tracer(
+                    max_traces=tcfg.max_traces,
+                    sample_rate=tcfg.sample_rate,
+                    collector=SpanCollector(service="gateway",
+                                            slow_ms=tcfg.slow_ms, sink=sink),
+                )
 
     # ------------------------------------------------------------------
     # shared forwarding client (pooled, apife parity: 150 conns)
@@ -119,6 +166,7 @@ class Gateway:
         app.router.add_get("/live", self._handle_ready)
         app.router.add_get("/metrics", self._handle_metrics)
         app.router.add_get("/seldon.json", self._handle_openapi)
+        app.router.add_get("/admin/traces", self._handle_traces)
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -159,6 +207,14 @@ class Gateway:
             self._dep_admission(rec) if path.endswith("/predictions")
             else None
         )
+        # Tracing: accept the client's W3C context or mint one (head
+        # sampling decided here, at ingress).  The gateway root span wraps
+        # the whole forward — admission shed, cache hit, engine hop — so a
+        # single trace explains what the stack did to the request.
+        tctx = None
+        if self.tracer is not None:
+            tctx = (trace_from_headers(request.headers)
+                    or self.tracer.new_context())
         # Prediction cache (annotation seldon.io/prediction-cache on the
         # deployment record): a byte-identical repeat of a /predictions
         # body never re-traverses gateway→engine→model; concurrent
@@ -169,60 +225,81 @@ class Gateway:
         # slot — they cost no engine work, so refusing (or charging) them
         # under overload would throw away the cheapest capacity there is.
         cache_state: Optional[str] = None
-        cache = (
-            self._dep_cache(rec) if path.endswith("/predictions") else None
-        )
-        if cache is not None:
-            key = raw_key(rec.name, path, body)
-            hit = cache.get(key)
-            if hit is not None:
-                out_status, out_body = hit
-                cache_state = "hit"
-            else:
-
-                async def compute():
-                    st, bd = await self._admitted_forward(
-                        rec, path, body, content_type, qctx, admission
-                    )
-                    if st == 200:
-                        cache.put(key, (st, bd), len(bd) + len(key))
-                    return st, bd
-
-                (out_status, out_body), coalesced = await self._flight.run(
-                    key, compute
-                )
-                if coalesced:
-                    cache.note_coalesced(1)
-                    cache_state = "coalesced"
-                else:
-                    cache_state = "miss"
-        else:
-            out_status, out_body = await self._admitted_forward(
-                rec, path, body, content_type, qctx, admission
+        with contextlib.ExitStack() as stack:
+            root = None
+            if tctx is not None:
+                stack.enter_context(trace_scope(tctx))
+                root = stack.enter_context(self.tracer.trace(
+                    tctx.trace_id, name="gateway",
+                    deployment=rec.name, path=path,
+                ))
+            cache = (
+                self._dep_cache(rec) if path.endswith("/predictions")
+                else None
             )
-        if path.endswith("/predictions") and not isinstance(
-            self.firehose, NullFirehose
-        ):
-            # parse only for the firehose, never on the forward path, and
-            # publish off the event loop — fire-and-forget like the
-            # reference's 20ms-max-block Kafka send
-            # (apife RestClientController.java:165)
-            def _publish(principal=principal, body=body, out_body=out_body):
-                try:
-                    self.firehose.publish(
-                        principal, json.loads(body), json.loads(out_body)
-                    )
-                except Exception:
-                    logger.exception("firehose publish failed")
+            if cache is not None:
+                key = raw_key(rec.name, path, body)
+                hit = cache.get(key)
+                if hit is not None:
+                    out_status, out_body = hit
+                    cache_state = "hit"
+                else:
 
-            asyncio.get_running_loop().run_in_executor(None, _publish)
-        # apife metric parity: seldon_api_server_ingress_* timer tagged by
-        # deployment (metrics/AuthorizedWebMvcTagsProvider.java)
-        self.registry.observe(
-            "seldon_api_server_ingress_seconds",
-            time.perf_counter() - t0,
-            {"deployment": rec.name, "path": path},
-        )
+                    async def compute():
+                        st, bd = await self._admitted_forward(
+                            rec, path, body, content_type, qctx, admission
+                        )
+                        if st == 200:
+                            cache.put(key, (st, bd), len(bd) + len(key))
+                        return st, bd
+
+                    (out_status, out_body), coalesced = await self._flight.run(
+                        key, compute
+                    )
+                    if coalesced:
+                        cache.note_coalesced(1)
+                        cache_state = "coalesced"
+                    else:
+                        cache_state = "miss"
+            else:
+                out_status, out_body = await self._admitted_forward(
+                    rec, path, body, content_type, qctx, admission
+                )
+            if path.endswith("/predictions") and not isinstance(
+                self.firehose, NullFirehose
+            ):
+                # parse only for the firehose, never on the forward path, and
+                # publish off the event loop — fire-and-forget like the
+                # reference's 20ms-max-block Kafka send
+                # (apife RestClientController.java:165)
+                def _publish(principal=principal, body=body, out_body=out_body):
+                    try:
+                        self.firehose.publish(
+                            principal, json.loads(body), json.loads(out_body)
+                        )
+                    except Exception:
+                        logger.exception("firehose publish failed")
+
+                asyncio.get_running_loop().run_in_executor(None, _publish)
+            # apife metric parity: seldon_api_server_ingress_* timer tagged
+            # by deployment (metrics/AuthorizedWebMvcTagsProvider.java).
+            # Observed INSIDE the trace scope so the latency histogram
+            # attaches this trace's ID as its OpenMetrics exemplar.
+            self.registry.observe(
+                "seldon_api_server_ingress_seconds",
+                time.perf_counter() - t0,
+                {"deployment": rec.name, "path": path},
+            )
+            if root is not None:
+                if cache_state:
+                    root.attributes["cache"] = cache_state
+                if out_status >= 400:
+                    root.status = f"ERROR: HTTP_{out_status}"
+                    if out_status in (429, 503, 504):
+                        root.add_event(
+                            "shed", reason=_shed_reason(out_body),
+                            status=out_status,
+                        )
         headers: dict[str, str] = {}
         if cache_state:
             headers["X-Seldon-Cache"] = cache_state
@@ -321,6 +398,9 @@ class Gateway:
             kwargs = {}
             if qctx is not None:
                 hop_headers.update(forward_headers(qctx))
+            # W3C context propagation: the gateway root span (ambient via
+            # trace_scope in _forward) parents the engine hop
+            hop_headers.update(trace_headers(current_trace()))
             if deadline is not None:
                 rem = deadline.remaining_s()
                 if rem <= 0:
@@ -542,6 +622,40 @@ class Gateway:
     async def _handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(
             text=self.registry.render(), content_type="text/plain"
+        )
+
+    async def _handle_traces(self, request: web.Request) -> web.Response:
+        """Collected-trace query endpoint: filter exported traces by
+        deployment / status / min duration / drill id.
+
+        ``GET /admin/traces?deployment=d&status=error&min_ms=50&drill=x&n=20``
+        """
+        collector = getattr(self.tracer, "collector", None)
+        if collector is None:
+            return web.json_response(
+                {"error": "tracing disabled",
+                 "hint": "set SELDON_TRACING=true on the gateway"},
+                status=404,
+            )
+        q = request.query
+        if "stats" in q:
+            return web.json_response({"collector": collector.stats()})
+        try:
+            min_ms = float(q["min_ms"]) if "min_ms" in q else None
+            n = int(q.get("n", "50"))
+        except ValueError:
+            return web.json_response(
+                {"error": "min_ms and n must be numeric"}, status=400
+            )
+        traces = collector.query(
+            deployment=q.get("deployment"),
+            status=q.get("status"),
+            min_duration_ms=min_ms,
+            drill=q.get("drill"),
+            n=n,
+        )
+        return web.json_response(
+            {"traces": traces, "stats": collector.stats()}
         )
 
     # ------------------------------------------------------------------

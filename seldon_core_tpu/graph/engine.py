@@ -124,6 +124,12 @@ class GraphEngine:
                     name, self.plan.boundaries,
                 )
                 self.plan = None
+            else:
+                # segment batchers emit batch-execution spans (linked to
+                # each coalesced request's trace) through this tracer
+                for seg in self.plan.segments:
+                    if seg.batcher is not None:
+                        seg.batcher.tracer = self.tracer
         # prediction cache (caching/store.py PredictionCache, annotation
         # seldon.io/prediction-cache): walk mode memoises maximal
         # deterministic-pure subtrees; plan mode caches per fused segment
@@ -212,10 +218,29 @@ class GraphEngine:
             qos_scope,
             stamp_meta,
         )
+        from seldon_core_tpu.utils.tracing import (
+            current_trace,
+            stamp_trace_meta,
+            trace_from_meta,
+            trace_scope,
+        )
 
         meta = request.meta.copy()
         if not meta.puid:
             meta.puid = new_puid()
+        # Trace context: wire channel (meta tags / inbound traceparent bound
+        # by the REST layer) wins; else mint one with the head-sampling
+        # decision.  The trace ID derives from the puid (already 128-bit
+        # hex), so walk and fused-plan executions of one request stamp
+        # identical trace-id tags — response parity holds.  Restamped onto
+        # BOTH metas: request.meta so remote hops join the trace, the
+        # response meta so callers can deep-link the trace that served them.
+        tctx = trace_from_meta(request.meta) or current_trace()
+        if tctx is None and self.tracer.enabled:
+            tctx = self.tracer.new_context(trace_hint=meta.puid)
+        if tctx is not None:
+            stamp_trace_meta(request.meta, tctx)
+            stamp_trace_meta(meta, tctx)
         # QoS context: the wire channel (meta tags, stamped by the
         # gateway/REST layer) wins; in-process callers inherit the ambient
         # contextvar.  Restamped onto the request so remote hops see the
@@ -238,6 +263,18 @@ class GraphEngine:
         if admission is not None:
             pri = qctx.priority if qctx is not None else "normal"
             if not admission.try_acquire(pri):
+                if self.tracer.enabled:
+                    # shed requests still get a (tiny) trace: the root
+                    # span carries the shed reason event, and the error
+                    # status makes it survive tail sampling
+                    with trace_scope(tctx), self.tracer.trace(
+                        meta.puid, graph=self.name
+                    ) as root:
+                        root.status = "ERROR: ADMISSION_SHED"
+                        root.add_event(
+                            "shed", reason="ADMISSION_SHED", priority=pri,
+                            limit=admission.limit,
+                        )
                 return SeldonMessage(
                     status=Status.failure(
                         429,
@@ -251,7 +288,7 @@ class GraphEngine:
         t0 = time.perf_counter()
         ok = False
         try:
-            with qos_scope(qctx):
+            with trace_scope(tctx), qos_scope(qctx):
                 out = await self._predict_qos(request, meta, qctx)
             ok = out.status is None or out.status.status == "SUCCESS"
         finally:
@@ -277,12 +314,14 @@ class GraphEngine:
             else None
         )
         try:
-            with self.tracer.trace(meta.puid, graph=self.name):
+            with self.tracer.trace(meta.puid, graph=self.name) as root_sp:
                 if degrade is not None:
                     # degraded-mode serving: the primary subgraph is sick
                     # (breaker open) or shedding past the configured level
                     # — serve the cheap fallback subtree and say so
                     meta.tags[DEGRADED_TAG] = degrade
+                    if self.tracer.enabled:
+                        root_sp.add_event("degraded", reason=degrade)
                     reg = getattr(self.metrics, "registry", None)
                     if reg is not None:
                         reg.counter_inc(
@@ -299,6 +338,12 @@ class GraphEngine:
                         coro, timeout_s
                     )
                     if timed_out:
+                        if self.tracer.enabled:
+                            root_sp.status = "ERROR: DEADLINE_EXCEEDED"
+                            root_sp.add_event(
+                                "shed", reason="DEADLINE_EXCEEDED",
+                                timeout_s=timeout_s,
+                            )
                         return SeldonMessage(
                             status=Status.failure(
                                 504,
@@ -416,15 +461,21 @@ class GraphEngine:
         # 1. transformInput: MODEL.predict / TRANSFORMER.transform_input
         #    (type→method map, PredictorConfigBean.java:45-99)
         t0 = time.perf_counter()
-        if node.type == "MODEL":
-            transformed = await _maybe_await(impl.predict(msg))
-        elif node.type in ("TRANSFORMER",):
-            transformed = await _maybe_await(impl.transform_input(msg))
-        elif node.type == "OUTPUT_TRANSFORMER" and not node.children:
-            # leaf OUTPUT_TRANSFORMER: apply here or it would never run
-            transformed = await _maybe_await(impl.transform_output(msg))
-        else:
-            transformed = msg  # ROUTER/COMBINER/OUTPUT_TRANSFORMER descend as-is
+        try:
+            if node.type == "MODEL":
+                transformed = await _maybe_await(impl.predict(msg))
+            elif node.type in ("TRANSFORMER",):
+                transformed = await _maybe_await(impl.transform_input(msg))
+            elif node.type == "OUTPUT_TRANSFORMER" and not node.children:
+                # leaf OUTPUT_TRANSFORMER: apply here or it would never run
+                transformed = await _maybe_await(impl.transform_output(msg))
+            else:
+                transformed = msg  # ROUTER/COMBINER/OUTPUT_TRANSFORMER descend as-is
+        except BaseException:
+            # a raising node must still report its elapsed time — error
+            # latency was invisible before (no way to measure error p99)
+            self._observe(unit.name, time.perf_counter() - t0, status="error")
+            raise
         if transformed is not msg:
             self._merge_meta(meta, transformed, unit.name, time.perf_counter() - t0)
         else:
@@ -463,7 +514,12 @@ class GraphEngine:
         #    (PredictiveUnitBean.java:234-245)
         if node.type == "COMBINER":
             t0 = time.perf_counter()
-            merged = await _maybe_await(impl.aggregate(child_outputs))
+            try:
+                merged = await _maybe_await(impl.aggregate(child_outputs))
+            except BaseException:
+                self._observe(unit.name, time.perf_counter() - t0,
+                              status="error")
+                raise
             self._merge_meta(meta, merged, unit.name, time.perf_counter() - t0)
         else:
             merged = child_outputs[0]
@@ -471,7 +527,12 @@ class GraphEngine:
         # 6. transformOutput (OUTPUT_TRANSFORMER)
         if node.type == "OUTPUT_TRANSFORMER":
             t0 = time.perf_counter()
-            new = await _maybe_await(impl.transform_output(merged))
+            try:
+                new = await _maybe_await(impl.transform_output(merged))
+            except BaseException:
+                self._observe(unit.name, time.perf_counter() - t0,
+                              status="error")
+                raise
             if new is not merged:
                 self._merge_meta(meta, new, unit.name, time.perf_counter() - t0)
             merged = new
@@ -493,9 +554,16 @@ class GraphEngine:
         out.meta = Meta()  # consumed
         self._observe(node_name, elapsed)
 
-    def _observe(self, node_name: str, elapsed: float) -> None:
+    def _observe(self, node_name: str, elapsed: float,
+                 status: str = "ok") -> None:
         if self.metrics is not None:
-            self.metrics.observe_node(self.name, node_name, elapsed)
+            try:
+                self.metrics.observe_node(self.name, node_name, elapsed,
+                                          status=status)
+            except TypeError:
+                # duck-typed sink without the status kwarg (pre-existing
+                # custom sinks) — drop the label, keep the observation
+                self.metrics.observe_node(self.name, node_name, elapsed)
 
     # ------------------------------------------------------------------
     # prediction cache (walk mode): maximal-subtree memoisation
@@ -656,11 +724,41 @@ class GraphEngine:
         return self._segment_entry(entry, interior)
 
     async def _dispatch_segment(self, seg: Any, x: Any, in_names) -> tuple:
-        with self.tracer.span(seg.label, kind="FUSED_SEGMENT"):
-            if seg.batcher is not None:
-                y = await seg.batcher(x)
-            else:
-                y = seg(x)
+        from seldon_core_tpu.utils.tracing import profile_annotation
+
+        traced = self.tracer.enabled
+        with self.tracer.span(seg.label, kind="FUSED_SEGMENT") as sp:
+            calls_before = getattr(seg, "n_calls", 0)
+            t0 = time.perf_counter()
+            with profile_annotation(f"seldon.segment.{seg.label}"):
+                if seg.batcher is not None:
+                    y = await seg.batcher(x)
+                else:
+                    y = seg(x)
+            t_dispatch = time.perf_counter() - t0
+            if traced:
+                # host/device attribution: jax dispatch is async — the call
+                # above returns a future in host time; the block below
+                # measures the residual device time.  Only paid on traced
+                # requests (the untraced hot path keeps full pipelining).
+                t1 = time.perf_counter()
+                try:
+                    import jax
+
+                    jax.block_until_ready(y)
+                except Exception:
+                    pass  # numpy result / non-jax batcher output
+                sp.attributes.update(
+                    host_dispatch_ms=round(t_dispatch * 1e3, 4),
+                    device_block_ms=round(
+                        (time.perf_counter() - t1) * 1e3, 4
+                    ),
+                    dispatch_count=getattr(seg, "n_calls", 0),
+                    compile_cache_hit=calls_before > 0,
+                    members=",".join(
+                        s.name for s in getattr(seg, "members", ())
+                    ),
+                )
             names = seg.out_names(x, in_names)
         return y, list(names)
 

@@ -319,6 +319,23 @@ def qos_config(dep: SeldonDeployment, p: PredictorSpec):
     return cfg
 
 
+def trace_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/tracing`` / ``seldon.io/trace-*`` annotations → a
+    validated :class:`~seldon_core_tpu.utils.tracing.TraceConfig` (or None
+    when tracing is off).  Invalid values — a sample rate outside [0, 1],
+    a non-numeric slow-ms bar, a bad ring size — reject at admission;
+    graphlint's GL9xx pass reports the same defects, this is the hard stop
+    for callers that skip linting."""
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+    from seldon_core_tpu.utils.tracing import trace_config_from_annotations
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return trace_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

@@ -17,6 +17,7 @@ Two jobs:
 from __future__ import annotations
 
 import importlib
+import logging
 import os
 import random
 from typing import Any, Optional
@@ -32,6 +33,8 @@ from seldon_core_tpu.operator.spec import (
 from seldon_core_tpu.runtime.batcher import BatchedModel, BatcherConfig
 from seldon_core_tpu.runtime.component import ComponentHandle, load_component
 from seldon_core_tpu.utils.metrics import EngineMetrics, MetricsRegistry
+
+logger = logging.getLogger(__name__)
 
 
 def resolve_component(
@@ -151,9 +154,13 @@ class LocalPredictor:
             graph_plan_mode,
             prediction_cache_config,
             qos_config,
+            trace_config,
         )
 
         plan_mode = graph_plan_mode(dep, pred)
+        # validates the seldon.io/trace-* family at admission (hard stop
+        # mirroring cache/qos); the tracer itself is built further down
+        trace_config(dep, pred)
         # fused segments batch END-TO-END: the whole segment is the
         # batched callable, so one device dispatch serves a cross-request
         # batch through every fused node (walk mode batches per MODEL)
@@ -206,15 +213,35 @@ class LocalPredictor:
 
 
 def _tracer_from_config(ann: dict):
-    """Tracing knob: annotation ``seldon.io/tracing`` ("true"/"1") or env
-    ``SELDON_TRACING=1``; ``seldon.io/tracing-max`` caps the ring."""
-    flag = str(ann.get("seldon.io/tracing",
-                       os.environ.get("SELDON_TRACING", ""))).lower()
-    if flag not in ("1", "true", "yes"):
-        return None
-    from seldon_core_tpu.utils.tracing import Tracer
+    """Tracing knobs: ``seldon.io/tracing`` turns the subsystem on
+    (env fallback ``SELDON_TRACING``); ``seldon.io/trace-sample`` sets the
+    head-sampling rate, ``seldon.io/trace-export`` an OTLP JSON-lines sink
+    path, ``seldon.io/trace-slow-ms`` the tail-sampling slow-outlier bar,
+    ``seldon.io/tracing-max`` the ring size.  Values were validated at
+    admission (compile.trace_config / graphlint GL901); a bad value that
+    still reaches here disables tracing with a warning rather than failing
+    the deployment start."""
+    from seldon_core_tpu.utils.tracing import (
+        FileSpanSink,
+        SpanCollector,
+        Tracer,
+        trace_config_from_annotations,
+    )
 
-    return Tracer(max_traces=int(ann.get("seldon.io/tracing-max", 256)))
+    try:
+        cfg = trace_config_from_annotations(ann, "local-deploy")
+    except ValueError as e:
+        logger.warning("tracing disabled (bad config): %s", e)
+        return None
+    if cfg is None or not cfg.enabled:
+        return None
+    sink = FileSpanSink(cfg.export_path) if cfg.export_path else None
+    return Tracer(
+        max_traces=cfg.max_traces,
+        sample_rate=cfg.sample_rate,
+        collector=SpanCollector(service="engine", slow_ms=cfg.slow_ms,
+                                sink=sink),
+    )
 
 
 class LocalDeployment:
@@ -259,6 +286,18 @@ class LocalDeployment:
             if r <= acc:
                 return p
         return self.predictors[-1]
+
+    @property
+    def tracer(self):
+        """First traced predictor's tracer (the /trace endpoint reads
+        ``engine.tracer`` — without this delegation a traced local runner
+        answered 404 "tracing disabled" while still exporting spans)."""
+        from seldon_core_tpu.utils.tracing import NULL_TRACER
+
+        for p in self.predictors:
+            if p.engine.tracer is not NULL_TRACER:
+                return p.engine.tracer
+        return NULL_TRACER
 
     async def predict(self, msg):
         return await self.pick().engine.predict(msg)

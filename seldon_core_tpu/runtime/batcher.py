@@ -60,6 +60,15 @@ def _qos_deadline() -> Optional[float]:
         return None
     return ctx.deadline.expires_at
 
+
+def _trace_ctx():
+    """Ambient trace context captured at enqueue time — the flush timer
+    callback runs outside any request context, so each _Pending must carry
+    the (trace_id, span_id) its batch span will link back to."""
+    from seldon_core_tpu.utils.tracing import current_trace
+
+    return current_trace()
+
 logger = logging.getLogger(__name__)
 
 
@@ -112,6 +121,9 @@ class _Pending:
     t_enqueue: float = 0.0
     # QoS deadline as a loop-clock expiry instant; None = no deadline
     deadline: Optional[float] = None
+    # trace context at enqueue (TraceContext or None): the batch span links
+    # to this — span links, not parenthood, since one batch serves N traces
+    tctx: Optional[Any] = None
 
 
 class _Lane:
@@ -164,6 +176,10 @@ class DynamicBatcher:
             else self.config.max_queue_rows
         )
         self.metrics = metrics
+        # set by the owning engine (or left None): emits one batch-execution
+        # span per dispatched batch, linked to each member request's trace
+        self.tracer = None
+        self._batch_seq = 0
         self._lanes: dict[tuple, _Lane] = {}
         self.max_lanes = 64
         self._inflight = 0
@@ -242,7 +258,7 @@ class DynamicBatcher:
             )
         fut: asyncio.Future = loop.create_future()
         p = _Pending(arr, nrows, fut, t_enqueue=loop.time(),
-                     deadline=_qos_deadline())
+                     deadline=_qos_deadline(), tctx=_trace_ctx())
         self._edf_insert(lane, p)
         lane.pending_rows += nrows
         if lane.pending_rows >= self.config.max_batch_size:
@@ -352,7 +368,33 @@ class DynamicBatcher:
             )
 
     def _run_batch(self, items: list[_Pending], rows: int) -> None:
+        import contextlib as _ctxlib
+
         bucket = self.bucket_for(rows)
+        tracer = self.tracer
+        if tracer is not None and getattr(tracer, "enabled", False):
+            self._batch_seq += 1
+            cm = tracer.trace(
+                f"batch:{self.config.name}:{self._batch_seq}",
+                name=f"batch:{self.config.name}",
+                batcher=self.config.name, rows=rows, bucket=bucket,
+                n_requests=len(items), pad_rows=bucket - rows,
+            )
+        else:
+            cm = _ctxlib.nullcontext()
+        with cm as bsp:
+            if bsp is not None:
+                # span LINKS (not parenthood): one batch execution serves N
+                # independent request traces — each link points back into
+                # the request span that was active at enqueue time
+                for p in items:
+                    if p.tctx is not None and p.tctx.span_id:
+                        bsp.add_link(p.tctx.trace_id, p.tctx.span_id,
+                                     kind="batched-request")
+            self._run_batch_inner(items, rows, bucket)
+
+    def _run_batch_inner(self, items: list[_Pending], rows: int,
+                         bucket: int) -> None:
         if len(items) == 1 and rows == bucket:
             batch = items[0].array
         else:

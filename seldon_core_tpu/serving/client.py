@@ -24,6 +24,7 @@ import aiohttp
 
 from seldon_core_tpu.messages import Feedback, SeldonMessage, Status
 from seldon_core_tpu.runtime.component import SeldonComponentError
+from seldon_core_tpu.utils.tracing import current_trace, trace_headers
 
 logger = logging.getLogger(__name__)
 
@@ -88,11 +89,16 @@ class RemoteComponent:
 
     async def _post(self, path: str, payload: dict) -> dict:
         sess = await self._sess()
+        # W3C context propagation: the ambient trace context (the engine
+        # node span currently open for this hop) becomes the remote
+        # process's parent via traceparent/tracestate
+        headers = {"Content-Type": "application/json",
+                   **trace_headers(current_trace())}
         try:
             async with sess.post(
                 f"{self.base_url}{path}",
                 json=payload,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
             ) as resp:
                 raw = await resp.read()
         except _ConnectTimeout as e:
@@ -164,7 +170,8 @@ class RemoteComponent:
             async with sess.post(
                 f"{self.base_url}/stream",
                 json=self._encode(msg),
-                headers={"Content-Type": "application/json"},
+                headers={"Content-Type": "application/json",
+                         **trace_headers(current_trace())},
                 timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
                                               sock_read=None),
             ) as resp:

@@ -28,6 +28,16 @@ from typing import Optional
 import numpy as np
 
 from seldon_core_tpu.messages import Feedback, Meta, SeldonMessage, Status
+from seldon_core_tpu.utils.tracing import (
+    TRACE_PARENT_TAG,
+    TRACE_STATE_TAG,
+    TRACEPARENT_HEADER,
+    TRACESTATE_HEADER,
+    current_trace,
+    trace_from_meta,
+    trace_headers,
+    trace_scope,
+)
 from seldon_core_tpu.native import (
     HAVE_NATIVE,
     MSG_ERROR,
@@ -137,6 +147,37 @@ def decode_feedback(frame: Frame) -> Feedback:
     return fb
 
 
+def _traced_copy(msg: SeldonMessage) -> SeldonMessage:
+    """Transport-side copy with the ambient trace context stamped into
+    ``meta.tags`` (the framed wire has no headers, so the full traceparent
+    rides the meta blob).  The caller's message is never mutated — span IDs
+    differ between walk and fused executions, so they must not leak into
+    the engine-visible payload."""
+    ctx = current_trace()
+    if ctx is None:
+        return msg
+    h = trace_headers(ctx)
+    m = msg.meta
+    tags = {**m.tags, TRACE_PARENT_TAG: h[TRACEPARENT_HEADER]}
+    if TRACESTATE_HEADER in h:
+        tags[TRACE_STATE_TAG] = h[TRACESTATE_HEADER]
+    meta2 = Meta(puid=m.puid, tags=tags, routing=dict(m.routing),
+                 request_path=dict(m.request_path), metrics=list(m.metrics))
+    return SeldonMessage(
+        data=msg.data, names=list(msg.names), bin_data=msg.bin_data,
+        str_data=msg.str_data, json_data=msg.json_data, meta=meta2,
+        status=msg.status, encoding=msg.encoding,
+    )
+
+
+def _bind_trace(msg: SeldonMessage):
+    """Server-side: recover the wire context and strip the transport-only
+    tags (they must not echo back in the response meta)."""
+    ctx = trace_from_meta(msg.meta)
+    msg.meta.tags.pop(TRACE_PARENT_TAG, None)
+    return trace_scope(ctx)
+
+
 def _writable(msg: SeldonMessage) -> None:
     """Zero-copy decode yields read-only views over the receive buffer; user
     components may mutate their input in place (the REST/GRPC transports hand
@@ -173,9 +214,10 @@ class FramedComponentServer:
     def _dispatch_predict(self, msg: SeldonMessage) -> SeldonMessage:
         t = self._target
         _writable(msg)
-        if hasattr(t, "predict_sync"):  # GraphEngine
-            return t.predict_sync(msg)
-        return t.predict(msg)
+        with _bind_trace(msg):
+            if hasattr(t, "predict_sync"):  # GraphEngine
+                return t.predict_sync(msg)
+            return t.predict(msg)
 
     def _dispatch_feedback(self, fb: Feedback) -> SeldonMessage:
         t = self._target
@@ -289,7 +331,8 @@ class AsyncFramedComponentServer:
             else:
                 msg = decode_message(frame)
                 _writable(msg)
-                out = await self._predict(msg)
+                with _bind_trace(msg):
+                    out = await self._predict(msg)
             return encode_message(self._codec, out, MSG_RESPONSE)
         except Exception as e:  # noqa: BLE001 — all errors go on the wire
             err = SeldonMessage(status=Status.failure(500, str(e)))
@@ -363,7 +406,9 @@ class AsyncFramedClient:
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         return decode_message(
-            await self._roundtrip(encode_message(self._codec, msg, MSG_PREDICT))
+            await self._roundtrip(
+                encode_message(self._codec, _traced_copy(msg), MSG_PREDICT)
+            )
         )
 
     async def send_feedback(self, fb: Feedback) -> SeldonMessage:
@@ -431,8 +476,9 @@ class FramedClient:
     def predict(self, msg: SeldonMessage,
                 timeout: Optional[float] = None) -> SeldonMessage:
         return decode_message(
-            self._roundtrip(encode_message(self._codec, msg, MSG_PREDICT),
-                            timeout=timeout)
+            self._roundtrip(
+                encode_message(self._codec, _traced_copy(msg), MSG_PREDICT),
+                timeout=timeout)
         )
 
     def send_feedback(self, fb: Feedback,

@@ -216,6 +216,32 @@ def gateway_spec() -> dict:
                 secured=True,
             )
         },
+        "/admin/traces": {
+            "get": {
+                "summary": "query collected traces (docs/observability.md)",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "deployment", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "status", "in": "query",
+                     "schema": {"type": "string", "enum": ["ok", "error"]}},
+                    {"name": "min_ms", "in": "query",
+                     "schema": {"type": "number"}},
+                    {"name": "drill", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "n", "in": "query",
+                     "schema": {"type": "integer", "default": 50}},
+                    {"name": "stats", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "collector counters only"},
+                ],
+                "responses": {
+                    "200": {"description": "matching trace records + stats"},
+                    "400": {"description": "non-numeric min_ms / n"},
+                    "404": {"description": "tracing disabled"},
+                },
+            }
+        },
         **_ops_paths(),
     }
     return {

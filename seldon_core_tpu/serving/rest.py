@@ -231,10 +231,18 @@ class EngineServer:
             payload = await _payload_json(request)
             msg = _parse_msg(payload)
             # QoS headers (docs/qos.md) bind the ambient context for the
-            # whole walk — the engine, batcher, and breakers all read it
+            # whole walk — the engine, batcher, and breakers all read it.
+            # W3C traceparent/tracestate bind the trace context the same
+            # way (docs/observability.md); absent/malformed → None, and
+            # the engine mints its own.
             from seldon_core_tpu.qos.context import qos_from_headers, qos_scope
+            from seldon_core_tpu.utils.tracing import (
+                trace_from_headers,
+                trace_scope,
+            )
 
-            with qos_scope(qos_from_headers(request.headers)):
+            with qos_scope(qos_from_headers(request.headers)), \
+                    trace_scope(trace_from_headers(request.headers)):
                 out = await self.engine.predict(msg)
         finally:
             self._inflight -= 1
@@ -318,6 +326,7 @@ class EngineServer:
                 content_type="application/json",
             )
         puid = request.query.get("puid")
+        collector = getattr(tracer, "collector", None)
         if puid:
             sp = tracer.get(puid)
             if sp is None:
@@ -326,6 +335,28 @@ class EngineServer:
                     content_type="application/json",
                 )
             body = json.dumps({"puid": puid, **sp.to_dict()})
+        elif request.query.get("stats") and collector is not None:
+            body = json.dumps({"collector": collector.stats()})
+        elif collector is not None and (
+            request.query.get("status") or request.query.get("min_ms")
+            or request.query.get("drill")
+        ):
+            # collector-backed filtered view (head+tail sampled exports)
+            try:
+                min_ms = (float(request.query["min_ms"])
+                          if "min_ms" in request.query else None)
+                n = int(request.query.get("n", 20))
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text=_err_json(400, "min_ms/n must be numeric"),
+                    content_type="application/json",
+                )
+            body = json.dumps({"traces": collector.query(
+                status=request.query.get("status"),
+                min_duration_ms=min_ms,
+                drill=request.query.get("drill"),
+                n=n,
+            )})
         else:
             try:
                 n = int(request.query.get("n", 20))

@@ -56,6 +56,11 @@ class ChaosPolicy:
     # apply faults only to these methods (None = all)
     methods: Optional[set] = None
     seed: Optional[int] = None
+    # drill tag: when set, every injected fault stamps a `chaos` event
+    # (with this id) onto the request's current span, and the id lands in
+    # root-span attributes — so /admin/traces?drill=<id> isolates exactly
+    # the traces a fault-injection drill touched (docs/observability.md)
+    drill_id: str = ""
 
     @property
     def burst_enabled(self) -> bool:
@@ -172,6 +177,8 @@ class ChaosWrapper:
             # function of the (deterministic) schedule and the call's
             # arrival time, not of coroutine wakeup order
             burst = self.burst_active()
+            if hang or fail or burst or pol.latency_ms or pol.jitter_ms:
+                self._mark_span(method, hang=hang, fail=fail, burst=burst)
             if hang:
                 self.injected_delays += 1
                 await asyncio.sleep(pol.hang_ms / 1000.0)
@@ -190,6 +197,24 @@ class ChaosWrapper:
                     f"(call #{self.calls})"
                 )
         return await maybe_await(getattr(self.inner, method)(*args))
+
+    def _mark_span(self, method: str, *, hang: bool, fail: bool,
+                   burst: bool) -> None:
+        """Record the injection on the request's current span (no-op when
+        tracing is off) — a drilled trace must say it was drilled."""
+        from seldon_core_tpu.utils.tracing import current_span
+
+        sp = current_span()
+        if sp is None:
+            return
+        sp.add_event(
+            "chaos", target=f"{self.name}.{method}",
+            kind=("hang" if hang else "error" if fail
+                  else "burst" if burst else "latency"),
+            drill_id=self.policy.drill_id,
+        )
+        if self.policy.drill_id:
+            sp.attributes["drill-id"] = self.policy.drill_id
 
     # -- duck-type surface ----------------------------------------------
     async def predict(self, msg):

@@ -106,6 +106,7 @@ class RestDriver:
         path: str = "/api/v0.1/predictions",
         token: str = "",
         connections: int = 128,
+        drill_id: str = "",
     ):
         self.base_url = base_url.rstrip("/")
         self.path = path
@@ -113,6 +114,11 @@ class RestDriver:
         self.headers = {"Content-Type": "application/json"}
         if token:
             self.headers["Authorization"] = f"Bearer {token}"
+        if drill_id:
+            # W3C tracestate entry: the gateway/engine tracer carries it
+            # through every span of every request this drill issues, so
+            # /admin/traces?drill=<id> isolates the drill's traffic
+            self.headers["tracestate"] = f"drill-id={drill_id}"
         self._connections = connections
         self._session = None
 
@@ -495,6 +501,7 @@ async def overload_drill(
     seed: int = 0,
     warmup_s: float = 0.2,
     max_inflight: int = 10_000,
+    drill_id: str = "",
 ) -> dict:
     """Open-loop overload drill against an in-process async
     ``predict(msg) -> SeldonMessage`` (a GraphEngine / LocalDeployment,
@@ -510,8 +517,17 @@ async def overload_drill(
     measured from the scheduled arrival (no coordinated omission).
 
     ``payload`` is a SeldonMessage or a zero-arg factory returning one.
+
+    ``drill_id`` (when set) binds a ``drill-id`` tracestate entry onto
+    every issued request, so a tracing-enabled engine's collector can be
+    queried for exactly this drill's traces afterwards.
     """
     from seldon_core_tpu.qos.context import Deadline, QosContext, qos_scope
+    from seldon_core_tpu.utils.tracing import (
+        TraceContext,
+        new_trace_id,
+        trace_scope,
+    )
 
     rng = np.random.default_rng(seed)
     pri_rng = np.random.default_rng(seed + 1)
@@ -553,8 +569,11 @@ async def overload_drill(
             priority=priority,
             deadline=Deadline.after_ms(deadline_ms) if deadline_ms else None,
         )
+        tctx = (TraceContext(trace_id=new_trace_id(),
+                             state=(("drill-id", drill_id),))
+                if drill_id else None)
         try:
-            with qos_scope(ctx):
+            with qos_scope(ctx), trace_scope(tctx):
                 out = await predict(_payload())
         except Exception:
             if tally is not None:

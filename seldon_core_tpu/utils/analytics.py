@@ -39,8 +39,9 @@ CATALOG: tuple[MetricInfo, ...] = (
     MetricInfo(
         "seldon_api_executor_client_requests_seconds", "histogram",
         "Per-graph-node southbound latency (model/router/combiner/"
-        "transformer calls)",
-        ("deployment", "predictor", "model_name"),
+        "transformer calls); status=ok|error so failed calls keep their "
+        "latency instead of vanishing from the histogram",
+        ("deployment", "predictor", "model_name", "status"),
     ),
     MetricInfo(
         "seldon_api_server_ingress_seconds", "histogram",
@@ -498,6 +499,11 @@ def metric_docs() -> str:
         "their own names (reference `CustomMetricsManager.java:30-43`, "
         "`docs/custom_metrics.md`).",
         "",
+        "When tracing is enabled ([docs/observability.md](observability.md)),"
+        " latency histograms attach the current request's trace ID to the "
+        "bucket the observation landed in as an OpenMetrics exemplar "
+        "(`# {trace_id=\"...\"}`), so a latency spike on any dashboard panel "
+        "deep-links to a concrete trace in `/trace` / `/admin/traces`.",
     ]
     return "\n".join(lines)
 

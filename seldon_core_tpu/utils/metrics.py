@@ -18,6 +18,7 @@ from collections import defaultdict
 from typing import Iterable, Optional
 
 from seldon_core_tpu.messages import Metric, MetricType
+from seldon_core_tpu.utils.tracing import current_trace
 
 _DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -46,6 +47,10 @@ class MetricsRegistry:
         self._hist_counts: dict[tuple, list[int]] = {}
         self._hist_sum: dict[tuple, float] = defaultdict(float)
         self._hist_total: dict[tuple, int] = defaultdict(int)
+        # (series key, bucket index) -> (trace_id, value, unix_ts): the last
+        # sampled observation landing in that bucket, emitted as an
+        # OpenMetrics exemplar so dashboards deep-link latency to traces
+        self._hist_exemplars: dict[tuple, tuple[str, float, float]] = {}
         self._help: dict[str, str] = {}
 
     def _key(self, name: str, labels: Optional[dict]) -> tuple:
@@ -60,8 +65,14 @@ class MetricsRegistry:
             self._gauges[self._key(name, labels)] = value
 
     def observe(self, name: str, value: float, labels: Optional[dict] = None):
-        """Histogram observation (seconds for timers)."""
+        """Histogram observation (seconds for timers).  When a sampled
+        trace context is ambient, the observation is remembered as that
+        bucket's exemplar (trace-id + value + timestamp)."""
         key = self._key(name, labels)
+        exemplar = None
+        ctx = current_trace()
+        if ctx is not None and ctx.sampled:
+            exemplar = (ctx.trace_id, value, time.time())
         with self._lock:
             if key not in self._hist_counts:
                 self._hist_counts[key] = [0] * (len(_DEFAULT_BUCKETS) + 1)
@@ -69,9 +80,13 @@ class MetricsRegistry:
             for i, b in enumerate(_DEFAULT_BUCKETS):
                 if value <= b:
                     counts[i] += 1
+                    bucket = i
                     break
             else:
                 counts[-1] += 1
+                bucket = len(_DEFAULT_BUCKETS)
+            if exemplar is not None:
+                self._hist_exemplars[(key, bucket)] = exemplar
             self._hist_sum[key] += value
             self._hist_total[key] += 1
 
@@ -87,6 +102,16 @@ class MetricsRegistry:
                 registry.observe(name, time.perf_counter() - self.t0, labels)
 
         return _Timer()
+
+    def _exemplar_suffix(self, key: tuple, bucket: int) -> str:
+        """OpenMetrics exemplar for one bucket line:
+        `` # {trace_id="<128-bit hex>"} <value> <unix ts>`` — the deep-link
+        from a Grafana heatmap cell to the trace behind it."""
+        ex = self._hist_exemplars.get((key, bucket))
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return f' # {{trace_id="{trace_id}"}} {value} {ts}'
 
     # ---- exposition ----------------------------------------------------
     def render(self) -> str:
@@ -114,9 +139,13 @@ class MetricsRegistry:
                     cum += self._hist_counts[key][i]
                     lines.append(
                         f'{name}_bucket{_fmt_labels({**ld, "le": repr(b)})} {cum}'
+                        f'{self._exemplar_suffix(key, i)}'
                     )
                 cum += self._hist_counts[key][-1]
-                lines.append(f'{name}_bucket{_fmt_labels({**ld, "le": "+Inf"})} {cum}')
+                lines.append(
+                    f'{name}_bucket{_fmt_labels({**ld, "le": "+Inf"})} {cum}'
+                    f'{self._exemplar_suffix(key, len(_DEFAULT_BUCKETS))}'
+                )
                 lines.append(f"{name}_sum{_fmt_labels(ld)} {self._hist_sum[key]}")
                 lines.append(f"{name}_count{_fmt_labels(ld)} {self._hist_total[key]}")
         return "\n".join(lines) + "\n"
@@ -132,12 +161,16 @@ class EngineMetrics:
         self.registry = registry or MetricsRegistry()
         self.deployment = deployment
 
-    def observe_node(self, predictor: str, node: str, seconds: float) -> None:
+    def observe_node(self, predictor: str, node: str, seconds: float,
+                     status: str = "ok") -> None:
+        """``status`` is "ok" or "error": failed node calls land in their
+        own series so error p99 is measurable (a raising node used to drop
+        its elapsed time on the floor)."""
         self.registry.observe(
             "seldon_api_executor_client_requests_seconds",
             seconds,
             {"deployment_name": self.deployment, "predictor_name": predictor,
-             "model_name": node},
+             "model_name": node, "status": status},
         )
 
     def observe_request(self, predictor: str, seconds: float, code: int = 200) -> None:

@@ -591,3 +591,70 @@ class TestSignedTokens:
         assert isinstance(default_token_store(), TokenStore)
         monkeypatch.setenv("SELDON_TOKEN_SIGNING_KEY", "k")
         assert isinstance(default_token_store(), SignedTokenStore)
+
+
+class TestAdminTraces:
+    """Gateway tracing: inbound traceparent accepted, /admin/traces query."""
+
+    async def _traced_gateway(self, engine_url):
+        from seldon_core_tpu.utils.tracing import SpanCollector, Tracer
+
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name="dep1", oauth_key="key1", oauth_secret="sec1",
+            engine_url=engine_url,
+        ))
+        gw = Gateway(store, tracer=Tracer(
+            collector=SpanCollector(service="gateway")))
+        client = TestClient(TestServer(gw.build_app()))
+        await client.start_server()
+        return gw, client
+
+    async def test_query_and_drill_filter(self):
+        engine = TestClient(TestServer(await fake_engine_app()))
+        await engine.start_server()
+        url = f"http://127.0.0.1:{engine.port}"
+        gw, client = await self._traced_gateway(url)
+        try:
+            token = await get_token(client)
+            tid = "ab" * 16
+            resp = await client.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0, 2.0]]}},
+                headers={
+                    "Authorization": f"Bearer {token}",
+                    "traceparent": f"00-{tid}-{'cd' * 8}-01",
+                    "tracestate": "drill-id=dz",
+                },
+            )
+            assert resp.status == 200
+
+            r = await client.get("/admin/traces")
+            body = await r.json()
+            assert r.status == 200
+            assert [t["trace_id"] for t in body["traces"]] == [tid]
+            assert body["stats"]["kept_head"] == 1
+
+            r = await client.get("/admin/traces", params={"drill": "dz"})
+            assert len((await r.json())["traces"]) == 1
+            r = await client.get("/admin/traces", params={"drill": "other"})
+            assert len((await r.json())["traces"]) == 0
+            r = await client.get("/admin/traces",
+                                 params={"deployment": "dep1"})
+            assert len((await r.json())["traces"]) == 1
+            r = await client.get("/admin/traces", params={"min_ms": "bogus"})
+            assert r.status == 400
+        finally:
+            await client.close()
+            await engine.close()
+            await gw.close()
+
+    async def test_disabled_returns_404(self):
+        gw, client, _ = await make_gateway()
+        try:
+            r = await client.get("/admin/traces")
+            assert r.status == 404
+            assert "hint" in await r.json()
+        finally:
+            await client.close()
+            await gw.close()

@@ -180,3 +180,225 @@ class TestTraceEndpoint:
                 await client.close()
 
         asyncio.run(run())
+
+
+class TestW3CContext:
+    """W3C traceparent/tracestate: strict parse, round trips, headers."""
+
+    def test_roundtrip(self):
+        from seldon_core_tpu.utils.tracing import (
+            TraceContext, format_traceparent, new_span_id, new_trace_id,
+            parse_traceparent,
+        )
+
+        ctx = TraceContext(new_trace_id(), new_span_id(), True)
+        back = parse_traceparent(format_traceparent(ctx))
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+
+    @pytest.mark.parametrize("bad", [
+        "",
+        "garbage",
+        "00-" + "0" * 32 + "-" + "ab" * 8 + "-01",   # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden version
+        "00-short-" + "cd" * 8 + "-01",
+    ])
+    def test_strict_parse_rejects(self, bad):
+        from seldon_core_tpu.utils.tracing import parse_traceparent
+
+        assert parse_traceparent(bad) is None
+
+    def test_header_roundtrip_keeps_tracestate(self):
+        from seldon_core_tpu.utils.tracing import (
+            TraceContext, new_span_id, new_trace_id, trace_from_headers,
+            trace_headers,
+        )
+
+        ctx = TraceContext(new_trace_id(), new_span_id(), True,
+                           state=(("drill-id", "d7"),))
+        back = trace_from_headers(trace_headers(ctx))
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.state_get("drill-id") == "d7"
+
+
+class TestConcurrentFanout:
+    """Trace-ID isolation: N concurrent requests under distinct contexts
+    must each stamp THEIR OWN trace id — contextvars must not bleed
+    across asyncio tasks sharing one engine."""
+
+    def test_concurrent_requests_keep_their_trace_ids(self):
+        from seldon_core_tpu.utils.tracing import TraceContext, trace_scope
+
+        tr = Tracer()
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"},
+                          tracer=tr)
+        tids = [f"{i:032x}" for i in range(1, 9)]
+
+        async def one(tid):
+            msg = SeldonMessage.from_ndarray(np.ones((1, 2)))
+            with trace_scope(TraceContext(tid, "", True)):
+                out = await eng.predict(msg)
+            return tid, out
+
+        async def drive():
+            return await asyncio.gather(*(one(t) for t in tids))
+
+        for tid, out in asyncio.run(drive()):
+            assert out.meta.tags["trace-id"] == tid
+            root = tr.get(out.meta.puid)
+            assert root is not None and root.trace_id == tid
+
+
+class TestBatchSpanLinks:
+    """One coalesced device batch serves N request traces: exactly ONE
+    batch span, LINKED (not parented) to all N member contexts."""
+
+    def test_n_requests_one_linked_batch_span(self):
+        from seldon_core_tpu.operator.local import resolve_component
+        from seldon_core_tpu.runtime.batcher import BatcherConfig
+        from seldon_core_tpu.utils.tracing import SpanCollector
+
+        spec = {
+            "name": "m0", "type": "MODEL",
+            "parameters": [
+                {"name": "model_class",
+                 "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+                 "type": "STRING"},
+                {"name": "seed", "value": "0", "type": "INT"},
+                {"name": "hidden", "value": "32", "type": "INT"},
+            ],
+        }
+        tr = Tracer(collector=SpanCollector(service="engine"))
+        eng = GraphEngine(
+            spec,
+            resolver=lambda u: resolve_component(
+                u, {"seldon.io/batching": "false"}),
+            name="p", plan_mode="fused", tracer=tr,
+            plan_batcher=BatcherConfig(max_batch_size=8, max_delay_ms=25.0),
+        )
+        assert eng.plan is not None and eng.plan.segments[0].batcher
+
+        rng = np.random.default_rng(0)
+        tids = [f"{i:032x}" for i in range(1, 7)]
+
+        async def one(tid):
+            msg = SeldonMessage.from_ndarray(
+                rng.normal(size=(1, 784)).astype(np.float32))
+            msg.meta.puid = tid
+            return await eng.predict(msg)
+
+        async def drive():
+            return await asyncio.gather(*(one(t) for t in tids))
+
+        outs = asyncio.run(drive())
+        assert all(o.status.status == "SUCCESS" for o in outs)
+        batch_recs = [r for r in tr.collector.query(n=100)
+                      if r["root"]["name"].startswith("batch:")]
+        assert len(batch_recs) == 1
+        links = batch_recs[0]["root"]["links"]
+        assert sorted(ln["trace_id"] for ln in links) == sorted(tids)
+
+
+class TestWalkFusedTraceParity:
+    """Tracing must not break walk↔fused byte parity: only deterministic
+    (puid-derived) trace tags ride the response meta, never span ids."""
+
+    GRAPH = {
+        "name": "combiner",
+        "implementation": "AVERAGE_COMBINER",
+        "type": "COMBINER",
+        "children": [
+            {"name": "m1", "implementation": "SIMPLE_MODEL"},
+            {"name": "m2", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+
+    def test_traced_responses_byte_identical(self):
+        walk = GraphEngine(self.GRAPH, name="p", tracer=Tracer())
+        fused = GraphEngine(self.GRAPH, name="p", plan_mode="fused",
+                            tracer=Tracer())
+
+        def msg():
+            m = SeldonMessage.from_ndarray(np.ones((1, 2)))
+            m.meta.puid = "ab" * 16
+            return m
+
+        a = asyncio.run(walk.predict(msg()))
+        b = asyncio.run(fused.predict(msg()))
+        assert a.status.status == "SUCCESS"
+        assert a.to_dict() == b.to_dict()
+        assert a.meta.tags["trace-id"] == "ab" * 16
+
+
+class TestCollectorSampling:
+    def _root(self, status="OK", duration_ms=1.0):
+        import time
+
+        from seldon_core_tpu.utils.tracing import Span
+
+        now = time.time_ns()
+        return Span(name="r", status=status, start_ns=now,
+                    end_ns=now + int(duration_ms * 1e6),
+                    trace_id="ab" * 16, span_id="cd" * 8)
+
+    def test_head_keeps_sampled(self):
+        from seldon_core_tpu.utils.tracing import SpanCollector
+
+        c = SpanCollector(slow_ms=100.0)
+        assert c.offer(self._root(), sampled=True)
+        assert c.stats()["kept_head"] == 1
+
+    def test_tail_keeps_error_and_slow_drops_boring(self):
+        from seldon_core_tpu.utils.tracing import SpanCollector
+
+        c = SpanCollector(slow_ms=100.0)
+        assert c.offer(self._root(status="ERROR: boom"), sampled=False)
+        assert c.offer(self._root(duration_ms=500.0), sampled=False)
+        assert not c.offer(self._root(), sampled=False)
+        s = c.stats()
+        assert s["kept_tail"] == 2 and s["dropped"] == 1 and s["offered"] == 3
+
+    def test_query_filters(self):
+        from seldon_core_tpu.utils.tracing import SpanCollector
+
+        c = SpanCollector(slow_ms=100.0)
+        r = self._root(status="ERROR: x")
+        r.attributes["deployment"] = "d1"
+        c.offer(r, sampled=True, extra={"tracestate": {"drill-id": "dz"}})
+        c.offer(self._root(), sampled=True)
+        assert len(c.query(n=10)) == 2
+        assert len(c.query(status="error", n=10)) == 1
+        assert len(c.query(deployment="d1", n=10)) == 1
+        assert len(c.query(drill="dz", n=10)) == 1
+        assert len(c.query(drill="nope", n=10)) == 0
+        assert len(c.query(min_duration_ms=10_000.0, n=10)) == 0
+
+
+class TestExemplars:
+    def test_histogram_attaches_trace_exemplar(self):
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+        from seldon_core_tpu.utils.tracing import TraceContext, trace_scope
+
+        reg = MetricsRegistry()
+        reg.observe("seldon_api_server_ingress_seconds", 0.02,
+                    {"deployment": "d"})
+        assert "# {trace_id=" not in reg.render()  # no ambient trace
+
+        with trace_scope(TraceContext("ef" * 16, "", True)):
+            reg.observe("seldon_api_server_ingress_seconds", 0.02,
+                        {"deployment": "d"})
+        assert f'# {{trace_id="{"ef" * 16}"}}' in reg.render()
+
+    def test_unsampled_trace_leaves_no_exemplar(self):
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+        from seldon_core_tpu.utils.tracing import TraceContext, trace_scope
+
+        reg = MetricsRegistry()
+        with trace_scope(TraceContext("ef" * 16, "", False)):
+            reg.observe("seldon_api_server_ingress_seconds", 0.02,
+                        {"deployment": "d"})
+        assert "# {trace_id=" not in reg.render()
