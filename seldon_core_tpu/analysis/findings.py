@@ -21,6 +21,9 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL9xx`` — tracing admission (``seldon.io/tracing`` /
   ``seldon.io/trace-*`` annotation validation, knobs set while the
   subsystem is off, effective-config report)
+- ``GL10xx`` — health-plane admission (``seldon.io/health*`` /
+  ``seldon.io/slo-availability`` annotation validation, knobs set while
+  the plane is off, effective sampler/recorder/SLO report)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -71,6 +74,9 @@ QOS_SLO_INFEASIBLE = "GL806"        # node budgets cannot fit the p95 SLO
 TRACE_ANNOTATION_INVALID = "GL901"  # seldon.io/trace-* value invalid
 TRACE_KNOBS_WITHOUT_TRACING = "GL902"  # trace-* knobs set, tracing off
 TRACE_CONFIG_REPORT = "GL903"       # trace report: effective config
+HEALTH_ANNOTATION_INVALID = "GL1001"  # seldon.io/health* / slo-availability invalid
+HEALTH_KNOBS_WITHOUT_HEALTH = "GL1002"  # health-* knobs set, plane off
+HEALTH_CONFIG_REPORT = "GL1003"     # health report: effective config
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -113,6 +119,9 @@ CODE_SEVERITY = {
     TRACE_ANNOTATION_INVALID: ERROR,
     TRACE_KNOBS_WITHOUT_TRACING: WARN,
     TRACE_CONFIG_REPORT: INFO,
+    HEALTH_ANNOTATION_INVALID: ERROR,
+    HEALTH_KNOBS_WITHOUT_HEALTH: WARN,
+    HEALTH_CONFIG_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

@@ -41,6 +41,9 @@ from seldon_core_tpu.analysis.findings import (
     GRAPH_CYCLE,
     HBM_NEAR_BUDGET,
     HBM_OVER_BUDGET,
+    HEALTH_ANNOTATION_INVALID,
+    HEALTH_CONFIG_REPORT,
+    HEALTH_KNOBS_WITHOUT_HEALTH,
     IMPL_TYPE_MISMATCH,
     METHOD_TYPE_MISMATCH,
     PLAN_MODE_INVALID,
@@ -167,6 +170,7 @@ def lint_graph(
         findings.extend(_cache_pass(unit, ann, path_prefix))
         findings.extend(_qos_pass(unit, ann, path_prefix))
         findings.extend(_trace_pass(unit, ann, path_prefix))
+        findings.extend(_health_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -896,6 +900,59 @@ def _trace_pass(root: PredictiveUnit, ann: dict,
     if cfg.export_path:
         detail += f"; OTLP JSON-lines export -> {cfg.export_path}"
     return [make_finding(TRACE_CONFIG_REPORT, path0, detail)]
+
+
+def _health_pass(root: PredictiveUnit, ann: dict,
+                 prefix: str) -> list[Finding]:
+    """Health-plane admission (GL10xx, active when any ``seldon.io/health*``
+    or ``seldon.io/slo-availability`` annotation is set): validates the
+    family through the same parser the operator and runtimes use (GL1001
+    — a malformed sample interval or an availability objective outside
+    (0, 1) rejects here, before a deployment ships with a silently-dead
+    burn monitor), warns when health knobs are set while the plane itself
+    is off (GL1002), and reports the effective sampler / flight-recorder
+    / SLO configuration (GL1003)."""
+    from seldon_core_tpu.health.config import (
+        HEALTH_ANNOTATION,
+        HEALTH_FLIGHT_RECORDS_ANNOTATION,
+        HEALTH_SAMPLE_MS_ANNOTATION,
+        HEALTH_TIMELINE_ANNOTATION,
+        SLO_AVAILABILITY_ANNOTATION,
+        health_config_from_annotations,
+    )
+
+    family = {HEALTH_ANNOTATION, HEALTH_SAMPLE_MS_ANNOTATION,
+              HEALTH_TIMELINE_ANNOTATION, HEALTH_FLIGHT_RECORDS_ANNOTATION,
+              SLO_AVAILABILITY_ANNOTATION}
+    health_keys = [k for k in ann if k in family]
+    if not health_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = health_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(HEALTH_ANNOTATION_INVALID, path0, str(e))]
+    if not cfg.enabled:
+        knobs = sorted(k for k in health_keys if k != HEALTH_ANNOTATION)
+        if knobs:
+            return [make_finding(
+                HEALTH_KNOBS_WITHOUT_HEALTH, path0,
+                f"{', '.join(knobs)} set but {HEALTH_ANNOTATION} is not "
+                f"enabled (and no {SLO_AVAILABILITY_ANNOTATION} objective "
+                "implies it) — the knobs have no effect",
+            )]
+        return []
+    detail = (f"health plane on: sampler every {cfg.sample_ms:g}ms "
+              f"(timeline {cfg.timeline}); flight recorder keeps "
+              f"{cfg.flight_records} requests")
+    slo_bits = []
+    if cfg.slo_availability is not None:
+        slo_bits.append(f"availability >= {cfg.slo_availability:g}")
+    if cfg.slo_p95_ms is not None:
+        slo_bits.append(f"p95 <= {cfg.slo_p95_ms:g}ms")
+    detail += ("; burn monitor: " + ", ".join(slo_bits) if slo_bits
+               else "; no SLO declared — burn monitor idle")
+    return [make_finding(HEALTH_CONFIG_REPORT, path0, detail)]
 
 
 def _join(prefix: str, name: str) -> str:

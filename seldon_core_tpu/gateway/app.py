@@ -76,6 +76,7 @@ class Gateway:
         retries: int = 2,
         retry_backoff_s: float = 0.05,
         tracer: Optional[Tracer] = None,
+        health=None,
     ):
         self.store = store
         # SELDON_TOKEN_SIGNING_KEY (chart Secret) selects stateless signed
@@ -127,6 +128,61 @@ class Gateway:
                     collector=SpanCollector(service="gateway",
                                             slow_ms=tcfg.slow_ms, sink=sink),
                 )
+        # Health plane (docs/observability.md): the always-on counterpart
+        # to sampled tracing — unconditional flight recording of every
+        # forward, SLO burn monitoring, and the introspection sampler.
+        # Env knobs: SELDON_HEALTH / SELDON_HEALTH_SAMPLE_MS /
+        # SELDON_SLO_AVAILABILITY.  Served from /admin/{health,
+        # flightrecorder,introspect}.
+        if health is not None:
+            self.health = health
+        else:
+            self.health = None
+            try:
+                from seldon_core_tpu.health import (
+                    HealthPlane,
+                    health_config_from_annotations,
+                )
+
+                hcfg = health_config_from_annotations({}, "gateway")
+            except ValueError as e:
+                logger.warning("health plane disabled (bad env config): %s",
+                               e)
+                hcfg = None
+            if hcfg is not None and hcfg.enabled:
+                self.health = HealthPlane(hcfg, metrics=self.registry,
+                                          service="gateway")
+        if self.health is not None:
+            from seldon_core_tpu.health import (
+                device_memory_probe,
+                device_registry_probe,
+            )
+
+            self.health.sampler.add_probe("device", device_memory_probe())
+            self.health.sampler.add_probe("device_registry",
+                                          device_registry_probe())
+            self.health.sampler.add_probe("gateway", self._gateway_probe)
+
+    def _gateway_probe(self) -> dict:
+        """Sampler probe over the gateway's per-deployment runtime state
+        (caches + admission controllers, summed across deployments)."""
+        out: dict = {}
+        caches = [c for c in self._caches.values() if c is not None]
+        if caches:
+            out["cache_bytes"] = float(
+                sum(c.stats.get("bytes", 0) for c in caches))
+            out["cache_entries"] = float(
+                sum(c.stats.get("entries", 0) for c in caches))
+        admissions = [a for _, a in self._admission.values()
+                      if a is not None]
+        if admissions:
+            out["admission_limit"] = float(
+                sum(a.limit for a in admissions))
+            out["admission_inflight"] = float(
+                sum(a.inflight for a in admissions))
+            out["shed_level"] = float(
+                max(a.shed_level for a in admissions))
+        return out
 
     # ------------------------------------------------------------------
     # shared forwarding client (pooled, apife parity: 150 conns)
@@ -140,6 +196,8 @@ class Gateway:
         return self._session
 
     async def close(self) -> None:
+        if self.health is not None:
+            await self.health.aclose()
         if self._session is not None and not self._session.closed:
             await self._session.close()
         for ch in self._grpc_channels.values():
@@ -167,6 +225,10 @@ class Gateway:
         app.router.add_get("/metrics", self._handle_metrics)
         app.router.add_get("/seldon.json", self._handle_openapi)
         app.router.add_get("/admin/traces", self._handle_traces)
+        app.router.add_get("/admin/introspect", self._handle_introspect)
+        app.router.add_get("/admin/flightrecorder",
+                           self._handle_flightrecorder)
+        app.router.add_get("/admin/health", self._handle_health)
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -300,6 +362,32 @@ class Gateway:
                             "shed", reason=_shed_reason(out_body),
                             status=out_status,
                         )
+        if self.health is not None:
+            # unconditional flight record (unlike sampled traces): raw
+            # body kept when small enough so tools/replay.py can re-issue
+            # the request verbatim (byte-identical), never parsed here
+            self.health.ensure_started()
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            self.health.recorder.record(
+                trace_id=tctx.trace_id if tctx is not None else "",
+                deployment=rec.name,
+                route=(path,),
+                status=out_status,
+                reason=_shed_reason(out_body) if out_status >= 400 else "",
+                duration_ms=elapsed_ms,
+                flags={
+                    "shed": out_status == 429,
+                    "cache": cache_state or "off",
+                    "path": path,
+                },
+                request={
+                    "body": body.decode("utf-8", "replace"),
+                    "contentType": content_type,
+                    "path": path,
+                },
+                request_bytes=len(body),
+            )
+            self.health.note_request(elapsed_ms, out_status)
         headers: dict[str, str] = {}
         if cache_state:
             headers["X-Seldon-Cache"] = cache_state
@@ -657,6 +745,37 @@ class Gateway:
         return web.json_response(
             {"traces": traces, "stats": collector.stats()}
         )
+
+    async def _handle_health_endpoint(self, request: web.Request,
+                                      body_fn) -> web.Response:
+        """Shared wrapper for /admin/{introspect,flightrecorder,health}:
+        404 + hint when the plane is off, 400 on malformed numerics (the
+        /admin/traces contract)."""
+        try:
+            status, payload = body_fn(self.health, request.query)
+        except ValueError:
+            return web.json_response(
+                {"error": "numeric query parameter expected"}, status=400
+            )
+        return web.json_response(payload, status=status)
+
+    async def _handle_introspect(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.health.http import introspect_body
+
+        return await self._handle_health_endpoint(request, introspect_body)
+
+    async def _handle_flightrecorder(
+        self, request: web.Request
+    ) -> web.Response:
+        from seldon_core_tpu.health.http import flightrecorder_body
+
+        return await self._handle_health_endpoint(request,
+                                                  flightrecorder_body)
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.health.http import health_body
+
+        return await self._handle_health_endpoint(request, health_body)
 
     # ------------------------------------------------------------------
     # gRPC front (Seldon service, forwards to engine gRPC)

@@ -30,6 +30,10 @@ import time
 from typing import Any, Callable, Optional
 
 from seldon_core_tpu.graph.builtins import make_builtin
+from seldon_core_tpu.health.flightrecorder import (
+    node_times_scope,
+    note_node_time,
+)
 from seldon_core_tpu.graph.spec import (
     PredictiveUnit,
     parse_graph,
@@ -80,6 +84,7 @@ class GraphEngine:
         cache: Optional[Any] = None,
         cache_version: str = "",
         qos: Optional[Any] = None,
+        health: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -162,6 +167,11 @@ class GraphEngine:
         # carry meta.tags.degraded.  The fallback is resolved against the
         # INTERPRETED node tree (always intact beneath a fused plan).
         self.qos = qos
+        # health plane (health/, docs/observability.md): every predict —
+        # including sheds and failures — leaves a flight-recorder record
+        # and feeds the SLO burn monitor; the introspection sampler is
+        # lazily started on the first request (the loop exists by then)
+        self.health = health
         self._fallback_node: Optional[_Node] = None
         if qos is not None and qos.config.fallback_node:
             node = self._nodes.get(qos.config.fallback_node)
@@ -228,6 +238,15 @@ class GraphEngine:
         meta = request.meta.copy()
         if not meta.puid:
             meta.puid = new_puid()
+        # health plane: unconditional flight recording (unlike sampled
+        # traces) — the node-times scope accumulates per-node ms via
+        # _observe, and every exit path below funnels through _flight_done
+        health = self.health
+        ht0 = time.perf_counter()
+        htoken = None
+        if health is not None:
+            health.ensure_started()
+            htoken = node_times_scope()
         # Trace context: wire channel (meta tags / inbound traceparent bound
         # by the REST layer) wins; else mint one with the head-sampling
         # decision.  The trace ID derives from the puid (already 128-bit
@@ -250,14 +269,17 @@ class GraphEngine:
         if qctx is not None:
             stamp_meta(request.meta, qctx)
             if qctx.deadline is not None and qctx.deadline.expired:
-                return SeldonMessage(
-                    status=Status.failure(
-                        504,
-                        "deadline budget exhausted before the graph walk "
-                        "started",
-                        "DEADLINE_EXCEEDED",
+                return self._flight_done(
+                    SeldonMessage(
+                        status=Status.failure(
+                            504,
+                            "deadline budget exhausted before the graph "
+                            "walk started",
+                            "DEADLINE_EXCEEDED",
+                        ),
+                        meta=meta,
                     ),
-                    meta=meta,
+                    meta, tctx, ht0, htoken,
                 )
         admission = self.qos.admission if self.qos is not None else None
         if admission is not None:
@@ -275,15 +297,18 @@ class GraphEngine:
                             "shed", reason="ADMISSION_SHED", priority=pri,
                             limit=admission.limit,
                         )
-                return SeldonMessage(
-                    status=Status.failure(
-                        429,
-                        f"shed at admission (priority {pri}, "
-                        f"concurrency limit {admission.limit}); retry "
-                        f"after {admission.retry_after_s():.1f}s",
-                        "ADMISSION_SHED",
+                return self._flight_done(
+                    SeldonMessage(
+                        status=Status.failure(
+                            429,
+                            f"shed at admission (priority {pri}, "
+                            f"concurrency limit {admission.limit}); retry "
+                            f"after {admission.retry_after_s():.1f}s",
+                            "ADMISSION_SHED",
+                        ),
+                        meta=meta,
                     ),
-                    meta=meta,
+                    meta, tctx, ht0, htoken, shed=True,
                 )
         t0 = time.perf_counter()
         ok = False
@@ -294,7 +319,7 @@ class GraphEngine:
         finally:
             if admission is not None:
                 admission.release(time.perf_counter() - t0, ok)
-        return out
+        return self._flight_done(out, meta, tctx, ht0, htoken)
 
     async def _predict_qos(
         self, request: SeldonMessage, meta: Meta, qctx: Optional[Any]
@@ -556,6 +581,9 @@ class GraphEngine:
 
     def _observe(self, node_name: str, elapsed: float,
                  status: str = "ok") -> None:
+        # per-request node timings for the flight recorder (no-op when no
+        # node-times scope is ambient, i.e. the health plane is off)
+        note_node_time(node_name, elapsed * 1000.0)
         if self.metrics is not None:
             try:
                 self.metrics.observe_node(self.name, node_name, elapsed,
@@ -564,6 +592,47 @@ class GraphEngine:
                 # duck-typed sink without the status kwarg (pre-existing
                 # custom sinks) — drop the label, keep the observation
                 self.metrics.observe_node(self.name, node_name, elapsed)
+
+    def _flight_done(self, out: SeldonMessage, meta: Meta, tctx,
+                     ht0: float, htoken, shed: bool = False) -> SeldonMessage:
+        """Every predict() exit path funnels here: one flight-recorder
+        record + one burn-monitor observation, shed and failure paths
+        included.  Never raises — health must not take serving down."""
+        health = self.health
+        if health is None:
+            return out
+        try:
+            node_ms = htoken.close() if htoken is not None else {}
+            elapsed_ms = (time.perf_counter() - ht0) * 1000.0
+            status = out.status
+            code = 200 if status is None else int(status.code or 200)
+            reason = "" if status is None else status.reason
+            from seldon_core_tpu.qos.context import DEGRADED_TAG
+            from seldon_core_tpu.utils.tracing import TRACE_ID_TAG
+
+            flags = {
+                "shed": shed or reason == "ADMISSION_SHED",
+                "degraded": meta.tags.get(DEGRADED_TAG, False),
+                "mode": "fused" if self.plan is not None else "walk",
+            }
+            if meta.routing:
+                flags["routing"] = dict(meta.routing)
+            health.recorder.record(
+                puid=meta.puid,
+                trace_id=str(meta.tags.get(TRACE_ID_TAG, "")),
+                deployment=health.deployment or self.name,
+                route=tuple(meta.request_path),
+                node_ms=node_ms,
+                status=code,
+                reason=reason,
+                duration_ms=elapsed_ms,
+                flags=flags,
+            )
+            health.note_request(elapsed_ms, code)
+        except Exception:  # pragma: no cover - defensive
+            logger.exception("flight recording failed in graph %s",
+                             self.name)
+        return out
 
     # ------------------------------------------------------------------
     # prediction cache (walk mode): maximal-subtree memoisation

@@ -336,6 +336,23 @@ def trace_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def health_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/health*`` / ``seldon.io/slo-availability`` annotations
+    → a validated :class:`~seldon_core_tpu.health.HealthConfig`.  Invalid
+    values — an availability objective outside (0, 1), a non-positive
+    sample interval, a bad ring size — reject at admission; graphlint's
+    GL10xx pass reports the same defects, this is the hard stop for
+    callers that skip linting."""
+    from seldon_core_tpu.health import health_config_from_annotations
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return health_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

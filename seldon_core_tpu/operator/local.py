@@ -152,6 +152,7 @@ class LocalPredictor:
         ann = {**dep.annotations, **pred.annotations}
         from seldon_core_tpu.operator.compile import (
             graph_plan_mode,
+            health_config,
             prediction_cache_config,
             qos_config,
             trace_config,
@@ -189,6 +190,19 @@ class LocalPredictor:
             from seldon_core_tpu.qos import EngineQos
 
             self.qos = EngineQos(qos_cfg, metrics=self.metrics.registry)
+        # Health plane (docs/observability.md): introspection sampler +
+        # flight recorder + SLO burn monitor, one plane per predictor;
+        # seldon.io/health or seldon.io/slo-availability turns it on
+        health_cfg = health_config(dep, pred)
+        self.health = None
+        if health_cfg is not None and health_cfg.enabled:
+            from seldon_core_tpu.health import HealthPlane
+
+            self.health = HealthPlane(
+                health_cfg, metrics=self.metrics.registry,
+                service="engine", deployment=dep.name,
+            )
+            self.health.qos = self.qos
         self.engine = GraphEngine(
             pred.graph,
             resolver=lambda u: resolve_component(
@@ -205,11 +219,53 @@ class LocalPredictor:
             cache=self.cache,
             cache_version=str(ann.get("seldon.io/spec-hash", "")),
             qos=self.qos,
+            health=self.health,
         )
         if (self.engine.plan is not None
                 and ann.get("seldon.io/graph-plan-warmup", "").lower()
                 in ("1", "true", "yes")):
             self.engine.plan.warmup()
+        if self.health is not None:
+            self._wire_health_probes()
+
+    def _wire_health_probes(self) -> None:
+        """Point the introspection sampler at this predictor's runtime
+        objects (engine plan, caches, admission, device memory/registry)
+        and make the device-buffer registry's own gauges live."""
+        from seldon_core_tpu.health import (
+            batcher_probe,
+            cache_probe,
+            device_memory_probe,
+            device_registry_probe,
+            engine_probe,
+            qos_probe,
+        )
+        from seldon_core_tpu.runtime.device_registry import (
+            registry as device_registry,
+        )
+
+        device_registry.attach_metrics(self.metrics.registry)
+        sampler = self.health.sampler
+        sampler.add_probe("device", device_memory_probe())
+        sampler.add_probe("device_registry", device_registry_probe())
+        sampler.add_probe("engine", engine_probe(self.engine))
+        if self.cache is not None:
+            sampler.add_probe("cache", cache_probe(self.cache))
+        if self.qos is not None:
+            sampler.add_probe("qos", qos_probe(self.qos))
+        plan = self.engine.plan
+        if plan is not None:
+            for seg in plan.segments:
+                if seg.batcher is not None:
+                    sampler.add_probe(f"batcher:{seg.label}",
+                                      batcher_probe(seg.batcher))
+        else:
+            from seldon_core_tpu.runtime.batcher import BatchedModel
+
+            for name, node in self.engine._nodes.items():
+                if isinstance(node.impl, BatchedModel):
+                    sampler.add_probe(f"batcher:{name}",
+                                      batcher_probe(node.impl._batcher))
 
 
 def _tracer_from_config(ann: dict):
@@ -270,6 +326,21 @@ class LocalDeployment:
                 }
 
             publish(dep.name, _qos_snapshot)
+        # same pattern for the health plane: verdict + burn state +
+        # sampler/flight-recorder stats land in status.health beside
+        # status.qos (operator/reconcile.py compute_status)
+        if any(p.health is not None for p in self.predictors):
+            from seldon_core_tpu.health import publish as health_publish
+
+            def _health_snapshot(preds=self.predictors):
+                return {
+                    "predictors": [
+                        {"name": p.spec.name, **p.health.snapshot()}
+                        for p in preds if p.health is not None
+                    ]
+                }
+
+            health_publish(dep.name, _health_snapshot)
         self._rng = random.Random(seed)
         weights = [max(p.spec.replicas, 0) * max(p.spec.traffic, 0)
                    for p in self.predictors]
@@ -298,6 +369,16 @@ class LocalDeployment:
             if p.engine.tracer is not NULL_TRACER:
                 return p.engine.tracer
         return NULL_TRACER
+
+    @property
+    def health(self):
+        """First health-enabled predictor's plane (the /admin/health,
+        /admin/introspect and /admin/flightrecorder endpoints read
+        ``engine.health`` — same delegation rationale as ``tracer``)."""
+        for p in self.predictors:
+            if p.health is not None:
+                return p.health
+        return None
 
     async def predict(self, msg):
         return await self.pick().engine.predict(msg)

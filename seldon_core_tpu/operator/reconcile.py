@@ -418,6 +418,14 @@ class SeldonDeploymentController:
         qos = qos_snapshot(owner)
         if qos is not None:
             status["qos"] = qos
+        # Health verdict (docs/observability.md): SLO burn state, sampler
+        # and flight-recorder stats, published by the same process-local
+        # pattern (health/registry.py) — status.health beside status.qos.
+        from seldon_core_tpu.health import snapshot as health_snapshot
+
+        health = health_snapshot(owner)
+        if health is not None:
+            status["health"] = health
         return status
 
     # -- internals -------------------------------------------------------

@@ -60,12 +60,58 @@ class ForeignProcessRef(ValueError):
 
 
 class DeviceBufferRegistry:
-    def __init__(self, capacity: int = 256, ttl_s: float = 300.0):
+    def __init__(self, capacity: int = 256, ttl_s: float = 300.0,
+                 metrics=None):
         self.capacity = capacity
         self.ttl_s = ttl_s
-        self._entries: "OrderedDict[str, tuple[Any, float]]" = OrderedDict()
+        #: entry → (array, registered_at, nbytes)
+        self._entries: "OrderedDict[str, tuple[Any, float, int]]" = \
+            OrderedDict()
         self._lock = threading.Lock()
         self._shm_exports: "OrderedDict[str, float]" = OrderedDict()
+        self.metrics = metrics
+        self._bytes = 0
+        self._reaped = 0
+
+    # -- observability ---------------------------------------------------
+    def attach_metrics(self, metrics) -> None:
+        """Late-bind a MetricsRegistry (the module singleton is built at
+        import, before any registry exists) and push current state."""
+        self.metrics = metrics
+        with self._lock:
+            self._export_locked()
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes currently held by registered (non-shm) entries."""
+        with self._lock:
+            return self._bytes
+
+    @property
+    def reaped(self) -> int:
+        """Entries/exports reaped by TTL or capacity (never consumed)."""
+        with self._lock:
+            return self._reaped
+
+    def _export_locked(self) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.gauge_set(
+                "seldon_device_registry_entries", len(self._entries))
+            self.metrics.gauge_set(
+                "seldon_device_registry_bytes", self._bytes)
+        except Exception:
+            pass
+
+    def _note_reaped_locked(self, kind: str, n: int = 1) -> None:
+        self._reaped += n
+        if self.metrics is not None and n:
+            try:
+                self.metrics.counter_inc(
+                    "seldon_device_registry_reaped_total", {"kind": kind}, n)
+            except Exception:
+                pass
 
     # -- cross-process (same host): POSIX shared-memory staging ---------
     def put_shm(self, array: Any) -> str:
@@ -115,8 +161,11 @@ class DeviceBufferRegistry:
                 seg = shared_memory.SharedMemory(name=name)
                 seg.close()
                 seg.unlink()
+                # only an export the consumer never took counts as reaped
+                self._note_reaped_locked("shm")
             except FileNotFoundError:
                 pass  # consumed
+        self._export_locked()
 
     @staticmethod
     def _resolve_shm(ref: str) -> Any:
@@ -172,16 +221,21 @@ class DeviceBufferRegistry:
         """Register ``array``; returns the ref string for the wire."""
         key = uuid.uuid4().hex
         now = time.monotonic()
+        nbytes = int(getattr(array, "nbytes", 0) or 0)
         with self._lock:
-            self._entries[key] = (array, now)
+            self._entries[key] = (array, now, nbytes)
+            self._bytes += nbytes
             # evict expired, then oldest-over-capacity (never grows unbounded
             # when a consumer dies between put and resolve)
             while self._entries:
-                k, (_, t) = next(iter(self._entries.items()))
+                k, (_, t, nb) = next(iter(self._entries.items()))
                 if now - t > self.ttl_s or len(self._entries) > self.capacity:
                     self._entries.popitem(last=False)
+                    self._bytes -= nb
+                    self._note_reaped_locked("entry")
                 else:
                     break
+            self._export_locked()
         return f"{process_token()}/{key}"
 
     def resolve(self, ref: str, consume: bool = True) -> Any:
@@ -209,6 +263,8 @@ class DeviceBufferRegistry:
                 )
             if consume:
                 del self._entries[key]
+                self._bytes -= entry[2]
+                self._export_locked()
         return entry[0]
 
     def __len__(self) -> int:

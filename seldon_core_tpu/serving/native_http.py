@@ -32,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import sys
 import time
 from collections import deque
 from typing import Any, Awaitable, Callable, Optional
@@ -42,6 +43,10 @@ from seldon_core_tpu.native import NativeHttpServer
 logger = logging.getLogger(__name__)
 
 __all__ = ["NativeGrpcServer", "NativeRestServer"]
+
+#: asyncio.Task(eager_start=) landed in 3.12; passing it earlier raises
+#: TypeError on every request spawn (the server then never answers)
+_EAGER_TASKS = sys.version_info >= (3, 12)
 
 # router result: (status, body_bytes, message) — status is the grpc-status
 # for h2 and the HTTP status for h1
@@ -118,11 +123,17 @@ class _AsyncBridge:
         # current_task()-dependent handler code (asyncio.timeout /
         # wait_for raise outside a task on 3.12) — eager tasks keep the
         # semantics; the measured win is within run-to-run noise, the
-        # Task allocation dominating what remains.
-        t = asyncio.Task(
-            self._run(token, method, path, body),
-            loop=self._loop, eager_start=True,
-        )
+        # Task allocation dominating what remains.  eager_start only
+        # exists on 3.12+; older runtimes take the ordinary scheduled
+        # task (one extra loop wakeup, same semantics).
+        if _EAGER_TASKS:
+            t = asyncio.Task(
+                self._run(token, method, path, body),
+                loop=self._loop, eager_start=True,
+            )
+        else:
+            t = self._loop.create_task(
+                self._run(token, method, path, body))
         if not t.done():
             self._tasks.add(t)
             t.add_done_callback(self._tasks.discard)

@@ -178,6 +178,80 @@ def _ops_paths() -> dict:
     }
 
 
+def _health_paths() -> dict:
+    """The health-plane admin surface — identical on gateway and engine
+    (docs/observability.md#health-plane)."""
+    disabled = {"404": {"description": "health plane disabled"}}
+    bad_num = {"400": {"description": "non-numeric query parameter"}}
+    return {
+        "/admin/health": {
+            "get": {
+                "summary": "SLO burn-rate verdict fused with live QoS "
+                           "posture",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "verbose", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "inline the latest introspection "
+                                    "sample + flight-recorder stats"},
+                ],
+                "responses": {
+                    "200": {"description":
+                            "verdict ok|warn|critical + burn rates"},
+                    **disabled,
+                },
+            }
+        },
+        "/admin/introspect": {
+            "get": {
+                "summary": "bounded runtime-introspection timeline",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "n", "in": "query",
+                     "schema": {"type": "integer"}},
+                    {"name": "probe", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "stats", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "sampler counters only"},
+                ],
+                "responses": {
+                    "200": {"description": "samples + sampler stats"},
+                    **bad_num, **disabled,
+                },
+            }
+        },
+        "/admin/flightrecorder": {
+            "get": {
+                "summary": "per-request flight records (every request, "
+                           "independent of trace sampling)",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "deployment", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "status", "in": "query",
+                     "schema": {"type": "integer"}},
+                    {"name": "puid", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "min_ms", "in": "query",
+                     "schema": {"type": "number"}},
+                    {"name": "errors_only", "in": "query",
+                     "schema": {"type": "boolean"}},
+                    {"name": "n", "in": "query",
+                     "schema": {"type": "integer", "default": 50}},
+                    {"name": "stats", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "ring counters only"},
+                ],
+                "responses": {
+                    "200": {"description": "matching records + ring stats"},
+                    **bad_num, **disabled,
+                },
+            }
+        },
+    }
+
+
 def gateway_spec() -> dict:
     """External API (reference apife.oas3.json)."""
     paths = {
@@ -242,6 +316,7 @@ def gateway_spec() -> dict:
                 },
             }
         },
+        **_health_paths(),
         **_ops_paths(),
     }
     return {
@@ -283,6 +358,7 @@ def engine_spec() -> dict:
         "/trace": {"get": {"summary": "recent request trace spans",
                            "tags": ["ops"],
                            "responses": {"200": {"description": "traces"}}}},
+        **_health_paths(),
         **_ops_paths(),
     }
     return {

@@ -370,6 +370,43 @@ class EngineServer:
             )
         return web.Response(text=body, content_type="application/json")
 
+    def _health_plane(self):
+        """The engine's health plane (duck attr — the engine may be a
+        GraphEngine or a LocalDeployment façade), or None."""
+        return getattr(self.engine, "health", None)
+
+    async def _health_endpoint(self, request: web.Request,
+                               body_fn) -> web.Response:
+        """Shared wrapper for the /admin/* health endpoints: 404 + hint
+        when the plane is off, 400 on malformed numeric params (the
+        /admin/traces contract)."""
+        try:
+            status, payload = body_fn(self._health_plane(), request.query)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, "numeric query parameter expected"),
+                content_type="application/json",
+            )
+        return web.Response(
+            status=status, text=json.dumps(payload),
+            content_type="application/json",
+        )
+
+    async def introspect(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.health.http import introspect_body
+
+        return await self._health_endpoint(request, introspect_body)
+
+    async def flightrecorder(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.health.http import flightrecorder_body
+
+        return await self._health_endpoint(request, flightrecorder_body)
+
+    async def health_verdict(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.health.http import health_body
+
+        return await self._health_endpoint(request, health_body)
+
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
         app.router.add_post("/api/v0.1/stream", self.stream)
@@ -381,6 +418,9 @@ class EngineServer:
         app.router.add_get("/unpause", self.unpause)
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_get("/trace", self.trace)
+        app.router.add_get("/admin/introspect", self.introspect)
+        app.router.add_get("/admin/flightrecorder", self.flightrecorder)
+        app.router.add_get("/admin/health", self.health_verdict)
         app.router.add_get("/seldon.json", _openapi_handler("engine"))
 
 

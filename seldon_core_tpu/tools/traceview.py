@@ -8,11 +8,19 @@ request's wall-clock went: host dispatch, device compute
 (``block_until_ready``), network/queue (time inside a span but outside
 any child), and shed/degraded/chaos events.
 
+With ``--introspect`` pointing at an ``/admin/introspect`` dump (the
+health plane's runtime timelines), ``--lanes`` adds sparkline lanes
+under the waterfalls — device memory and batch-queue depth over the
+same wall-clock the traces cover — so a latency spike can be eyeballed
+against HBM pressure or queue buildup without leaving the terminal.
+
 Usage::
 
     python -m seldon_core_tpu.tools.traceview /tmp/traces.jsonl
     python -m seldon_core_tpu.tools.traceview traces.jsonl --trace-id 0af7...
     curl -s engine:8000/trace | python -m seldon_core_tpu.tools.traceview -
+    python -m seldon_core_tpu.tools.traceview traces.jsonl \
+        --introspect introspect.json --lanes memory,queue
 
 No external dependencies: the OTLP envelope is parsed right back into the
 plain span dicts the renderer consumes.
@@ -281,13 +289,101 @@ def render_report(traces: list[tuple[dict, str]], width: int = 100,
     return "\n".join(out)
 
 
+# ---------------------------------------------------------------------------
+# introspection lanes (health plane /admin/introspect overlays)
+# ---------------------------------------------------------------------------
+
+#: sparkline ramp, low → high (pure ASCII like the waterfall bars)
+_RAMP = " .:-=+*#%@"
+
+#: lane name → (label, unit, extractor over one sample's probe dicts)
+_LANES = {
+    "memory": (
+        "memory", "MB",
+        lambda probes: _first_value(
+            probes, ("hbm_bytes_in_use", "host_rss_bytes")) / 1e6,
+    ),
+    "queue": (
+        "queue", "rows",
+        lambda probes: sum(
+            float(p.get("queue_rows", 0.0) or 0.0) for p in probes.values()),
+    ),
+}
+
+
+def _first_value(probes: dict, keys: tuple) -> float:
+    for key in keys:
+        for p in probes.values():
+            if key in p:
+                return float(p[key] or 0.0)
+    return 0.0
+
+
+def load_introspection(stream: Iterable[str]) -> list[dict]:
+    """Parse an ``/admin/introspect`` response (``{"samples": [...]}``),
+    a bare samples list, or JSON-lines of samples into sample dicts."""
+    text = "".join(stream).strip()
+    if not text:
+        return []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        out = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+        return [s for s in out if isinstance(s, dict) and "probes" in s]
+    if isinstance(doc, dict):
+        doc = doc.get("samples", [])
+    if not isinstance(doc, list):
+        return []
+    return [s for s in doc if isinstance(s, dict) and "probes" in s]
+
+
+def render_lanes(samples: list[dict], lanes: list[str],
+                 width: int = 100) -> str:
+    """Sparkline lanes over the introspection timeline: one row per lane,
+    amplitude normalized per lane, min/max printed so the ramp has
+    units.  Sample count > width is downsampled by striding."""
+    if not samples:
+        return "no introspection samples"
+    lane_w = max(16, width - 40)
+    stride = max(1, -(-len(samples) // lane_w))  # ceil division
+    picked = samples[::stride]
+    t0 = float(picked[0].get("ts", 0.0))
+    t1 = float(picked[-1].get("ts", t0))
+    lines = [f"introspection: {len(samples)} sample(s) over "
+             f"{max(0.0, t1 - t0):.1f}s"]
+    for name in lanes:
+        if name not in _LANES:
+            lines.append(f"  {name:<8s} (unknown lane; have: "
+                         f"{', '.join(sorted(_LANES))})")
+            continue
+        label, unit, fn = _LANES[name]
+        vals = [fn(s.get("probes", {})) for s in picked]
+        lo, hi = min(vals), max(vals)
+        span = (hi - lo) or 1.0
+        cells = "".join(
+            _RAMP[min(len(_RAMP) - 1,
+                      int((v - lo) / span * (len(_RAMP) - 1)))]
+            for v in vals)
+        lines.append(f"  {label:<8s}|{cells:<{lane_w}.{lane_w}s}| "
+                     f"{lo:.1f}..{hi:.1f} {unit}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[list] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="traceview",
         description="render exported traces as an ASCII waterfall",
     )
-    ap.add_argument("path", help="OTLP JSON-lines file, /trace JSON dump, "
-                                 "or '-' for stdin")
+    ap.add_argument("path", nargs="?", default="",
+                    help="OTLP JSON-lines file, /trace JSON dump, or '-' "
+                         "for stdin (optional with --introspect)")
     ap.add_argument("--trace-id", default="",
                     help="only render traces whose ID starts with this")
     ap.add_argument("--last", type=int, default=0,
@@ -297,13 +393,23 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--width", type=int, default=100)
     ap.add_argument("--summary", action="store_true",
                     help="aggregate summary only, no waterfalls")
+    ap.add_argument("--introspect", default="",
+                    help="/admin/introspect JSON dump to render as "
+                         "sparkline lanes under the report")
+    ap.add_argument("--lanes", default="memory,queue",
+                    help="comma-separated introspection lanes "
+                         "(memory,queue); used with --introspect")
     args = ap.parse_args(argv)
 
+    if not args.path and not args.introspect:
+        ap.error("a trace path and/or --introspect is required")
     if args.path == "-":
         traces = load_traces(sys.stdin)
-    else:
+    elif args.path:
         with open(args.path) as f:
             traces = load_traces(f)
+    else:
+        traces = []
     if args.trace_id:
         traces = [t for t in traces
                   if str(t[0].get("trace_id", "")).startswith(args.trace_id)]
@@ -313,11 +419,19 @@ def main(argv: Optional[list] = None) -> int:
                          for s in _walk(t[0]))]
     if args.last:
         traces = traces[-args.last:]
-    if not traces:
+    if not traces and not args.introspect:
         print("no traces matched", file=sys.stderr)
         return 1
-    print(render_report(traces, width=args.width,
-                        summary_only=args.summary))
+    if traces:
+        print(render_report(traces, width=args.width,
+                            summary_only=args.summary))
+    elif args.path:
+        print("no traces matched", file=sys.stderr)
+    if args.introspect:
+        with open(args.introspect) as f:
+            samples = load_introspection(f)
+        lanes = [x.strip() for x in args.lanes.split(",") if x.strip()]
+        print(render_lanes(samples, lanes, width=args.width))
     return 0
 
 

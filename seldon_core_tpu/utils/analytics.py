@@ -226,6 +226,169 @@ CATALOG: tuple[MetricInfo, ...] = (
         "release (OpenMetrics forbids gauges named *_total)",
         ("model_name",),
     ),
+    # -- health plane (docs/observability.md): runtime introspection
+    #    sampler, flight recorder, SLO burn monitor ----------------------
+    MetricInfo(
+        "seldon_runtime_hbm_bytes_in_use", "gauge",
+        "Device (HBM) bytes in use, from jax.Device.memory_stats() "
+        "(health-plane introspection sampler; absent on hosts whose "
+        "backend reports no memory stats)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_hbm_bytes_limit", "gauge",
+        "Device (HBM) byte capacity reported by the backend",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_host_rss_bytes", "gauge",
+        "Host resident set size (/proc fallback when the device exposes "
+        "no memory stats — CPU-only dev rigs still get a memory lane)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_event_loop_lag_ms", "gauge",
+        "Asyncio event-loop lag measured as sampler sleep overshoot — "
+        "the canary for blocking work on the serving hot path "
+        "(graphlint RL401 is the static twin)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_jit_segments", "gauge",
+        "Fused-plan segments in the serving graph (0 in walk mode)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_jit_segments_compiled", "gauge",
+        "Fused segments that have compiled (n_calls > 0) — compared to "
+        "seldon_runtime_jit_segments this exposes warmup coverage",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_jit_dispatches", "gauge",
+        "Cumulative jitted segment calls (compile-cache activity)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_queue_rows", "gauge",
+        "Rows waiting in a dynamic batcher's lanes at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_queue_lanes", "gauge",
+        "Distinct shape/dtype lanes currently queued in a batcher",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_queue_occupancy", "gauge",
+        "Queued rows / max_queue_rows (1.0 = backpressure sheds next)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_batch_inflight", "gauge",
+        "Device batches currently executing for a batcher",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_batch_latency_ewma_ms", "gauge",
+        "Batcher's EWMA of device batch latency (the adaptive max-wait "
+        "controller's input)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_cache_bytes", "gauge",
+        "Prediction-cache resident bytes as seen by the sampler (the "
+        "cache's own seldon_cache_bytes is event-driven; this one lands "
+        "on the introspection timeline)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_cache_entries", "gauge",
+        "Prediction-cache entry count at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_admission_limit", "gauge",
+        "QoS AIMD concurrency limit at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_admission_inflight", "gauge",
+        "Admission slots held at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_shed_level", "gauge",
+        "QoS shed level at sample time (0 none .. 3 all)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_registry_entries", "gauge",
+        "Zero-copy device-buffer registry entries at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_registry_bytes", "gauge",
+        "Bytes pinned by the device-buffer registry at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_sampler_ticks", "gauge",
+        "Introspection samples taken since process start (a flat line "
+        "means the sampler died — alert on it, it is the watchdog's "
+        "watchdog)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_health_verdict", "gauge",
+        "Health verdict per deployment: 0 ok, 1 warn, 2 critical "
+        "(/admin/health serves the contributing signals)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_health_burn_rate", "gauge",
+        "Error-budget burn rate per SLO objective and window "
+        "(slo=availability|latency, window=5m|1h; 1.0 = burning exactly "
+        "the budget, 14.4 sustained in both windows = critical)",
+        ("deployment", "slo", "window"),
+    ),
+    MetricInfo(
+        "seldon_flightrecorder_records", "gauge",
+        "Flight-recorder ring occupancy (bounded at "
+        "seldon.io/health-flight-records)",
+        ("service",),
+    ),
+    MetricInfo(
+        "seldon_flightrecorder_recorded", "gauge",
+        "Requests recorded since process start (recorded - records = "
+        "ring overwrites)",
+        ("service",),
+    ),
+    MetricInfo(
+        "seldon_metrics_dropped_series_total", "counter",
+        "Label series refused by the per-metric cardinality cap "
+        "(utils/metrics.py max_series) — a nonzero rate means some "
+        "label value is unbounded and that metric is now partial",
+        ("metric",),
+    ),
+    MetricInfo(
+        "seldon_device_registry_entries", "gauge",
+        "Zero-copy device-buffer registry entries (event-driven twin of "
+        "the seldon_runtime_* sampler series)",
+        (),
+    ),
+    MetricInfo(
+        "seldon_device_registry_bytes", "gauge",
+        "Bytes pinned by device-buffer registry entries awaiting "
+        "consumption",
+        (),
+    ),
+    MetricInfo(
+        "seldon_device_registry_reaped_total", "counter",
+        "Registry entries reaped (kind=entry on TTL/capacity eviction, "
+        "kind=shm for orphaned shared-memory segments)",
+        ("kind",),
+    ),
 )
 
 
@@ -364,6 +527,43 @@ def alert_rules() -> dict:
                         },
                     },
                     {
+                        "alert": "SeldonErrorBudgetFastBurn",
+                        "expr": (
+                            'max(seldon_health_burn_rate{window="5m"}) '
+                            "by (deployment, slo) > 14.4 and "
+                            'max(seldon_health_burn_rate{window="1h"}) '
+                            "by (deployment, slo) > 14.4"
+                        ),
+                        "for": "2m",
+                        "labels": {"severity": "critical"},
+                        "annotations": {
+                            "summary":
+                                "{{ $labels.deployment }} burning "
+                                "{{ $labels.slo }} error budget at >14.4x "
+                                "in both the 5m and 1h windows — budget "
+                                "gone within hours (multiwindow SRE burn "
+                                "alert; /admin/health has the signals)",
+                        },
+                    },
+                    {
+                        "alert": "SeldonErrorBudgetSlowBurn",
+                        "expr": (
+                            'max(seldon_health_burn_rate{window="5m"}) '
+                            "by (deployment, slo) > 6 and "
+                            'max(seldon_health_burn_rate{window="1h"}) '
+                            "by (deployment, slo) > 6"
+                        ),
+                        "for": "15m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "{{ $labels.deployment }} burning "
+                                "{{ $labels.slo }} error budget at >6x "
+                                "sustained — on track to exhaust the "
+                                "monthly budget early",
+                        },
+                    },
+                    {
                         "alert": "SeldonGatewayRetrying",
                         "expr": (
                             "sum(rate(seldon_api_gateway_retries_total[5m])) "
@@ -463,6 +663,20 @@ def grafana_dashboard() -> dict:
                ["seldon_qos_breaker_state",
                 "sum(rate(seldon_qos_degraded_total[5m])) "
                 "by (graph, reason)"], y=40, x=12),
+        _panel(13, "SLO error-budget burn rate (5m/1h)",
+               ["max(seldon_health_burn_rate) by (deployment, slo, window)",
+                "max(seldon_health_verdict) by (deployment)"],
+               y=48, x=0),
+        _panel(14, "Device memory (HBM / host RSS)",
+               ["max(seldon_runtime_hbm_bytes_in_use) by (probe)",
+                "max(seldon_runtime_hbm_bytes_limit) by (probe)",
+                "max(seldon_runtime_host_rss_bytes) by (probe)"],
+               y=48, x=12, unit="bytes"),
+        _panel(15, "Batch queue depth + event-loop lag",
+               ["sum(seldon_runtime_queue_rows) by (probe)",
+                "max(seldon_runtime_queue_occupancy) by (probe)",
+                "max(seldon_runtime_event_loop_lag_ms) by (probe)"],
+               y=56, x=0),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",

@@ -38,10 +38,20 @@ def _escape(v: str) -> str:
 
 
 class MetricsRegistry:
-    """Thread-safe counter/gauge/histogram registry with label support."""
+    """Thread-safe counter/gauge/histogram registry with label support.
 
-    def __init__(self):
+    Series cardinality is capped per metric name (``max_series``,
+    default 1000): a label value that would mint a new series beyond the
+    cap is dropped and counted in ``seldon_metrics_dropped_series_total``
+    instead — an abusive or unbounded label (puid, raw path, …) can cost
+    data, never the scrape path's memory.
+    """
+
+    DROPPED_SERIES = "seldon_metrics_dropped_series_total"
+
+    def __init__(self, max_series: int = 1000):
         self._lock = threading.Lock()
+        self.max_series = max_series
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
         self._hist_counts: dict[tuple, list[int]] = {}
@@ -52,17 +62,42 @@ class MetricsRegistry:
         # OpenMetrics exemplar so dashboards deep-link latency to traces
         self._hist_exemplars: dict[tuple, tuple[str, float, float]] = {}
         self._help: dict[str, str] = {}
+        # metric name -> count of distinct label sets across all kinds
+        self._series_count: dict[str, int] = defaultdict(int)
 
     def _key(self, name: str, labels: Optional[dict]) -> tuple:
         return (name, tuple(sorted((labels or {}).items())))
 
+    def _admit_locked(self, key: tuple, store: dict) -> bool:
+        """Cardinality gate for a series about to be minted (lock held).
+        Existing series always pass — only *new* label sets count."""
+        if key in store:
+            return True
+        name = key[0]
+        if self._series_count[name] >= self.max_series:
+            # the drop counter bypasses the cap; its own cardinality is
+            # bounded by the number of distinct metric names
+            dropped = (self.DROPPED_SERIES, (("metric", name),))
+            if dropped not in self._counters:
+                self._series_count[self.DROPPED_SERIES] += 1
+            self._counters[dropped] += 1
+            return False
+        self._series_count[name] += 1
+        return True
+
     def counter_inc(self, name: str, labels: Optional[dict] = None, value: float = 1.0):
+        key = self._key(name, labels)
         with self._lock:
-            self._counters[self._key(name, labels)] += value
+            if not self._admit_locked(key, self._counters):
+                return
+            self._counters[key] += value
 
     def gauge_set(self, name: str, value: float, labels: Optional[dict] = None):
+        key = self._key(name, labels)
         with self._lock:
-            self._gauges[self._key(name, labels)] = value
+            if not self._admit_locked(key, self._gauges):
+                return
+            self._gauges[key] = value
 
     def observe(self, name: str, value: float, labels: Optional[dict] = None):
         """Histogram observation (seconds for timers).  When a sampled
@@ -75,6 +110,8 @@ class MetricsRegistry:
             exemplar = (ctx.trace_id, value, time.time())
         with self._lock:
             if key not in self._hist_counts:
+                if not self._admit_locked(key, self._hist_counts):
+                    return
                 self._hist_counts[key] = [0] * (len(_DEFAULT_BUCKETS) + 1)
             counts = self._hist_counts[key]
             for i, b in enumerate(_DEFAULT_BUCKETS):
@@ -115,39 +152,56 @@ class MetricsRegistry:
 
     # ---- exposition ----------------------------------------------------
     def render(self) -> str:
-        lines: list[str] = []
+        # Snapshot under the lock, format outside it: formatting grows
+        # linearly with series count and must not stall every concurrent
+        # counter_inc/observe on the serving path for its duration.
         with self._lock:
-            seen_types: set[str] = set()
-            for (name, labels), v in sorted(self._counters.items()):
-                if name not in seen_types:
-                    lines.append(f"# TYPE {name} counter")
-                    seen_types.add(name)
-                lines.append(f"{name}{_fmt_labels(dict(labels))} {v}")
-            for (name, labels), v in sorted(self._gauges.items()):
-                if name not in seen_types:
-                    lines.append(f"# TYPE {name} gauge")
-                    seen_types.add(name)
-                lines.append(f"{name}{_fmt_labels(dict(labels))} {v}")
-            for key in sorted(self._hist_counts):
-                name, labels = key
-                ld = dict(labels)
-                if name not in seen_types:
-                    lines.append(f"# TYPE {name} histogram")
-                    seen_types.add(name)
-                cum = 0
-                for i, b in enumerate(_DEFAULT_BUCKETS):
-                    cum += self._hist_counts[key][i]
-                    lines.append(
-                        f'{name}_bucket{_fmt_labels({**ld, "le": repr(b)})} {cum}'
-                        f'{self._exemplar_suffix(key, i)}'
-                    )
-                cum += self._hist_counts[key][-1]
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hist_counts = {k: list(v) for k, v in self._hist_counts.items()}
+            hist_sum = dict(self._hist_sum)
+            hist_total = dict(self._hist_total)
+            exemplars = dict(self._hist_exemplars)
+
+        def exemplar_suffix(key: tuple, bucket: int) -> str:
+            ex = exemplars.get((key, bucket))
+            if ex is None:
+                return ""
+            trace_id, value, ts = ex
+            return f' # {{trace_id="{trace_id}"}} {value} {ts}'
+
+        lines: list[str] = []
+        seen_types: set[str] = set()
+        for (name, labels), v in sorted(counters.items()):
+            if name not in seen_types:
+                lines.append(f"# TYPE {name} counter")
+                seen_types.add(name)
+            lines.append(f"{name}{_fmt_labels(dict(labels))} {v}")
+        for (name, labels), v in sorted(gauges.items()):
+            if name not in seen_types:
+                lines.append(f"# TYPE {name} gauge")
+                seen_types.add(name)
+            lines.append(f"{name}{_fmt_labels(dict(labels))} {v}")
+        for key in sorted(hist_counts):
+            name, labels = key
+            ld = dict(labels)
+            if name not in seen_types:
+                lines.append(f"# TYPE {name} histogram")
+                seen_types.add(name)
+            cum = 0
+            for i, b in enumerate(_DEFAULT_BUCKETS):
+                cum += hist_counts[key][i]
                 lines.append(
-                    f'{name}_bucket{_fmt_labels({**ld, "le": "+Inf"})} {cum}'
-                    f'{self._exemplar_suffix(key, len(_DEFAULT_BUCKETS))}'
+                    f'{name}_bucket{_fmt_labels({**ld, "le": repr(b)})} {cum}'
+                    f'{exemplar_suffix(key, i)}'
                 )
-                lines.append(f"{name}_sum{_fmt_labels(ld)} {self._hist_sum[key]}")
-                lines.append(f"{name}_count{_fmt_labels(ld)} {self._hist_total[key]}")
+            cum += hist_counts[key][-1]
+            lines.append(
+                f'{name}_bucket{_fmt_labels({**ld, "le": "+Inf"})} {cum}'
+                f'{exemplar_suffix(key, len(_DEFAULT_BUCKETS))}'
+            )
+            lines.append(f"{name}_sum{_fmt_labels(ld)} {hist_sum[key]}")
+            lines.append(f"{name}_count{_fmt_labels(ld)} {hist_total[key]}")
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,662 @@
+"""Health plane: config, burn-rate monitor, flight recorder, sampler,
+admin endpoints, graphlint GL10xx, replay parity, metric hygiene."""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.health import (
+    BurnRateMonitor,
+    FlightRecorder,
+    HealthConfig,
+    HealthPlane,
+    RuntimeSampler,
+    health_config_from_annotations,
+)
+from seldon_core_tpu.health.flightrecorder import REQUEST_CAP_BYTES
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+
+def _plane(slo_availability=0.999, slo_p95_ms=None, clock=None, **kw):
+    cfg = HealthConfig(enabled=True, slo_availability=slo_availability,
+                       slo_p95_ms=slo_p95_ms)
+    kwargs = dict(kw)
+    if clock is not None:
+        kwargs["clock"] = clock
+    return HealthPlane(cfg, **kwargs)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_defaults_off(self):
+        cfg = health_config_from_annotations({})
+        assert not cfg.enabled
+
+    def test_explicit_enable(self):
+        cfg = health_config_from_annotations({"seldon.io/health": "true"})
+        assert cfg.enabled and cfg.sample_ms == 1000.0
+        assert cfg.slo_availability is None
+
+    def test_availability_objective_implies_enable(self):
+        cfg = health_config_from_annotations(
+            {"seldon.io/slo-availability": "0.999"})
+        assert cfg.enabled and cfg.slo_availability == 0.999
+
+    def test_knobs(self):
+        cfg = health_config_from_annotations({
+            "seldon.io/health": "yes",
+            "seldon.io/health-sample-ms": "250",
+            "seldon.io/health-timeline": "64",
+            "seldon.io/health-flight-records": "16",
+            "seldon.io/slo-p95-ms": "50",
+        })
+        assert cfg.sample_ms == 250.0 and cfg.timeline == 64
+        assert cfg.flight_records == 16 and cfg.slo_p95_ms == 50.0
+
+    @pytest.mark.parametrize("ann,fragment", [
+        ({"seldon.io/health": "maybe"}, "seldon.io/health"),
+        ({"seldon.io/slo-availability": "1.0"}, "outside (0, 1)"),
+        ({"seldon.io/slo-availability": "0"}, "outside (0, 1)"),
+        ({"seldon.io/slo-availability": "nope"}, "not a number"),
+        ({"seldon.io/health": "1",
+          "seldon.io/health-sample-ms": "-5"}, "must be > 0"),
+        ({"seldon.io/health": "1",
+          "seldon.io/health-timeline": "x"}, "not an integer"),
+        ({"seldon.io/health": "1",
+          "seldon.io/health-flight-records": "0"}, "must be > 0"),
+    ])
+    def test_invalid(self, ann, fragment):
+        with pytest.raises(ValueError) as ei:
+            health_config_from_annotations(ann, "d/p")
+        assert fragment in str(ei.value)
+        assert " at d/p" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate monitor
+# ---------------------------------------------------------------------------
+
+class TestBurnRate:
+    def test_ok_when_idle_or_healthy(self):
+        clk = FakeClock()
+        m = BurnRateMonitor(slo_p95_ms=100.0, slo_availability=0.999,
+                            clock=clk)
+        assert m.verdict()["verdict"] == "ok"
+        for _ in range(100):
+            m.observe(5.0, error=False)
+        assert m.verdict()["verdict"] == "ok"
+
+    def test_error_burst_goes_critical(self):
+        clk = FakeClock()
+        m = BurnRateMonitor(slo_p95_ms=None, slo_availability=0.999,
+                            clock=clk)
+        # 10% errors vs a 0.1% budget = 100x burn in both windows
+        for i in range(100):
+            m.observe(1.0, error=(i % 10 == 0))
+        v = m.verdict()
+        assert v["verdict"] == "critical"
+        assert "availability-burn" in v["signals"]
+        assert v["burn"]["availability"]["5m"] > 14.4
+
+    def test_latency_burn_warns_then_clears(self):
+        clk = FakeClock()
+        m = BurnRateMonitor(slo_p95_ms=10.0, slo_availability=None,
+                            clock=clk)
+        # 40% of requests over the p95 bar vs the 5% budget = 8x burn:
+        # above the 6x warn threshold, below 14.4x critical
+        for i in range(100):
+            m.observe(50.0 if i % 5 < 2 else 1.0, error=False)
+        v = m.verdict()
+        assert v["verdict"] == "warn" and "latency-burn" in v["signals"]
+        # the burst ages out of the 5m window -> ok again
+        clk.t += 301
+        for _ in range(20):
+            m.observe(1.0, error=False)
+        assert m.verdict()["verdict"] == "ok"
+
+    def test_min_volume_suppresses_noise(self):
+        m = BurnRateMonitor(slo_p95_ms=None, slo_availability=0.999,
+                            clock=FakeClock())
+        for _ in range(5):
+            m.observe(1.0, error=True)  # 100% errors but only 5 requests
+        assert m.verdict()["verdict"] == "ok"
+
+    def test_both_windows_must_burn(self):
+        clk = FakeClock()
+        m = BurnRateMonitor(slo_p95_ms=None, slo_availability=0.999,
+                            clock=clk)
+        # long healthy history dilutes the 1h window below threshold
+        for _ in range(40):
+            for _ in range(100):
+                m.observe(1.0, error=False)
+            clk.t += 60
+        for _ in range(50):
+            m.observe(1.0, error=True)
+        v = m.verdict()
+        assert v["burn"]["availability"]["5m"] > 14.4
+        assert v["verdict"] != "critical"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def _rec(self, fr, puid, status=200, ms=1.0, deployment="d", **kw):
+        return fr.record(puid=puid, trace_id="", deployment=deployment,
+                         route=("m",), node_ms={"m": ms}, status=status,
+                         reason="", duration_ms=ms, flags={}, **kw)
+
+    def test_ring_bound_holds_under_concurrency(self):
+        fr = FlightRecorder(32, service="engine")
+        errs = []
+
+        def worker(k):
+            try:
+                for i in range(200):
+                    self._rec(fr, f"p{k}-{i}")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        st = fr.stats()
+        assert st["size"] == 32 and st["capacity"] == 32
+        assert st["recorded"] == 8 * 200
+        assert st["dropped"] == 8 * 200 - 32
+        assert len(fr.query(n=1000)) == 32
+
+    def test_filters_and_get(self):
+        fr = FlightRecorder(16, service="engine")
+        self._rec(fr, "a", status=200, ms=1.0, deployment="d1")
+        self._rec(fr, "b", status=500, ms=5.0, deployment="d1")
+        self._rec(fr, "c", status=200, ms=50.0, deployment="d2")
+        assert [r["puid"] for r in fr.query()] == ["c", "b", "a"]
+        assert [r["puid"] for r in fr.query(errors_only=True)] == ["b"]
+        assert [r["puid"] for r in fr.query(min_ms=10.0)] == ["c"]
+        assert [r["puid"] for r in fr.query(deployment="d1",
+                                            status=200)] == ["a"]
+        assert fr.get("b")["status"] == 500
+        assert fr.get("zzz") is None
+
+    def test_request_capture_capped(self):
+        fr = FlightRecorder(4, service="gateway")
+        small = {"body": "{}", "contentType": "application/json",
+                 "path": "/p"}
+        self._rec(fr, "ok", request=small, request_bytes=2)
+        self._rec(fr, "big", request=dict(small),
+                  request_bytes=REQUEST_CAP_BYTES + 1)
+        assert fr.get("ok")["request"] == small
+        assert fr.get("big")["request"] is None
+        assert fr.get("big")["requestTruncated"] is True
+
+    def test_gauges_exported(self):
+        reg = MetricsRegistry()
+        fr = FlightRecorder(2, service="engine", metrics=reg)
+        for i in range(3):
+            self._rec(fr, f"p{i}")
+        text = reg.render()
+        assert 'seldon_flightrecorder_records{service="engine"} 2' in text
+        assert 'seldon_flightrecorder_recorded{service="engine"} 3' in text
+
+
+# ---------------------------------------------------------------------------
+# sampler
+# ---------------------------------------------------------------------------
+
+class TestSampler:
+    def test_sample_once_and_probe_errors(self):
+        s = RuntimeSampler(interval_s=0.01, timeline=8)
+        s.add_probe("good", lambda: {"queue_rows": 3})
+        s.add_probe("bad", lambda: 1 / 0)
+        sample = s.sample_once()
+        assert sample["probes"]["good"]["queue_rows"] == 3
+        assert "bad" not in sample["probes"]
+        assert "event_loop_lag_ms" in sample["probes"]["loop"]
+        assert s.stats()["probeErrors"] == 1
+
+    def test_timeline_bounded(self):
+        s = RuntimeSampler(interval_s=0.01, timeline=4)
+        s.add_probe("p", lambda: {"queue_rows": 1})
+        for _ in range(10):
+            s.sample_once()
+        assert len(s.timeline()) == 4
+        assert s.stats()["samples"] == 10
+
+    def test_lifecycle_no_leaked_tasks(self):
+        async def run():
+            s = RuntimeSampler(interval_s=0.005, timeline=16)
+            s.add_probe("p", lambda: {"queue_rows": 1})
+            s.ensure_started()
+            assert s.running
+            s.ensure_started()  # idempotent
+            await asyncio.sleep(0.05)
+            assert s.stats()["samples"] >= 2
+            await s.stop()
+            assert not s.running
+            # no health-sampler task left behind
+            names = {t.get_name() for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()}
+            assert "health-sampler" not in names
+
+        asyncio.run(run())
+
+    def test_ensure_started_without_loop_is_noop(self):
+        s = RuntimeSampler(interval_s=0.01, timeline=4)
+        s.ensure_started()  # sync context: must not raise
+        assert not s.running
+
+    def test_gauge_export(self):
+        reg = MetricsRegistry()
+        s = RuntimeSampler(interval_s=0.01, timeline=4, metrics=reg,
+                           service="engine")
+        s.add_probe("b", lambda: {"queue_rows": 7, "not_a_gauge": 1})
+        s.sample_once()
+        text = reg.render()
+        assert 'seldon_runtime_queue_rows{probe="b"} 7' in text
+        assert 'seldon_runtime_sampler_ticks{probe="engine"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# plane verdict fusion
+# ---------------------------------------------------------------------------
+
+class TestPlane:
+    def test_qos_shed_becomes_warn_signal(self):
+        class FakeQos:
+            shed_level = 2
+
+            def open_breakers(self):
+                return ["m"]
+
+        p = _plane(clock=FakeClock())
+        p.qos = FakeQos()
+        v = p.verdict()
+        assert v["verdict"] == "warn"
+        assert "shed-level-2" in v["signals"]
+        assert "breaker-open" in v["signals"]
+        assert v["openBreakers"] == ["m"]
+
+    def test_note_request_feeds_monitor(self):
+        p = _plane(clock=FakeClock())
+        for _ in range(50):
+            p.note_request(1.0, 500)
+        assert p.verdict()["verdict"] == "critical"
+
+    def test_snapshot_shape(self):
+        p = _plane(clock=FakeClock(), deployment="dep")
+        snap = p.snapshot()
+        assert snap["verdict"] == "ok"
+        assert snap["slo"] == {"p95Ms": None, "availability": 0.999}
+        assert snap["sampler"]["timelineCap"] == 600
+        assert snap["flightRecorder"]["capacity"] == 1024
+
+    def test_verdict_gauges(self):
+        reg = MetricsRegistry()
+        cfg = HealthConfig(enabled=True, slo_availability=0.999)
+        p = HealthPlane(cfg, metrics=reg, deployment="dep",
+                        clock=FakeClock())
+        for _ in range(50):
+            p.note_request(1.0, 500)
+        p.verdict()
+        text = reg.render()
+        assert 'seldon_health_verdict{deployment="dep"} 2' in text
+        assert 'seldon_health_burn_rate{deployment="dep",' \
+               'slo="availability",window="5m"}' in text
+
+
+# ---------------------------------------------------------------------------
+# engine integration + admin endpoints
+# ---------------------------------------------------------------------------
+
+def _engine(plane=None, plan_mode="walk"):
+    return GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"},
+                       plan_mode=plan_mode, health=plane)
+
+
+class TestEngineIntegration:
+    def test_predict_records_flight(self):
+        plane = _plane(clock=FakeClock())
+        eng = _engine(plane)
+        out = asyncio.run(eng.predict(
+            SeldonMessage(data=np.array([[1.0, 2.0]]))))
+        assert out.status.status == "SUCCESS"
+        recs = plane.recorder.query()
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["puid"] == out.meta.puid
+        assert r["route"] == ["m"] and r["status"] == 200
+        assert r["nodeMs"]["m"] >= 0
+        assert r["flags"]["mode"] == "walk"
+        assert plane.monitor.burn()["windows"]["5m"]["total"] == 1
+
+    def test_engine_without_plane_unaffected(self):
+        eng = _engine(None)
+        out = asyncio.run(eng.predict(
+            SeldonMessage(data=np.array([[1.0, 2.0]]))))
+        assert out.status.status == "SUCCESS"
+
+    async def _client(self, plane):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.serving.rest import EngineServer
+
+        app = web.Application()
+        EngineServer(_engine(plane)).register(app)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    def test_admin_endpoints(self):
+        async def run():
+            plane = _plane(clock=FakeClock())
+            client = await self._client(plane)
+            try:
+                r = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}})
+                assert r.status == 200
+                puid = (await r.json())["meta"]["puid"]
+
+                r = await client.get("/admin/health")
+                body = await r.json()
+                assert r.status == 200 and body["verdict"] == "ok"
+                r = await client.get("/admin/health?verbose=1")
+                assert "flightRecorder" in await r.json()
+
+                r = await client.get("/admin/flightrecorder")
+                body = await r.json()
+                assert body["records"][0]["puid"] == puid
+                r = await client.get("/admin/flightrecorder",
+                                     params={"puid": puid})
+                assert (await r.json())["records"]
+                r = await client.get("/admin/flightrecorder?stats=1")
+                assert (await r.json())["stats"]["size"] == 1
+
+                plane.sampler.sample_once()
+                r = await client.get("/admin/introspect")
+                body = await r.json()
+                assert body["samples"] and body["stats"]["samples"] >= 1
+                r = await client.get("/admin/introspect",
+                                     params={"probe": "nope"})
+                assert r.status == 404
+                r = await client.get("/admin/introspect",
+                                     params={"n": "xyz"})
+                assert r.status == 400
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+    def test_admin_endpoints_disabled_404(self):
+        async def run():
+            client = await self._client(None)
+            try:
+                for path in ("/admin/health", "/admin/introspect",
+                             "/admin/flightrecorder"):
+                    r = await client.get(path)
+                    assert r.status == 404
+                    assert "hint" in await r.json()
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# replay parity (walk vs fused)
+# ---------------------------------------------------------------------------
+
+class TestReplayParity:
+    def test_walk_fused_byte_parity(self):
+        from seldon_core_tpu.tools.replay import (
+            canonical_body,
+            compare_responses,
+            replay_record,
+        )
+
+        async def run():
+            from aiohttp import web
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from seldon_core_tpu.serving.rest import EngineServer
+
+            clients = []
+            for mode in ("walk", "fused"):
+                app = web.Application()
+                EngineServer(_engine(None, plan_mode=mode)).register(app)
+                c = TestClient(TestServer(app))
+                await c.start_server()
+                clients.append(c)
+            try:
+                record = {
+                    "puid": "x", "request": {
+                        "body": json.dumps(
+                            {"data": {"ndarray": [[1.0, 2.0, 3.0]]}}),
+                        "contentType": "application/json",
+                        "path": "/api/v0.1/predictions",
+                    },
+                }
+                bodies = []
+                for c in clients:
+                    base = f"http://{c.host}:{c.port}"
+                    status, body = await asyncio.to_thread(
+                        replay_record, record, base)
+                    assert status == 200
+                    bodies.append(body)
+                equal, detail = compare_responses(*bodies)
+                assert equal, detail
+                # data payloads really are byte-identical once
+                # canonicalized (puid is the only volatile part)
+                assert canonical_body(bodies[0]) == canonical_body(bodies[1])
+            finally:
+                for c in clients:
+                    await c.close()
+
+        asyncio.run(run())
+
+    def test_replay_requires_captured_body(self):
+        from seldon_core_tpu.tools.replay import replay_record
+
+        with pytest.raises(RuntimeError) as ei:
+            replay_record({"puid": "x", "request": None}, "http://x")
+        assert "no captured request body" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# graphlint GL10xx
+# ---------------------------------------------------------------------------
+
+class TestGraphlintHealth:
+    GRAPH = {"name": "m", "type": "MODEL",
+             "implementation": "SIMPLE_MODEL"}
+
+    def _codes(self, ann):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        return {f.code: f for f in lint_graph(self.GRAPH, ann)
+                if f.code.startswith("GL10")}
+
+    def test_report_when_enabled(self):
+        found = self._codes({"seldon.io/health": "true",
+                             "seldon.io/slo-availability": "0.999"})
+        assert set(found) == {"GL1003"}
+        assert found["GL1003"].severity == "INFO"
+        assert "availability >= 0.999" in found["GL1003"].message
+
+    def test_invalid_value_errors(self):
+        found = self._codes({"seldon.io/slo-availability": "2"})
+        assert set(found) == {"GL1001"}
+        assert found["GL1001"].severity == "ERROR"
+
+    def test_knobs_without_enable_warns(self):
+        found = self._codes({"seldon.io/health-flight-records": "64"})
+        assert set(found) == {"GL1002"}
+        assert found["GL1002"].severity == "WARN"
+
+    def test_admission_rejects_invalid(self):
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+        from seldon_core_tpu.operator.compile import health_config
+        from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+        dep = SeldonDeployment.from_dict(_iris_spec())
+        dep.annotations["seldon.io/slo-availability"] = "7"
+        with pytest.raises(DeploymentValidationError) as ei:
+            health_config(dep, dep.predictors[0])
+        assert "slo-availability" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# metric hygiene satellites
+# ---------------------------------------------------------------------------
+
+class TestMetricsCardinalityCap:
+    def test_cap_drops_new_series_and_counts_them(self):
+        reg = MetricsRegistry(max_series=3)
+        for i in range(10):
+            reg.counter_inc("seldon_cache_hits_total", {"cache": f"c{i}"})
+        text = reg.render()
+        assert text.count('seldon_cache_hits_total{cache=') == 3
+        assert ('seldon_metrics_dropped_series_total'
+                '{metric="seldon_cache_hits_total"} 7') in text
+        # existing series keep incrementing under the cap
+        reg.counter_inc("seldon_cache_hits_total", {"cache": "c0"})
+        assert 'seldon_cache_hits_total{cache="c0"} 2' in reg.render()
+
+    def test_render_concurrent_with_writes(self):
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        errs = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                reg.counter_inc("seldon_cache_hits_total", {"cache": "c"})
+                reg.observe("seldon_api_server_ingress_seconds",
+                            0.001 * (i % 7), {"deployment": "d"})
+                i += 1
+
+        def reader():
+            try:
+                for _ in range(50):
+                    reg.render()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        w = threading.Thread(target=writer)
+        w.start()
+        readers = [threading.Thread(target=reader) for _ in range(4)]
+        for t in readers:
+            t.start()
+        for t in readers:
+            t.join()
+        stop.set()
+        w.join()
+        assert not errs
+
+
+class TestDeviceRegistryGauges:
+    def test_gauges_and_reap_counter(self):
+        from seldon_core_tpu.runtime.device_registry import (
+            DeviceBufferRegistry,
+        )
+
+        reg = MetricsRegistry()
+        r = DeviceBufferRegistry(capacity=2, ttl_s=60.0, metrics=reg)
+        r.put(np.zeros(5, dtype=np.float64))
+        ref_b = r.put(np.zeros(5, dtype=np.float64))
+        text = reg.render()
+        assert "seldon_device_registry_entries 2" in text
+        assert "seldon_device_registry_bytes 80" in text
+        r.put(np.zeros(5, dtype=np.float64))  # evicts oldest
+        text = reg.render()
+        assert "seldon_device_registry_entries 2" in text
+        assert ('seldon_device_registry_reaped_total{kind="entry"} 1'
+                in text)
+        assert r.resolve(ref_b) is not None  # consume subtracts bytes
+        assert "seldon_device_registry_bytes 40" in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# status.health publication
+# ---------------------------------------------------------------------------
+
+def _iris_spec():
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "iris-health"},
+        "spec": {
+            "name": "iris-health",
+            "predictors": [{
+                "name": "main",
+                "replicas": 1,
+                "graph": {
+                    "name": "classifier",
+                    "type": "MODEL",
+                    "parameters": [{
+                        "name": "model_class",
+                        "value": "seldon_core_tpu.models.iris:IrisClassifier",
+                        "type": "STRING",
+                    }],
+                },
+            }],
+        },
+    }
+
+
+class TestStatusHealth:
+    def test_local_deployment_publishes_snapshot(self):
+        from seldon_core_tpu.health import snapshot, unpublish
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+        from seldon_core_tpu.operator.local import LocalDeployment
+
+        spec = _iris_spec()
+        spec["metadata"]["annotations"] = {
+            "seldon.io/slo-availability": "0.999"}
+        dep = SeldonDeployment.from_dict(spec)
+        try:
+            ld = LocalDeployment(dep)
+            assert ld.health is not None
+            out = asyncio.run(ld.predict(SeldonMessage.from_ndarray(
+                np.array([[5.0, 3.4, 1.5, 0.2]], np.float32))))
+            assert out.status.status == "SUCCESS"
+            snap = snapshot(dep.name)
+            assert snap is not None
+            pred = snap["predictors"][0]
+            assert pred["verdict"] == "ok"
+            assert pred["flightRecorder"]["size"] == 1
+        finally:
+            unpublish(dep.name)
+
+    def test_disabled_stays_unpublished(self):
+        from seldon_core_tpu.health import snapshot
+        from seldon_core_tpu.operator.spec import SeldonDeployment
+        from seldon_core_tpu.operator.local import LocalDeployment
+
+        dep = SeldonDeployment.from_dict(_iris_spec())
+        ld = LocalDeployment(dep)
+        assert ld.health is None
+        assert snapshot(dep.name) is None
