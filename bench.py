@@ -1232,6 +1232,330 @@ def health_smoke() -> int:
     return 1 if failures else 0
 
 
+def profile_smoke() -> int:
+    """Fast CI gate for the profiling plane (CPU-only):
+    (1) a chaos cpu-burn drill through gateway -> engine shows up in a
+        ``/admin/profile/capture`` window with ``_chaos_cpu_burn``
+        dominating the serving thread's flamegraph,
+    (2) a fused segment reports nonzero ``cost_analysis`` FLOPs and
+        compile wall time at ``/admin/profile/compile``,
+    (3) forced shape churn (one compile per distinct batch shape) flips
+        the recompile-storm signal into the ``/admin/health`` verdict,
+    (4) per-request FLOP attribution across a coalesced dynamic batch
+        sums exactly to the executed bucket's segment total,
+    (5) the always-on host sampler at the default 19 Hz stays within the
+        p50 overhead budget on the predict path.
+    Returns a process exit code."""
+    import numpy as np
+
+    from seldon_core_tpu.graph.engine import GraphEngine
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.operator.local import resolve_component
+    from seldon_core_tpu.profiling import ProfileConfig, ProfilePlane
+    from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+    from seldon_core_tpu.tools.profview import frame_totals, parse_collapsed
+
+    failures: list[str] = []
+    report: dict = {}
+    ann = {"seldon.io/batching": "false"}
+    spec = {
+        "name": "m", "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+        ],
+    }
+    x = np.zeros((1, 784), np.float32)
+    BURN_MS, N_BURN = 20.0, 25
+
+    # -- (1): cpu-burn drill over real sockets, capture window watching --
+    async def flame() -> dict:
+        import aiohttp
+        from aiohttp import web
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+        from seldon_core_tpu.serving.rest import build_app
+        from seldon_core_tpu.utils.metrics import EngineMetrics
+
+        plane = ProfilePlane(
+            ProfileConfig(enabled=True, hz=200.0, stacks=2000,
+                          window_s=30.0, storm=4),
+            service="engine", deployment="dep-prof")
+        engine = GraphEngine(
+            spec,
+            resolver=lambda u: ChaosWrapper(
+                resolve_component(u, ann),
+                ChaosPolicy(cpu_burn_ms=BURN_MS, seed=7)),
+            name="dep-prof", profiler=plane)
+        eng_runner = web.AppRunner(
+            build_app(engine=engine, metrics=EngineMetrics()),
+            access_log=None)
+        await eng_runner.setup()
+        await web.TCPSite(eng_runner, "127.0.0.1", 0).start()
+        eng_base = f"http://127.0.0.1:{eng_runner.addresses[0][1]}"
+
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name="dep-prof", oauth_key="k", oauth_secret="s",
+            engine_url=eng_base))
+        gw = Gateway(store)
+        gw_runner = web.AppRunner(gw.build_app(), access_log=None)
+        await gw_runner.setup()
+        await web.TCPSite(gw_runner, "127.0.0.1", 0).start()
+        base = f"http://127.0.0.1:{gw_runner.addresses[0][1]}"
+
+        out: dict = {}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"{base}/oauth/token",
+                    data={"grant_type": "client_credentials"},
+                    auth=aiohttp.BasicAuth("k", "s"),
+                ) as resp:
+                    token = (await resp.json())["access_token"]
+                # warmup outside the window: jit compile must not count
+                # as burn time
+                async with sess.post(
+                    f"{base}/api/v0.1/predictions",
+                    json=SeldonMessage.from_ndarray(x).to_dict(),
+                    headers={"Authorization": f"Bearer {token}"},
+                ) as resp:
+                    await resp.read()
+                async with sess.get(
+                    f"{eng_base}/admin/profile/capture?seconds=25"
+                ) as resp:
+                    out["window"] = await resp.json()
+                    wid = out["window"].get("id", "")
+                for _ in range(N_BURN):
+                    async with sess.post(
+                        f"{base}/api/v0.1/predictions",
+                        json=SeldonMessage.from_ndarray(x).to_dict(),
+                        headers={"Authorization": f"Bearer {token}"},
+                    ) as resp:
+                        await resp.read()
+                async with sess.get(
+                    f"{eng_base}/admin/profile/capture?id={wid}&stop=1"
+                ) as resp:
+                    out["capture"] = await resp.json()
+                async with sess.get(f"{eng_base}/admin/profile") as resp:
+                    out["profile"] = await resp.json()
+        finally:
+            await gw.close()
+            await plane.aclose()
+            await gw_runner.cleanup()
+            await eng_runner.cleanup()
+        return out
+
+    r = asyncio.run(flame())
+    cap = r.get("capture", {})
+    folded = parse_collapsed(cap.get("folded", ""))
+    report["capture"] = {"samples": cap.get("samples"),
+                         "stacks": cap.get("stacks")}
+    if not cap.get("done"):
+        failures.append(f"capture window did not finalize on stop: {cap}")
+    # the serving thread's view: the chaos burn holds the event loop, so
+    # its frame must dominate the main thread's flamegraph
+    serving = {s: c for s, c in folded.items()
+               if s.startswith("thread:MainThread")}
+    serving_total = sum(serving.values())
+    burn = sum(c for s, c in serving.items() if "_chaos_cpu_burn" in s)
+    share = burn / serving_total if serving_total else 0.0
+    report["burn_share"] = round(share, 4)
+    if serving_total < 20:
+        failures.append(f"capture window caught only {serving_total} "
+                        "serving-thread samples — sampler not running?")
+    if share < 0.5:
+        hot = sorted(frame_totals(serving).items(),
+                     key=lambda kv: -kv[1])[:5]
+        failures.append(
+            f"_chaos_cpu_burn holds {100 * share:.1f}% of serving-thread "
+            f"samples, expected it to dominate (>=50%); hottest: {hot}")
+    prof = r.get("profile", {})
+    if prof.get("service") != "engine" or \
+            not prof.get("stats", {}).get("samples"):
+        failures.append(f"/admin/profile posture empty: {prof}")
+
+    # -- (2)(3): fused compile telemetry + shape-churn recompile storm --
+    async def compile_and_storm() -> dict:
+        import aiohttp
+        from aiohttp import web
+
+        from seldon_core_tpu.health import HealthConfig, HealthPlane
+        from seldon_core_tpu.serving.rest import build_app
+        from seldon_core_tpu.utils.metrics import EngineMetrics
+
+        plane = ProfilePlane(
+            ProfileConfig(enabled=True, hz=19.0, stacks=2000,
+                          window_s=30.0, storm=3),
+            service="engine", deployment="dep-storm")
+        hplane = HealthPlane(
+            HealthConfig(enabled=True, sample_ms=50.0, timeline=128,
+                         slo_availability=0.999),
+            service="engine", deployment="dep-storm")
+        hplane.profiler = plane
+        engine = GraphEngine(
+            spec, resolver=lambda u: resolve_component(u, ann),
+            name="dep-storm", plan_mode="fused", health=hplane,
+            profiler=plane)
+        runner = web.AppRunner(
+            build_app(engine=engine, metrics=EngineMetrics()),
+            access_log=None)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", 0).start()
+        base = f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+        out: dict = {}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                # one compile per distinct batch shape: the storm drill
+                for rows in (1, 2, 3):
+                    xr = np.zeros((rows, 784), np.float32)
+                    async with sess.post(
+                        f"{base}/api/v0.1/predictions",
+                        json=SeldonMessage.from_ndarray(xr).to_dict(),
+                    ) as resp:
+                        await resp.read()
+                async with sess.get(
+                    f"{base}/admin/profile/compile"
+                ) as resp:
+                    out["compile"] = await resp.json()
+                async with sess.get(f"{base}/admin/health") as resp:
+                    out["health"] = await resp.json()
+                async with sess.get(
+                    f"{base}/admin/profile/capacity"
+                ) as resp:
+                    out["capacity"] = await resp.json()
+        finally:
+            await plane.aclose()
+            await hplane.aclose()
+            await runner.cleanup()
+        return out
+
+    r = asyncio.run(compile_and_storm())
+    comp = r["compile"]
+    segments = comp.get("segments", {})
+    report["compiles"] = {label: seg["compiles"]
+                          for label, seg in segments.items()}
+    flops_buckets = [
+        cost for seg in segments.values()
+        for cost in seg.get("buckets", {}).values() if cost.get("flops")
+    ]
+    if not flops_buckets:
+        failures.append(f"no fused segment reported cost_analysis FLOPs: "
+                        f"{comp}")
+    if not any(seg.get("wallMsTotal", 0) > 0 for seg in segments.values()):
+        failures.append("no fused segment reported compile wall time")
+    if not comp.get("storm"):
+        failures.append(f"3 shape-bucket compiles under storm threshold 3 "
+                        f"did not raise the recompile-storm signal: {comp}")
+    health = r["health"]
+    report["storm_verdict"] = {"verdict": health.get("verdict"),
+                               "signals": health.get("signals")}
+    if "recompile-storm" not in health.get("signals", []):
+        failures.append(f"recompile storm missing from the /admin/health "
+                        f"verdict: {health}")
+    capacity = r["capacity"]
+    report["capacity"] = {k: capacity.get(k) for k in
+                          ("requests", "avgRequestGflops", "headroom")}
+    if not capacity.get("requests") or \
+            not capacity.get("avgRequestGflops"):
+        failures.append(f"/admin/profile/capacity has no attributed "
+                        f"requests after fused traffic: {capacity}")
+
+    # -- (4): coalesced-batch attribution sums to the bucket total ------
+    async def attribution_sum() -> dict:
+        from seldon_core_tpu.runtime.batcher import BatcherConfig
+
+        plane = ProfilePlane(
+            ProfileConfig(enabled=True, hz=19.0, stacks=2000,
+                          window_s=30.0, storm=4),
+            service="engine", deployment="dep-attr")
+        engine = GraphEngine(
+            spec, resolver=lambda u: resolve_component(u, ann),
+            name="dep-attr", plan_mode="fused",
+            plan_batcher=BatcherConfig(max_batch_size=2, max_delay_ms=20.0,
+                                       buckets=[2], name="attr"),
+            profiler=plane)
+        msg = SeldonMessage.from_ndarray(x)
+        try:
+            # two 1-row requests coalesce into (or pad to) the single
+            # 2-row bucket; each is attributed half the bucket's cost
+            await asyncio.gather(engine.predict(msg), engine.predict(msg))
+            with plane.attribution._lock:
+                per_request = [f for _, f in plane.attribution._requests]
+            seg = engine.plan.segments[0]
+            bucket = seg.cost_by_bucket.get(((2, 784), "float32"), {})
+        finally:
+            await plane.aclose()
+        return {"per_request": per_request,
+                "bucket_flops": bucket.get("flops", 0.0)}
+
+    r = asyncio.run(attribution_sum())
+    total = sum(r["per_request"])
+    report["attribution"] = {
+        "requests": len(r["per_request"]),
+        "sum_gflops": round(total / 1e9, 6),
+        "bucket_gflops": round(r["bucket_flops"] / 1e9, 6),
+    }
+    if len(r["per_request"]) != 2:
+        failures.append(f"expected 2 attributed requests, got "
+                        f"{len(r['per_request'])}")
+    elif not r["bucket_flops"]:
+        failures.append("executed bucket has no cost_analysis FLOPs to "
+                        "attribute")
+    elif abs(total - r["bucket_flops"]) > 1e-6 * r["bucket_flops"]:
+        failures.append(
+            f"coalesced request shares sum to {total:.1f} FLOPs, executed "
+            f"bucket total is {r['bucket_flops']:.1f} — attribution must "
+            "conserve cost")
+
+    # -- (5): sampler overhead on the predict path ----------------------
+    async def p50_ms(with_profile: bool, n: int = 200) -> float:
+        plane = None
+        if with_profile:
+            plane = ProfilePlane(ProfileConfig(enabled=True), service="engine",
+                                 deployment="ovh")
+        eng = GraphEngine(spec,
+                          resolver=lambda u: resolve_component(u, ann),
+                          name="ovh", profiler=plane)
+        msg = SeldonMessage.from_ndarray(x)
+        for _ in range(20):  # warmup: jit compile + sampler start
+            await eng.predict(msg)
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            await eng.predict(msg)
+            lat.append((time.perf_counter() - t0) * 1e3)
+            await asyncio.sleep(0)
+        if plane is not None:
+            report["sampler_ticks"] = plane.sampler.stats()["samples"]
+            await plane.aclose()
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    base_p50 = asyncio.run(p50_ms(False))
+    prof_p50 = asyncio.run(p50_ms(True))
+    ratio = prof_p50 / base_p50 if base_p50 else 1.0
+    report["overhead"] = {"off_p50_ms": round(base_p50, 4),
+                          "on_p50_ms": round(prof_p50, 4),
+                          "ratio": round(ratio, 4)}
+    # the gate needs BOTH a 5% ratio and a 0.25ms absolute regression so
+    # a noisy shared CI runner cannot flake a sub-ms p50
+    if ratio > 1.05 and (prof_p50 - base_p50) > 0.25:
+        failures.append(
+            f"host sampler at the default rate costs "
+            f"{100 * (ratio - 1):.1f}%% p50 on the predict path "
+            f"({base_p50:.3f}ms -> {prof_p50:.3f}ms)")
+
+    print(json.dumps({"profile_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 RESNET50_GFLOPS = 8.2  # fwd FLOPs per 224x224 image: 4.1 GMACs x 2 FLOPs/MAC
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak, TPU v5e
 
@@ -2535,6 +2859,16 @@ def main() -> None:
                          "byte-identically against walk and fused "
                          "engines, and the introspection sampler stays "
                          "within the p50 overhead budget; then exit")
+    ap.add_argument("--profile-smoke", action="store_true",
+                    help="fast CI gate: a chaos cpu-burn drill dominates "
+                         "the /admin/profile/capture flamegraph, fused "
+                         "segments report cost_analysis FLOPs + compile "
+                         "wall time, forced shape churn flips the "
+                         "recompile-storm signal into /admin/health, "
+                         "coalesced-batch FLOP attribution sums to the "
+                         "executed bucket total, and the host sampler "
+                         "stays within the p50 overhead budget; then "
+                         "exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -2548,6 +2882,8 @@ def main() -> None:
         sys.exit(trace_smoke())
     if args.health_smoke:
         sys.exit(health_smoke())
+    if args.profile_smoke:
+        sys.exit(profile_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice

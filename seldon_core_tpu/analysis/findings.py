@@ -24,6 +24,9 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL10xx`` — health-plane admission (``seldon.io/health*`` /
   ``seldon.io/slo-availability`` annotation validation, knobs set while
   the plane is off, effective sampler/recorder/SLO report)
+- ``GL11xx`` — profiling-plane admission (``seldon.io/profile*``
+  annotation validation, knobs set while the plane is off, effective
+  sampler/compile-watch report)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -77,6 +80,9 @@ TRACE_CONFIG_REPORT = "GL903"       # trace report: effective config
 HEALTH_ANNOTATION_INVALID = "GL1001"  # seldon.io/health* / slo-availability invalid
 HEALTH_KNOBS_WITHOUT_HEALTH = "GL1002"  # health-* knobs set, plane off
 HEALTH_CONFIG_REPORT = "GL1003"     # health report: effective config
+PROFILE_ANNOTATION_INVALID = "GL1101"  # seldon.io/profile* value invalid
+PROFILE_KNOBS_WITHOUT_PROFILE = "GL1102"  # profile-* knobs set, plane off
+PROFILE_CONFIG_REPORT = "GL1103"    # profile report: effective config
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -122,6 +128,9 @@ CODE_SEVERITY = {
     HEALTH_ANNOTATION_INVALID: ERROR,
     HEALTH_KNOBS_WITHOUT_HEALTH: WARN,
     HEALTH_CONFIG_REPORT: INFO,
+    PROFILE_ANNOTATION_INVALID: ERROR,
+    PROFILE_KNOBS_WITHOUT_PROFILE: WARN,
+    PROFILE_CONFIG_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

@@ -50,6 +50,9 @@ from seldon_core_tpu.analysis.findings import (
     PLAN_NODE_BOUNDARY,
     PLAN_NOTHING_FUSED,
     PLAN_SEGMENT_FUSED,
+    PROFILE_ANNOTATION_INVALID,
+    PROFILE_CONFIG_REPORT,
+    PROFILE_KNOBS_WITHOUT_PROFILE,
     QOS_ANNOTATION_INVALID,
     QOS_FALLBACK_FRAGILE,
     QOS_FALLBACK_IS_ROOT,
@@ -171,6 +174,7 @@ def lint_graph(
         findings.extend(_qos_pass(unit, ann, path_prefix))
         findings.extend(_trace_pass(unit, ann, path_prefix))
         findings.extend(_health_pass(unit, ann, path_prefix))
+        findings.extend(_profile_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -953,6 +957,52 @@ def _health_pass(root: PredictiveUnit, ann: dict,
     detail += ("; burn monitor: " + ", ".join(slo_bits) if slo_bits
                else "; no SLO declared — burn monitor idle")
     return [make_finding(HEALTH_CONFIG_REPORT, path0, detail)]
+
+
+def _profile_pass(root: PredictiveUnit, ann: dict,
+                  prefix: str) -> list[Finding]:
+    """Profiling-plane admission (GL11xx, active when any
+    ``seldon.io/profile*`` annotation is set): validates the family
+    through the same parser the operator and runtimes use (GL1101 — a
+    sampling rate outside (0, 1000] or a storm threshold below 2 rejects
+    here, before a deployment ships with a silently-dead profiler),
+    warns when profile knobs are set while the plane itself is off
+    (GL1102), and reports the effective sampler / compile-watch
+    configuration (GL1103)."""
+    from seldon_core_tpu.profiling.config import (
+        PROFILE_ANNOTATION,
+        PROFILE_HZ_ANNOTATION,
+        PROFILE_STACKS_ANNOTATION,
+        PROFILE_STORM_ANNOTATION,
+        PROFILE_WINDOW_S_ANNOTATION,
+        profile_config_from_annotations,
+    )
+
+    family = {PROFILE_ANNOTATION, PROFILE_HZ_ANNOTATION,
+              PROFILE_STACKS_ANNOTATION, PROFILE_WINDOW_S_ANNOTATION,
+              PROFILE_STORM_ANNOTATION}
+    profile_keys = [k for k in ann if k in family]
+    if not profile_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = profile_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(PROFILE_ANNOTATION_INVALID, path0, str(e))]
+    if not cfg.enabled:
+        knobs = sorted(k for k in profile_keys if k != PROFILE_ANNOTATION)
+        if knobs:
+            return [make_finding(
+                PROFILE_KNOBS_WITHOUT_PROFILE, path0,
+                f"{', '.join(knobs)} set but {PROFILE_ANNOTATION} is not "
+                "enabled — the knobs have no effect",
+            )]
+        return []
+    detail = (f"profiling plane on: host sampler at {cfg.hz:g}Hz "
+              f"(stack table {cfg.stacks}, capture windows up to "
+              f"{cfg.window_s:g}s); recompile storm at "
+              f">= {cfg.storm} compiles/segment/min")
+    return [make_finding(PROFILE_CONFIG_REPORT, path0, detail)]
 
 
 def _join(prefix: str, name: str) -> str:

@@ -77,6 +77,7 @@ class Gateway:
         retry_backoff_s: float = 0.05,
         tracer: Optional[Tracer] = None,
         health=None,
+        profiler=None,
     ):
         self.store = store
         # SELDON_TOKEN_SIGNING_KEY (chart Secret) selects stateless signed
@@ -152,6 +153,29 @@ class Gateway:
             if hcfg is not None and hcfg.enabled:
                 self.health = HealthPlane(hcfg, metrics=self.registry,
                                           service="gateway")
+        # Profiling plane (docs/observability.md): always-on host sampling
+        # profiler for the gateway process — the forward path is pure
+        # Python/asyncio, exactly what wall-clock flamegraphs explain.
+        # Env knobs: SELDON_PROFILE / SELDON_PROFILE_HZ.  Served from
+        # /admin/profile*.
+        if profiler is not None:
+            self.profiler = profiler
+        else:
+            self.profiler = None
+            try:
+                from seldon_core_tpu.profiling import (
+                    ProfilePlane,
+                    profile_config_from_annotations,
+                )
+
+                pcfg = profile_config_from_annotations({}, "gateway")
+            except ValueError as e:
+                logger.warning(
+                    "profiling plane disabled (bad env config): %s", e)
+                pcfg = None
+            if pcfg is not None and pcfg.enabled:
+                self.profiler = ProfilePlane(pcfg, metrics=self.registry,
+                                             service="gateway")
         if self.health is not None:
             from seldon_core_tpu.health import (
                 device_memory_probe,
@@ -162,6 +186,12 @@ class Gateway:
             self.health.sampler.add_probe("device_registry",
                                           device_registry_probe())
             self.health.sampler.add_probe("gateway", self._gateway_probe)
+            if self.profiler is not None:
+                from seldon_core_tpu.health import profile_probe
+
+                self.health.profiler = self.profiler
+                self.health.sampler.add_probe(
+                    "profile", profile_probe(self.profiler))
 
     def _gateway_probe(self) -> dict:
         """Sampler probe over the gateway's per-deployment runtime state
@@ -198,6 +228,8 @@ class Gateway:
     async def close(self) -> None:
         if self.health is not None:
             await self.health.aclose()
+        if self.profiler is not None:
+            await self.profiler.aclose()
         if self._session is not None and not self._session.closed:
             await self._session.close()
         for ch in self._grpc_channels.values():
@@ -229,6 +261,13 @@ class Gateway:
         app.router.add_get("/admin/flightrecorder",
                            self._handle_flightrecorder)
         app.router.add_get("/admin/health", self._handle_health)
+        app.router.add_get("/admin/profile", self._handle_profile)
+        app.router.add_get("/admin/profile/capture",
+                           self._handle_profile_capture)
+        app.router.add_get("/admin/profile/compile",
+                           self._handle_profile_compile)
+        app.router.add_get("/admin/profile/capacity",
+                           self._handle_profile_capacity)
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -776,6 +815,44 @@ class Gateway:
         from seldon_core_tpu.health.http import health_body
 
         return await self._handle_health_endpoint(request, health_body)
+
+    async def _handle_profile_endpoint(self, request: web.Request,
+                                       body_fn) -> web.Response:
+        """Shared wrapper for /admin/profile*: 404 + hint when the plane
+        is off, 400 on malformed numerics (the /admin/traces contract)."""
+        try:
+            status, payload = body_fn(self.profiler, request.query)
+        except ValueError:
+            return web.json_response(
+                {"error": "numeric query parameter expected"}, status=400
+            )
+        return web.json_response(payload, status=status)
+
+    async def _handle_profile(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.profiling.http import profile_body
+
+        return await self._handle_profile_endpoint(request, profile_body)
+
+    async def _handle_profile_capture(
+        self, request: web.Request
+    ) -> web.Response:
+        from seldon_core_tpu.profiling.http import capture_body
+
+        return await self._handle_profile_endpoint(request, capture_body)
+
+    async def _handle_profile_compile(
+        self, request: web.Request
+    ) -> web.Response:
+        from seldon_core_tpu.profiling.http import compile_body
+
+        return await self._handle_profile_endpoint(request, compile_body)
+
+    async def _handle_profile_capacity(
+        self, request: web.Request
+    ) -> web.Response:
+        from seldon_core_tpu.profiling.http import capacity_body
+
+        return await self._handle_profile_endpoint(request, capacity_body)
 
     # ------------------------------------------------------------------
     # gRPC front (Seldon service, forwards to engine gRPC)

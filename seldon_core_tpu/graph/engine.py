@@ -34,6 +34,7 @@ from seldon_core_tpu.health.flightrecorder import (
     node_times_scope,
     note_node_time,
 )
+from seldon_core_tpu.profiling.attribution import attribution_scope
 from seldon_core_tpu.graph.spec import (
     PredictiveUnit,
     parse_graph,
@@ -85,6 +86,7 @@ class GraphEngine:
         cache_version: str = "",
         qos: Optional[Any] = None,
         health: Optional[Any] = None,
+        profiler: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -172,6 +174,15 @@ class GraphEngine:
         # and feeds the SLO burn monitor; the introspection sampler is
         # lazily started on the first request (the loop exists by then)
         self.health = health
+        # profiling plane (profiling/, docs/observability.md): host stack
+        # sampling, compile telemetry, per-request FLOP attribution.
+        # Fused segments report their shape-bucket compiles into the
+        # plane's CompileWatch — wired HERE, before any warmup, so the
+        # first compile of every bucket is already on the ledger.
+        self.profiler = profiler
+        if profiler is not None and self.plan is not None:
+            for seg in self.plan.segments:
+                seg.compile_watch = profiler.compile
         self._fallback_node: Optional[_Node] = None
         if qos is not None and qos.config.fallback_node:
             node = self._nodes.get(qos.config.fallback_node)
@@ -247,6 +258,13 @@ class GraphEngine:
         if health is not None:
             health.ensure_started()
             htoken = node_times_scope()
+        # profiling plane: per-request cost attribution scope — every
+        # fused-segment dispatch notes its FLOP/HBM share into it, and
+        # _flight_done stamps the totals into the flight record
+        ptoken = None
+        if self.profiler is not None:
+            self.profiler.ensure_started()
+            ptoken = attribution_scope()
         # Trace context: wire channel (meta tags / inbound traceparent bound
         # by the REST layer) wins; else mint one with the head-sampling
         # decision.  The trace ID derives from the puid (already 128-bit
@@ -279,7 +297,7 @@ class GraphEngine:
                         ),
                         meta=meta,
                     ),
-                    meta, tctx, ht0, htoken,
+                    meta, tctx, ht0, htoken, ptoken=ptoken,
                 )
         admission = self.qos.admission if self.qos is not None else None
         if admission is not None:
@@ -308,7 +326,7 @@ class GraphEngine:
                         ),
                         meta=meta,
                     ),
-                    meta, tctx, ht0, htoken, shed=True,
+                    meta, tctx, ht0, htoken, shed=True, ptoken=ptoken,
                 )
         t0 = time.perf_counter()
         ok = False
@@ -319,7 +337,8 @@ class GraphEngine:
         finally:
             if admission is not None:
                 admission.release(time.perf_counter() - t0, ok)
-        return self._flight_done(out, meta, tctx, ht0, htoken)
+        return self._flight_done(out, meta, tctx, ht0, htoken,
+                                 ptoken=ptoken)
 
     async def _predict_qos(
         self, request: SeldonMessage, meta: Meta, qctx: Optional[Any]
@@ -594,10 +613,21 @@ class GraphEngine:
                 self.metrics.observe_node(self.name, node_name, elapsed)
 
     def _flight_done(self, out: SeldonMessage, meta: Meta, tctx,
-                     ht0: float, htoken, shed: bool = False) -> SeldonMessage:
+                     ht0: float, htoken, shed: bool = False,
+                     ptoken=None) -> SeldonMessage:
         """Every predict() exit path funnels here: one flight-recorder
-        record + one burn-monitor observation, shed and failure paths
-        included.  Never raises — health must not take serving down."""
+        record + one burn-monitor observation (and, with the profiling
+        plane on, the request's attributed device cost), shed and failure
+        paths included.  Never raises — observability must not take
+        serving down."""
+        cost = None
+        if ptoken is not None:
+            try:
+                cost = ptoken.close()
+                if self.profiler is not None and cost["flops"] > 0:
+                    self.profiler.attribution.note_request(cost["flops"])
+            except Exception:  # pragma: no cover - defensive
+                cost = None
         health = self.health
         if health is None:
             return out
@@ -617,6 +647,14 @@ class GraphEngine:
             }
             if meta.routing:
                 flags["routing"] = dict(meta.routing)
+            if cost is not None and cost["flops"] > 0:
+                # attributed device cost (profiling/attribution.py):
+                # segment cost_analysis x dynamic-batch share
+                flags["flops"] = round(cost["flops"], 3)
+                flags["hbmBytes"] = round(cost["hbmBytes"], 3)
+                flags["segmentFlops"] = {
+                    k: round(v, 3) for k, v in cost["segments"].items()
+                }
             health.recorder.record(
                 puid=meta.puid,
                 trace_id=str(meta.tags.get(TRACE_ID_TAG, "")),
@@ -828,6 +866,19 @@ class GraphEngine:
                         s.name for s in getattr(seg, "members", ())
                     ),
                 )
+            if self.profiler is not None:
+                # per-request cost attribution: this request's rows x the
+                # executed bucket's per-row cost_analysis cost — shares
+                # of a coalesced batch sum to the batch's segment total
+                try:
+                    shape = getattr(x, "shape", None)
+                    rows = int(shape[0]) if shape else 1
+                    est = seg.cost_for_rows(rows)
+                    if est is not None:
+                        self.profiler.attribution.note_dispatch(
+                            seg.label, est["flops"], est["hbm_bytes"])
+                except Exception:  # pragma: no cover - defensive
+                    pass
             names = seg.out_names(x, in_names)
         return y, list(names)
 

@@ -44,6 +44,8 @@ segment — not just one model — per device dispatch.
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
@@ -60,6 +62,42 @@ PURE_FN_ATTR = {
     "OUTPUT_TRANSFORMER": "transform_output_fn",
     "COMBINER": "aggregate_fn",
 }
+
+#: bucket-never-seen sentinel (None means "AOT unavailable, use the jit
+#: cache" — a real state that must not retrigger compilation)
+_UNCOMPILED = object()
+
+
+def _cost_summary(compiled) -> dict:
+    """FLOPs / bytes-accessed / peak-HBM from an AOT-compiled executable.
+    ``cost_analysis`` returns a dict on current jax and a one-element
+    list on older releases; ``memory_analysis`` may be absent per
+    backend — every field is best-effort."""
+    out: dict = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        if flops > 0:
+            out["flops"] = flops
+        ba = float(cost.get("bytes accessed", 0.0) or 0.0)
+        if ba > 0:
+            out["bytes_accessed"] = ba
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        peak = sum(
+            float(getattr(mem, attr, 0) or 0)
+            for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                         "temp_size_in_bytes")
+        )
+        if peak > 0:
+            out["peak_hbm_bytes"] = peak
+    except Exception:
+        pass
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +296,14 @@ class FusedSegment:
         self._fn = jax.jit(self._traced)
         self.batcher = None  # set by compile_plan when batching is on
         self.n_calls = 0     # device dispatches issued (bench/CI smoke)
+        # compile observability (profiling/compilewatch.py): per shape
+        # bucket the AOT executable, its compile wall time, and its
+        # cost_analysis summary; ``compile_watch`` is an optional
+        # CompileWatch the operator wires in before warmup
+        self.compile_watch = None
+        self._compiled: dict = {}
+        self._compile_lock = threading.Lock()
+        self.cost_by_bucket: dict = {}
         self._names_cache: dict = {}
         # prediction-cache eligibility: every member is a pure tensor fn by
         # construction, so the segment caches unless a member opted out or
@@ -325,7 +371,97 @@ class FusedSegment:
     # -- request-time ----------------------------------------------------
     def __call__(self, x):
         self.n_calls += 1
+        key = self.bucket_key(x)
+        compiled = self._compiled.get(key, _UNCOMPILED)
+        if compiled is _UNCOMPILED:
+            compiled = self._compile_bucket(key, x)
+        if compiled is not None:
+            try:
+                return compiled(self._params, x)
+            except Exception:
+                # an AOT executable rejecting at call time (sharding /
+                # layout drift) falls back to the jit cache for good —
+                # telemetry must never cost a request
+                self._compiled[key] = None
         return self._fn(self._params, x)
+
+    @staticmethod
+    def bucket_key(x) -> tuple:
+        """Shape bucket of one input: (shape, dtype) — the same identity
+        jax's jit cache keys dispatch on, so one bucket = one compile."""
+        return (tuple(getattr(x, "shape", ())),
+                str(getattr(x, "dtype", "")))
+
+    def _compile_bucket(self, key: tuple, x):
+        """First dispatch of a shape bucket: AOT-compile it
+        (``lower().compile()``), record wall time + cost_analysis into
+        the ledger and the CompileWatch, and keep the executable — the
+        serving path then calls it directly so the compile is paid ONCE
+        (the jit cache stays the fallback, not a second compile)."""
+        with self._compile_lock:
+            hit = self._compiled.get(key, _UNCOMPILED)
+            if hit is not _UNCOMPILED:
+                return hit
+            t0 = time.perf_counter()
+            compiled = None
+            cost: dict = {}
+            try:
+                compiled = self._fn.lower(self._params, x).compile()
+                cost = _cost_summary(compiled)
+            except Exception:
+                logger.debug("segment %s: AOT compile telemetry "
+                             "unavailable for bucket %s", self.label, key,
+                             exc_info=True)
+            wall_ms = (time.perf_counter() - t0) * 1000.0
+            cost["compile_ms"] = round(wall_ms, 3)
+            self._compiled[key] = compiled
+            self.cost_by_bucket[key] = cost
+        watch = self.compile_watch
+        if watch is not None:
+            try:
+                shape, dtype = key
+                watch.note_compile(
+                    self.label,
+                    bucket="x".join(str(d) for d in shape) + f":{dtype}",
+                    wall_ms=wall_ms,
+                    flops=cost.get("flops", 0.0),
+                    bytes_accessed=cost.get("bytes_accessed", 0.0),
+                    peak_hbm_bytes=cost.get("peak_hbm_bytes", 0.0),
+                )
+            except Exception:
+                pass
+        return compiled
+
+    def cost_for_rows(self, rows: int) -> Optional[dict]:
+        """Estimated device cost of ``rows`` request rows through this
+        segment: the best-matching compiled bucket's cost scaled by the
+        row share (exact bucket > smallest covering bucket > largest).
+        A coalesced batch's request shares therefore sum to the executed
+        bucket's total — padding waste is charged to nobody.  None until
+        a bucket with cost_analysis data has compiled."""
+        rows = max(1, int(rows))
+        best = None  # (exactness rank, bucket_rows, cost)
+        for (shape, _dtype), cost in self.cost_by_bucket.items():
+            if not cost.get("flops") or not shape:
+                continue
+            bucket_rows = int(shape[0]) if shape[0] else 1
+            if bucket_rows == rows:
+                rank = 0
+            elif bucket_rows > rows:
+                rank = 1
+            else:
+                rank = 2
+            cand = (rank, bucket_rows if rank == 1 else -bucket_rows)
+            if best is None or cand < best[0]:
+                best = (cand, bucket_rows, cost)
+        if best is None:
+            return None
+        _, bucket_rows, cost = best
+        share = rows / float(bucket_rows)
+        return {
+            "flops": cost["flops"] * share,
+            "hbm_bytes": cost.get("bytes_accessed", 0.0) * share,
+        }
 
     def out_names(self, x, in_names: Sequence[str]) -> list:
         """Final output names, byte-identical to the interpreted walk.

@@ -38,6 +38,7 @@ __all__ = [
     "batcher_probe",
     "cache_probe",
     "qos_probe",
+    "profile_probe",
     "device_registry_probe",
 ]
 
@@ -51,6 +52,10 @@ GAUGES = {
     "jit_segments": "seldon_runtime_jit_segments",
     "jit_segments_compiled": "seldon_runtime_jit_segments_compiled",
     "jit_dispatches": "seldon_runtime_jit_dispatches",
+    "device_occupancy_est": "seldon_runtime_device_occupancy_est",
+    "compiles_total": "seldon_runtime_compiles_total",
+    "recompile_storm": "seldon_runtime_recompile_storm",
+    "compile_cache_enabled": "seldon_compile_cache_enabled",
     "queue_rows": "seldon_runtime_queue_rows",
     "queue_lanes": "seldon_runtime_queue_lanes",
     "queue_occupancy": "seldon_runtime_queue_occupancy",
@@ -160,6 +165,29 @@ def qos_probe(qos) -> Callable[[], dict]:
             out["admission_inflight"] = float(
                 getattr(admission, "inflight", 0))
         return out
+
+    return probe
+
+
+def profile_probe(profiler) -> Callable[[], dict]:
+    """Profiling-plane posture (profiling/plane.py ProfilePlane):
+    estimated device-FLOP occupancy from per-request attribution, the
+    compile ledger, the live recompile-storm signal, and whether the
+    persistent XLA compile cache is on — the source of tools/traceview.py's
+    ``device`` lane."""
+
+    def probe() -> dict:
+        from seldon_core_tpu.utils import compile_cache_enabled
+
+        compile_stats = profiler.compile.stats()
+        return {
+            "device_occupancy_est":
+                profiler.attribution.occupancy_estimate(),
+            "compiles_total": float(compile_stats.get("compiles", 0)),
+            "recompile_storm": 1.0 if profiler.storm_segments() else 0.0,
+            "compile_cache_enabled":
+                1.0 if compile_cache_enabled() else 0.0,
+        }
 
     return probe
 

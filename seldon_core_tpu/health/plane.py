@@ -44,6 +44,11 @@ class HealthPlane:
         #: optional EngineQos ref — shed level / open breakers become
         #: contributing warn signals in the verdict
         self.qos = None
+        #: optional ProfilePlane ref — a recompile storm (profiling/
+        #: compilewatch.py) becomes a contributing warn signal too: on a
+        #: TPU each recompile is seconds of dead device time, so shape
+        #: churn degrades the verdict before latency SLOs notice
+        self.profiler = None
 
     # -- lifecycle ------------------------------------------------------
     def ensure_started(self) -> None:
@@ -55,8 +60,9 @@ class HealthPlane:
 
     # -- verdict --------------------------------------------------------
     def verdict(self) -> dict:
-        """Burn-rate verdict fused with live QoS posture; also exports
-        the ``seldon_health_*`` gauges."""
+        """Burn-rate verdict fused with live QoS posture and the
+        profiling plane's recompile-storm signal; also exports the
+        ``seldon_health_*`` gauges."""
         out = self.monitor.verdict()
         level = out["level"]
         signals = list(out["signals"])
@@ -74,6 +80,15 @@ class HealthPlane:
                 level = max(level, 1)
                 signals.append("breaker-open")
                 out["openBreakers"] = open_breakers
+        if self.profiler is not None:
+            try:
+                storm = list(self.profiler.storm_segments())
+            except Exception:
+                storm = []
+            if storm:
+                level = max(level, 1)
+                signals.append("recompile-storm")
+                out["recompileStorm"] = storm
         out["level"] = level
         out["verdict"] = ("ok", "warn", "critical")[level]
         out["signals"] = signals

@@ -353,6 +353,23 @@ def health_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def profile_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/profile*`` annotations → a validated
+    :class:`~seldon_core_tpu.profiling.ProfileConfig`.  Invalid values —
+    a sampling rate outside (0, 1000], a non-positive stack-table cap, a
+    capture window beyond ten minutes, a storm threshold below 2 —
+    reject at admission; graphlint's GL11xx pass reports the same
+    defects, this is the hard stop for callers that skip linting."""
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+    from seldon_core_tpu.profiling import profile_config_from_annotations
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return profile_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

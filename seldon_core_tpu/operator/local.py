@@ -154,6 +154,7 @@ class LocalPredictor:
             graph_plan_mode,
             health_config,
             prediction_cache_config,
+            profile_config,
             qos_config,
             trace_config,
         )
@@ -203,6 +204,31 @@ class LocalPredictor:
                 service="engine", deployment=dep.name,
             )
             self.health.qos = self.qos
+        # Profiling plane (docs/observability.md): always-on host sampling
+        # profiler + XLA compile/cost telemetry + per-request FLOP
+        # attribution; seldon.io/profile turns it on (SELDON_PROFILE for
+        # ad-hoc runs); a recompile storm feeds the health verdict
+        profile_cfg = profile_config(dep, pred)
+        self.profiler = None
+        if profile_cfg is not None and profile_cfg.enabled:
+            from seldon_core_tpu.profiling import ProfilePlane
+
+            self.profiler = ProfilePlane(
+                profile_cfg, metrics=self.metrics.registry,
+                service="engine", deployment=dep.name,
+            )
+            if self.health is not None:
+                self.health.profiler = self.profiler
+        # persistent XLA compile cache: seldon.io/compile-cache is either a
+        # boolean (default dir) or a cache-dir path; idempotent across
+        # predictors (utils.enable_compile_cache)
+        cc = str(ann.get("seldon.io/compile-cache", "")).strip()
+        if cc and cc.lower() not in ("0", "false", "no", "off"):
+            from seldon_core_tpu.utils import enable_compile_cache
+
+            enable_compile_cache(
+                None if cc.lower() in ("1", "true", "yes", "on") else cc
+            )
         self.engine = GraphEngine(
             pred.graph,
             resolver=lambda u: resolve_component(
@@ -220,6 +246,7 @@ class LocalPredictor:
             cache_version=str(ann.get("seldon.io/spec-hash", "")),
             qos=self.qos,
             health=self.health,
+            profiler=self.profiler,
         )
         if (self.engine.plan is not None
                 and ann.get("seldon.io/graph-plan-warmup", "").lower()
@@ -238,6 +265,7 @@ class LocalPredictor:
             device_memory_probe,
             device_registry_probe,
             engine_probe,
+            profile_probe,
             qos_probe,
         )
         from seldon_core_tpu.runtime.device_registry import (
@@ -253,6 +281,8 @@ class LocalPredictor:
             sampler.add_probe("cache", cache_probe(self.cache))
         if self.qos is not None:
             sampler.add_probe("qos", qos_probe(self.qos))
+        if self.profiler is not None:
+            sampler.add_probe("profile", profile_probe(self.profiler))
         plan = self.engine.plan
         if plan is not None:
             for seg in plan.segments:
@@ -378,6 +408,16 @@ class LocalDeployment:
         for p in self.predictors:
             if p.health is not None:
                 return p.health
+        return None
+
+    @property
+    def profiler(self):
+        """First profiling-enabled predictor's plane (the
+        ``/admin/profile*`` endpoints read ``engine.profiler`` — same
+        delegation rationale as ``tracer``/``health``)."""
+        for p in self.predictors:
+            if p.profiler is not None:
+                return p.profiler
         return None
 
     async def predict(self, msg):

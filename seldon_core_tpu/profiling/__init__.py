@@ -1,0 +1,70 @@
+"""Continuous profiling plane (docs/observability.md): the third
+observability pillar next to sampled tracing and the always-on health
+plane — *where the cycles go*.  Three pillars, one subsystem:
+
+1. **Host sampling profiler**
+   (:mod:`~seldon_core_tpu.profiling.hostsampler`): a daemon thread
+   samples every thread's stack at ``seldon.io/profile-hz`` into a
+   bounded folded-stack table keyed by thread + running asyncio task;
+   collapsed-flamegraph export at ``/admin/profile``, on-demand
+   baseline-diff capture windows (optionally wrapping an ``xla_profile``
+   device trace) at ``/admin/profile/capture``, ASCII rendering and
+   profile diffing with ``tools/profview.py``.
+2. **Compile observability**
+   (:mod:`~seldon_core_tpu.profiling.compilewatch`): every fused-segment
+   shape-bucket compile reports wall time and
+   ``lower().compile().cost_analysis()`` FLOPs / bytes-accessed /
+   peak-HBM; ``seldon_compile_*`` metrics, ``/admin/profile/compile``,
+   and a recompile-storm signal fused into the ``/admin/health``
+   verdict.
+3. **Per-request cost attribution**
+   (:mod:`~seldon_core_tpu.profiling.attribution`): estimated
+   FLOPs/HBM-bytes per request from segment cost × dynamic-batch share,
+   stamped into the flight recorder and exported as counters, plus the
+   ``/admin/profile/capacity`` headroom estimate (achievable rps vs.
+   device peak).
+
+Enabled by ``seldon.io/profile: "true"`` (env ``SELDON_PROFILE=1`` for
+the gateway); validated at admission (graphlint GL11xx,
+``operator/compile.py profile_config``).
+"""
+
+from seldon_core_tpu.profiling.attribution import (
+    CostAttribution,
+    attribution_scope,
+    device_peak_tflops,
+    note_segment_cost,
+)
+from seldon_core_tpu.profiling.compilewatch import (
+    STORM_WINDOW_S,
+    CompileWatch,
+)
+from seldon_core_tpu.profiling.config import (
+    PROFILE_ANNOTATION,
+    PROFILE_HZ_ANNOTATION,
+    PROFILE_STACKS_ANNOTATION,
+    PROFILE_STORM_ANNOTATION,
+    PROFILE_WINDOW_S_ANNOTATION,
+    ProfileConfig,
+    profile_config_from_annotations,
+)
+from seldon_core_tpu.profiling.hostsampler import HostSampler
+from seldon_core_tpu.profiling.plane import ProfilePlane
+
+__all__ = [
+    "PROFILE_ANNOTATION",
+    "PROFILE_HZ_ANNOTATION",
+    "PROFILE_STACKS_ANNOTATION",
+    "PROFILE_STORM_ANNOTATION",
+    "PROFILE_WINDOW_S_ANNOTATION",
+    "ProfileConfig",
+    "profile_config_from_annotations",
+    "HostSampler",
+    "CompileWatch",
+    "STORM_WINDOW_S",
+    "CostAttribution",
+    "attribution_scope",
+    "note_segment_cost",
+    "device_peak_tflops",
+    "ProfilePlane",
+]

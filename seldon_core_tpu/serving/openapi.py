@@ -252,6 +252,86 @@ def _health_paths() -> dict:
     }
 
 
+def _profile_paths() -> dict:
+    """The profiling-plane admin surface — identical on gateway and engine
+    (docs/observability.md#continuous-profiling-plane)."""
+    disabled = {"404": {"description": "profiling plane disabled"}}
+    bad_num = {"400": {"description": "non-numeric query parameter"}}
+    return {
+        "/admin/profile": {
+            "get": {
+                "summary": "always-on host-profiler posture + collapsed "
+                           "flamegraph (render with tools/profview)",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "n", "in": "query",
+                     "schema": {"type": "integer"},
+                     "description": "cap the folded stacks returned"},
+                    {"name": "reset", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "clear the folded table after reading"},
+                ],
+                "responses": {
+                    "200": {"description": "stats + collapsed profile"},
+                    **bad_num, **disabled,
+                },
+            }
+        },
+        "/admin/profile/capture": {
+            "get": {
+                "summary": "baseline-diff capture window: open with "
+                           "?seconds, poll/finalize with ?id[&stop]",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "seconds", "in": "query",
+                     "schema": {"type": "number", "default": 5.0}},
+                    {"name": "device", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "directory for an xla_profile device "
+                                    "trace spanning the window"},
+                    {"name": "id", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "stop", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "finalize the window now (one-shot)"},
+                ],
+                "responses": {
+                    "200": {"description": "window handle or diffed "
+                                           "profile"},
+                    "400": {"description": "bad seconds / past "
+                                           "seldon.io/profile-window-s"},
+                    "404": {"description": "unknown window id, or "
+                                           "profiling plane disabled"},
+                    "429": {"description": "too many concurrent capture "
+                                           "windows"},
+                },
+            }
+        },
+        "/admin/profile/compile": {
+            "get": {
+                "summary": "per-segment XLA compile ledger: wall time, "
+                           "per-bucket cost analysis, recompile storms",
+                "tags": ["ops"],
+                "responses": {
+                    "200": {"description": "compile telemetry snapshot"},
+                    **disabled,
+                },
+            }
+        },
+        "/admin/profile/capacity": {
+            "get": {
+                "summary": "attributed FLOP demand vs device peak → "
+                           "achievable-RPS headroom",
+                "tags": ["ops"],
+                "responses": {
+                    "200": {"description": "capacity estimate"},
+                    **disabled,
+                },
+            }
+        },
+    }
+
+
 def gateway_spec() -> dict:
     """External API (reference apife.oas3.json)."""
     paths = {
@@ -317,6 +397,7 @@ def gateway_spec() -> dict:
             }
         },
         **_health_paths(),
+        **_profile_paths(),
         **_ops_paths(),
     }
     return {
@@ -359,6 +440,7 @@ def engine_spec() -> dict:
                            "tags": ["ops"],
                            "responses": {"200": {"description": "traces"}}}},
         **_health_paths(),
+        **_profile_paths(),
         **_ops_paths(),
     }
     return {

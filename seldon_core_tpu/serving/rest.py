@@ -407,6 +407,44 @@ class EngineServer:
 
         return await self._health_endpoint(request, health_body)
 
+    def _profile_plane(self):
+        """The engine's profiling plane (duck attr, like ``health``)."""
+        return getattr(self.engine, "profiler", None)
+
+    async def _profile_endpoint(self, request: web.Request,
+                                body_fn) -> web.Response:
+        try:
+            status, payload = body_fn(self._profile_plane(), request.query)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, "numeric query parameter expected"),
+                content_type="application/json",
+            )
+        return web.Response(
+            status=status, text=json.dumps(payload),
+            content_type="application/json",
+        )
+
+    async def profile(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.profiling.http import profile_body
+
+        return await self._profile_endpoint(request, profile_body)
+
+    async def profile_capture(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.profiling.http import capture_body
+
+        return await self._profile_endpoint(request, capture_body)
+
+    async def profile_compile(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.profiling.http import compile_body
+
+        return await self._profile_endpoint(request, compile_body)
+
+    async def profile_capacity(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.profiling.http import capacity_body
+
+        return await self._profile_endpoint(request, capacity_body)
+
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
         app.router.add_post("/api/v0.1/stream", self.stream)
@@ -421,6 +459,10 @@ class EngineServer:
         app.router.add_get("/admin/introspect", self.introspect)
         app.router.add_get("/admin/flightrecorder", self.flightrecorder)
         app.router.add_get("/admin/health", self.health_verdict)
+        app.router.add_get("/admin/profile", self.profile)
+        app.router.add_get("/admin/profile/capture", self.profile_capture)
+        app.router.add_get("/admin/profile/compile", self.profile_compile)
+        app.router.add_get("/admin/profile/capacity", self.profile_capacity)
         app.router.add_get("/seldon.json", _openapi_handler("engine"))
 
 

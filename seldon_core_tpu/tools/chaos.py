@@ -44,6 +44,12 @@ class ChaosPolicy:
     # probability a call hangs for hang_ms (timeout / deadline testing)
     hang_rate: float = 0.0
     hang_ms: float = 1000.0
+    # synchronous CPU burn per call (host-profiler drills: unlike the
+    # asyncio sleeps above this BLOCKS the event loop in a distinctly
+    # named frame, so a flamegraph from profiling/hostsampler.py must
+    # show `_chaos_cpu_burn` dominating — bench.py --profile-smoke
+    # asserts exactly that)
+    cpu_burn_ms: float = 0.0
     # -- burst mode: deterministic latency spikes over a seeded schedule
     # (overload drills, docs/qos.md): every call landing inside a burst
     # window pays burst_latency_ms EXTRA.  Windows are drawn once from
@@ -109,6 +115,17 @@ class BurstSchedule:
         return [w for w in self._windows if w[0] < elapsed_s]
 
 
+def _chaos_cpu_burn(ms: float) -> int:
+    """Synchronous busy loop (module-level, distinctly named so folded
+    host-profiler stacks attribute the burn to `chaos:_chaos_cpu_burn`
+    rather than an anonymous lambda)."""
+    deadline = time.perf_counter() + ms / 1000.0
+    acc = 0
+    while time.perf_counter() < deadline:
+        acc += 1
+    return acc
+
+
 class ChaosError(SeldonComponentError):
     """Injected failure: rides the standard component-error path, so the
     graph engine wires it as a FAILURE status with this reason."""
@@ -134,6 +151,7 @@ class ChaosWrapper:
         self.injected_errors = 0
         self.injected_delays = 0
         self.injected_bursts = 0
+        self.injected_burns = 0
         self.calls = 0
         self.name = getattr(inner, "name", type(inner).__name__)
         # burst schedule: its own seeded stream (per-call draws above stay
@@ -177,8 +195,15 @@ class ChaosWrapper:
             # function of the (deterministic) schedule and the call's
             # arrival time, not of coroutine wakeup order
             burst = self.burst_active()
-            if hang or fail or burst or pol.latency_ms or pol.jitter_ms:
-                self._mark_span(method, hang=hang, fail=fail, burst=burst)
+            if (hang or fail or burst or pol.latency_ms or pol.jitter_ms
+                    or pol.cpu_burn_ms):
+                self._mark_span(method, hang=hang, fail=fail, burst=burst,
+                                burn=bool(pol.cpu_burn_ms))
+            if pol.cpu_burn_ms:
+                # deliberately synchronous: the burn holds the event loop
+                # (that is the drill — blocking work on the hot path)
+                self.injected_burns += 1
+                _chaos_cpu_burn(pol.cpu_burn_ms)
             if hang:
                 self.injected_delays += 1
                 await asyncio.sleep(pol.hang_ms / 1000.0)
@@ -199,7 +224,7 @@ class ChaosWrapper:
         return await maybe_await(getattr(self.inner, method)(*args))
 
     def _mark_span(self, method: str, *, hang: bool, fail: bool,
-                   burst: bool) -> None:
+                   burst: bool, burn: bool = False) -> None:
         """Record the injection on the request's current span (no-op when
         tracing is off) — a drilled trace must say it was drilled."""
         from seldon_core_tpu.utils.tracing import current_span
@@ -210,7 +235,8 @@ class ChaosWrapper:
         sp.add_event(
             "chaos", target=f"{self.name}.{method}",
             kind=("hang" if hang else "error" if fail
-                  else "burst" if burst else "latency"),
+                  else "burst" if burst else "cpu_burn" if burn
+                  else "latency"),
             drill_id=self.policy.drill_id,
         )
         if self.policy.drill_id:

@@ -308,6 +308,13 @@ _LANES = {
         lambda probes: sum(
             float(p.get("queue_rows", 0.0) or 0.0) for p in probes.values()),
     ),
+    # profiling plane (profiling/plane.py profile probe): estimated
+    # device-FLOP occupancy from per-request attribution — the lane that
+    # answers "was the device actually busy during that latency spike?"
+    "device": (
+        "device", "occupancy",
+        lambda probes: _first_value(probes, ("device_occupancy_est",)),
+    ),
 }
 
 
@@ -396,9 +403,9 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--introspect", default="",
                     help="/admin/introspect JSON dump to render as "
                          "sparkline lanes under the report")
-    ap.add_argument("--lanes", default="memory,queue",
+    ap.add_argument("--lanes", default="memory,queue,device",
                     help="comma-separated introspection lanes "
-                         "(memory,queue); used with --introspect")
+                         "(memory,queue,device); used with --introspect")
     args = ap.parse_args(argv)
 
     if not args.path and not args.introspect:

@@ -16,17 +16,45 @@ async def maybe_await(x: Any) -> Any:
     return x
 
 
-def enable_compile_cache(cache_dir: str | None = None) -> None:
+#: resolved cache dir once enabled (idempotence + the
+#: ``seldon_compile_cache_enabled`` gauge read by the profile probe)
+_COMPILE_CACHE_DIR: str | None = None
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def compile_cache_enabled() -> bool:
+    """Whether :func:`enable_compile_cache` has taken effect in this
+    process (exported as the ``seldon_compile_cache_enabled`` gauge —
+    dashboards tell cold fleets apart from warm ones)."""
+    return _COMPILE_CACHE_DIR is not None
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str | None:
     """Persistent XLA compilation cache.
 
     Remote compiles over the device tunnel cost 20-40 s each; with the cache
     warm a bench/dryrun run spends seconds, not minutes, in compilation.
-    Resolution order: explicit arg > ``JAX_COMPILATION_CACHE_DIR`` env >
-    ``<repo root>/.jax_cache``.  Safe to call multiple times; never raises
-    (older jax versions without the knobs just skip them).
-    """
-    import jax
+    Resolution order: explicit arg > ``SELDON_COMPILE_CACHE`` env (a path,
+    or a boolean — falsy disables, truthy uses the default dir) >
+    ``JAX_COMPILATION_CACHE_DIR`` env > ``<repo root>/.jax_cache``.
 
+    Idempotent: once enabled, repeat calls (any args) return the active
+    dir without touching jax config again — the operator boot path, the
+    bench harness, and tests can all call it freely.  Never raises
+    (older jax versions without the knobs just skip them); returns the
+    active cache dir, or None when disabled via env.
+    """
+    global _COMPILE_CACHE_DIR
+    if _COMPILE_CACHE_DIR is not None:
+        return _COMPILE_CACHE_DIR
+
+    env = os.environ.get("SELDON_COMPILE_CACHE")
+    if env is not None and env.strip().lower() in _FALSY:
+        return None
+    if cache_dir is None and env and env.strip().lower() not in (
+            "1", "true", "yes", "on"):
+        cache_dir = env  # a path, not a boolean
     if cache_dir is None:
         cache_dir = os.environ.get(
             "JAX_COMPILATION_CACHE_DIR",
@@ -37,8 +65,12 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
             ),
         )
     try:
+        import jax
+
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _COMPILE_CACHE_DIR = cache_dir
     except Exception:
         pass
+    return _COMPILE_CACHE_DIR

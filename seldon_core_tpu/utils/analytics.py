@@ -371,6 +371,107 @@ CATALOG: tuple[MetricInfo, ...] = (
         "label value is unbounded and that metric is now partial",
         ("metric",),
     ),
+    # -- profiling plane (docs/observability.md): host sampling profiler,
+    #    XLA compile/cost telemetry, per-request FLOP attribution --------
+    MetricInfo(
+        "seldon_profile_samples_total", "counter",
+        "Host profiler sampling ticks since process start (profiling/"
+        "hostsampler.py; a flat line means the sampler thread died)",
+        ("service",),
+    ),
+    MetricInfo(
+        "seldon_profile_stacks", "gauge",
+        "Distinct folded stacks in the profiler's bounded table "
+        "(at seldon.io/profile-stacks the (other) overflow bucket "
+        "starts absorbing new stacks)",
+        ("service",),
+    ),
+    MetricInfo(
+        "seldon_profile_windows_open", "gauge",
+        "Capture windows currently open via /admin/profile/capture",
+        ("service",),
+    ),
+    MetricInfo(
+        "seldon_compile_total", "counter",
+        "XLA segment compilations, labelled by fused segment and "
+        "shape-bucket (rows x cols : dtype) — a high rate on one segment "
+        "is a recompile storm (each recompile is seconds of dead device "
+        "time)",
+        ("segment", "bucket"),
+    ),
+    MetricInfo(
+        "seldon_compile_wall_ms_total", "counter",
+        "Milliseconds spent inside lower().compile() per fused segment",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_compile_flops", "gauge",
+        "XLA cost_analysis FLOPs for a segment's latest compile per "
+        "shape-bucket (the per-request attribution numerator)",
+        ("segment", "bucket"),
+    ),
+    MetricInfo(
+        "seldon_compile_bytes_accessed", "gauge",
+        "XLA cost_analysis bytes-accessed per segment and shape-bucket "
+        "(HBM traffic estimate)",
+        ("segment", "bucket"),
+    ),
+    MetricInfo(
+        "seldon_compile_peak_hbm_bytes", "gauge",
+        "Compiled executable peak memory (argument + output + temp) per "
+        "segment and shape-bucket, from memory_analysis()",
+        ("segment", "bucket"),
+    ),
+    MetricInfo(
+        "seldon_compile_storm", "gauge",
+        "1 while a segment is recompiling at storm rate (>= "
+        "seldon.io/profile-storm compiles within the window) — also "
+        "degrades the /admin/health verdict to warn",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_compile_cache_enabled", "gauge",
+        "1 when the persistent XLA compile cache is active in this "
+        "process (utils.enable_compile_cache; cold fleets recompile "
+        "everything on every rollout)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_request_flops_total", "counter",
+        "Device FLOPs attributed to completed requests (segment "
+        "cost_analysis x the request's share of each dynamic batch)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_request_hbm_bytes_total", "counter",
+        "HBM bytes-accessed attributed to completed requests (same "
+        "share accounting as seldon_request_flops_total)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_request_attributed_total", "counter",
+        "Requests that received nonzero FLOP attribution (compare to "
+        "request rate for attribution coverage)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_occupancy_est", "gauge",
+        "Estimated device FLOP occupancy: attributed FLOP rate / device "
+        "peak (introspection sampler profile probe; the "
+        "/admin/profile/capacity headroom estimate derives from it)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_compiles_total", "gauge",
+        "Cumulative segment compilations at sample time (sampler twin "
+        "of seldon_compile_total)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_recompile_storm", "gauge",
+        "1 while any segment is in a recompile storm at sample time",
+        ("probe",),
+    ),
     MetricInfo(
         "seldon_device_registry_entries", "gauge",
         "Zero-copy device-buffer registry entries (event-driven twin of "
@@ -564,6 +665,22 @@ def alert_rules() -> dict:
                         },
                     },
                     {
+                        "alert": "SeldonRecompileStorm",
+                        "expr": "max by (segment) (seldon_compile_storm)"
+                                " > 0",
+                        "for": "2m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "segment {{ $labels.segment }} is "
+                                "recompiling at storm rate — shape/dtype "
+                                "churn is burning device time on XLA "
+                                "compiles (bucket the inputs or pad to "
+                                "the batcher ladder; /admin/profile/"
+                                "compile has the per-bucket ledger)",
+                        },
+                    },
+                    {
                         "alert": "SeldonGatewayRetrying",
                         "expr": (
                             "sum(rate(seldon_api_gateway_retries_total[5m])) "
@@ -677,6 +794,19 @@ def grafana_dashboard() -> dict:
                 "max(seldon_runtime_queue_occupancy) by (probe)",
                 "max(seldon_runtime_event_loop_lag_ms) by (probe)"],
                y=56, x=0),
+        _panel(16, "XLA compiles + recompile storms",
+               ["sum(rate(seldon_compile_total[5m])) by (segment)",
+                "max(seldon_compile_storm) by (segment)",
+                "sum(rate(seldon_compile_wall_ms_total[5m])) by (segment)"],
+               y=56, x=12),
+        _panel(17, "Attributed device FLOPs (per deployment)",
+               ["sum(rate(seldon_request_flops_total[5m])) by (deployment)",
+                "sum(rate(seldon_request_hbm_bytes_total[5m])) "
+                "by (deployment)"], y=64, x=0),
+        _panel(18, "Device occupancy estimate + compile cache",
+               ["max(seldon_runtime_device_occupancy_est) by (probe)",
+                "max(seldon_compile_cache_enabled) by (probe)"],
+               y=64, x=12, unit="percentunit"),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",

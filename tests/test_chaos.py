@@ -74,6 +74,53 @@ def test_latency_injection_delays_the_call():
     assert w.injected_delays == 1
 
 
+def test_cpu_burn_blocks_the_loop_in_a_named_frame():
+    """The burn must be synchronous (it holds the event loop — that is
+    the drill) and spend its time inside the distinctly named
+    ``_chaos_cpu_burn`` frame so host-profiler flamegraphs attribute it
+    (bench.py --profile-smoke asserts the attribution end to end)."""
+    w = wrap(ChaosPolicy(cpu_burn_ms=30.0, seed=0))
+    eng = engine_with(w)
+
+    loop_yields = []
+
+    async def drill():
+        async def ticker():
+            while True:
+                loop_yields.append(time.perf_counter())
+                await asyncio.sleep(0)
+
+        t = asyncio.ensure_future(ticker())
+        for _ in range(3):  # let the ticker establish its cadence
+            await asyncio.sleep(0)
+        msg = SeldonMessage.from_ndarray(
+            np.asarray([[1.0, 2.0]], np.float32))
+        await eng.predict(msg)
+        await asyncio.sleep(0)
+        t.cancel()
+
+    asyncio.run(drill())
+    assert w.injected_burns == 1
+    # the loop starved for the burn duration: some gap between ticker
+    # wakeups must cover (most of) the 30ms burn
+    gaps = [b - a for a, b in zip(loop_yields, loop_yields[1:])]
+    assert gaps and max(gaps) >= 0.02
+
+
+def test_cpu_burn_frame_visible_to_the_host_sampler():
+    from seldon_core_tpu.profiling import HostSampler
+
+    sampler = HostSampler(hz=200.0)
+    w = wrap(ChaosPolicy(cpu_burn_ms=120.0, seed=0))
+    eng = engine_with(w)
+    sampler.ensure_started()
+    try:
+        run_predict(eng)
+    finally:
+        sampler.stop()
+    assert any("_chaos_cpu_burn" in stack for stack in sampler.folded())
+
+
 def test_methods_filter_scopes_faults():
     """Faults armed only for send_feedback must leave predict untouched."""
     class Learner(Identity):

@@ -1,0 +1,880 @@
+"""Continuous profiling plane: annotation config, host sampler, compile
+watch, per-request cost attribution, admin bodies, profview rendering,
+graphlint GL11xx, and admission."""
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.profiling import (
+    CompileWatch,
+    CostAttribution,
+    HostSampler,
+    ProfileConfig,
+    ProfilePlane,
+    attribution_scope,
+    note_segment_cost,
+    profile_config_from_annotations,
+)
+from seldon_core_tpu.profiling.hostsampler import OVERFLOW_KEY
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+NO_BATCH = {"seldon.io/batching": "false"}
+
+MLP_SPEC = {
+    "name": "m", "type": "MODEL",
+    "parameters": [
+        {"name": "model_class",
+         "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+         "type": "STRING"},
+    ],
+}
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def resolver():
+    from seldon_core_tpu.operator.local import resolve_component
+
+    return lambda u: resolve_component(u, NO_BATCH)
+
+
+def _spin(seconds: float) -> int:
+    deadline = time.perf_counter() + seconds
+    acc = 0
+    while time.perf_counter() < deadline:
+        acc += 1
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# annotation config
+# ---------------------------------------------------------------------------
+
+class TestProfileConfig:
+    def test_defaults_off(self):
+        cfg = profile_config_from_annotations({})
+        assert cfg == ProfileConfig()
+        assert not cfg.enabled
+        assert cfg.hz == 19.0  # prime: never phase-locks periodic work
+
+    def test_full_annotation_family(self):
+        cfg = profile_config_from_annotations({
+            "seldon.io/profile": "true",
+            "seldon.io/profile-hz": "97",
+            "seldon.io/profile-stacks": "500",
+            "seldon.io/profile-window-s": "10",
+            "seldon.io/profile-storm": "6",
+        })
+        assert cfg == ProfileConfig(enabled=True, hz=97.0, stacks=500,
+                                    window_s=10.0, storm=6)
+
+    def test_env_fallbacks(self, monkeypatch):
+        monkeypatch.setenv("SELDON_PROFILE", "1")
+        monkeypatch.setenv("SELDON_PROFILE_HZ", "53")
+        cfg = profile_config_from_annotations({})
+        assert cfg.enabled and cfg.hz == 53.0
+        # annotations outrank the env
+        cfg = profile_config_from_annotations(
+            {"seldon.io/profile": "false", "seldon.io/profile-hz": "7"})
+        assert not cfg.enabled and cfg.hz == 7.0
+
+    @pytest.mark.parametrize("ann,needle", [
+        ({"seldon.io/profile": "maybe"}, "not a boolean"),
+        ({"seldon.io/profile-hz": "fast"}, "not a number"),
+        ({"seldon.io/profile-hz": "0"}, "outside (0, 1000]"),
+        ({"seldon.io/profile-hz": "2000"}, "outside (0, 1000]"),
+        ({"seldon.io/profile-stacks": "x"}, "not an integer"),
+        ({"seldon.io/profile-stacks": "0"}, "must be > 0"),
+        ({"seldon.io/profile-window-s": "soon"}, "not a number"),
+        ({"seldon.io/profile-window-s": "1e9"}, "outside (0, 600]"),
+        ({"seldon.io/profile-storm": "1.5"}, "not an integer"),
+        ({"seldon.io/profile-storm": "1"}, "must be >= 2"),
+    ])
+    def test_invalid_values_raise_with_annotation_name(self, ann, needle):
+        with pytest.raises(ValueError) as ei:
+            profile_config_from_annotations(ann, "dep/p")
+        msg = str(ei.value)
+        assert needle in msg
+        assert next(iter(ann)) in msg
+        assert "dep/p" in msg  # path-prefixed for admission errors
+
+
+# ---------------------------------------------------------------------------
+# host sampler
+# ---------------------------------------------------------------------------
+
+class TestHostSampler:
+    def test_sample_once_folds_a_busy_thread(self):
+        sampler = HostSampler(hz=50.0)
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                _spin(0.002)
+
+        t = threading.Thread(target=busy, name="busy-worker")
+        t.start()
+        try:
+            for _ in range(20):
+                sampler.sample_once()
+                time.sleep(0.002)
+        finally:
+            stop.set()
+            t.join()
+        folded = sampler.folded()
+        hit = [s for s in folded
+               if "thread:busy-worker" in s and "test_profiling:_spin" in s]
+        assert hit, f"busy frame missing from {sorted(folded)[:10]}"
+        # keys are root-first: the thread root leads every stack
+        assert all(s.split(";")[0].startswith("thread:")
+                   for s in folded if s != OVERFLOW_KEY)
+
+    def test_running_asyncio_task_keys_the_stack(self):
+        sampler = HostSampler(hz=500.0)
+
+        def hammer():
+            for _ in range(60):
+                sampler.sample_once()
+                time.sleep(0.002)
+
+        async def main():
+            t = threading.Thread(target=hammer)
+            t.start()
+            # a deliberately loop-blocking task: it is the RUNNING task
+            # while the hammer thread samples
+            task = asyncio.get_running_loop().create_task(
+                asyncio.to_thread(t.join))
+            await asyncio.get_running_loop().create_task(
+                _spin_coro(), name="prof-busy")
+            await task
+
+        async def _spin_coro():
+            _spin(0.12)
+
+        asyncio.run(main())
+        assert any("task:prof-busy" in s and "test_profiling:_spin" in s
+                   for s in sampler.folded())
+
+    def test_bounded_stack_table_overflows_to_other(self):
+        sampler = HostSampler(hz=1.0, max_stacks=2)
+        with sampler._lock:
+            sampler._folded["a"] = 1
+            sampler._folded["b"] = 1
+        # a third distinct stack must fold into (other), not grow the table
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="parked")
+        t.start()
+        try:
+            sampler.sample_once()
+        finally:
+            stop.set()
+            t.join()
+        folded = sampler.folded()
+        assert len(folded) <= 3  # a, b, (other)
+        assert folded.get(OVERFLOW_KEY, 0) >= 1
+
+    def test_ensure_started_is_idempotent_and_stops_clean(self):
+        sampler = HostSampler(hz=200.0)
+        assert sampler.ensure_started()
+        first = sampler._thread
+        assert sampler.ensure_started()
+        assert sampler._thread is first
+        time.sleep(0.05)
+        sampler.stop()
+        assert not sampler.running
+        assert sampler.samples > 0
+
+    def test_concurrent_windows_hold_independent_baselines(self):
+        sampler = HostSampler(hz=1000.0)
+        stop = threading.Event()
+        t = threading.Thread(target=lambda: _spin_until(stop),
+                             name="windowed")
+        t.start()
+        try:
+            w1 = sampler.open_window(30.0)
+            for _ in range(10):
+                sampler.sample_once()
+            w2 = sampler.open_window(30.0)
+            for _ in range(10):
+                sampler.sample_once()
+            r2 = sampler.read_window(w2["id"], stop=True)
+            r1 = sampler.read_window(w1["id"], stop=True)
+        finally:
+            stop.set()
+            t.join()
+            sampler.stop()
+        assert r1["done"] and r2["done"]
+        # open_window ensure_starts the 1000 Hz background thread, which
+        # samples concurrently with the manual sample_once calls — exact
+        # counts race, but the manual samples are a floor and w1 (opened
+        # one 10-sample loop earlier, read later) must stay ahead of w2
+        assert r1["samples"] >= 20 and r2["samples"] >= 10
+        assert r1["samples"] >= r2["samples"] + 10
+        # w1 opened earlier: its diff dominates w2's on every shared stack
+        f1 = _parse(r1["folded"])
+        f2 = _parse(r2["folded"])
+        assert sum(f1.values()) >= sum(f2.values())
+        for stack, count in f2.items():
+            assert f1.get(stack, 0) >= count
+        # one-shot reads: both windows are gone, the table is intact
+        assert sampler.read_window(w1["id"]) is None
+        assert sampler.stats()["windows"] == []
+        assert sum(sampler.folded().values()) >= sum(f1.values())
+
+    def test_window_cap_raises_value_error(self):
+        sampler = HostSampler(hz=1.0)
+        try:
+            for _ in range(8):
+                sampler.open_window(30.0)
+            with pytest.raises(ValueError) as ei:
+                sampler.open_window(30.0)
+            assert "concurrent capture windows" in str(ei.value)
+        finally:
+            sampler.stop()
+
+    def test_reset_keeps_open_window_diffs_sane(self):
+        sampler = HostSampler(hz=1000.0)
+        stop = threading.Event()
+        t = threading.Thread(target=lambda: _spin_until(stop))
+        t.start()
+        try:
+            for _ in range(5):
+                sampler.sample_once()
+            w = sampler.open_window(30.0)
+            sampler.reset()
+            for _ in range(3):
+                sampler.sample_once()
+            r = sampler.read_window(w["id"], stop=True)
+        finally:
+            stop.set()
+            t.join()
+            sampler.stop()
+        # post-reset counts sit below the pre-reset baseline: the diff
+        # clamps at zero rather than going negative or corrupting
+        assert all(v > 0 for v in _parse(r["folded"]).values())
+
+    def test_no_deadlock_against_metrics_registry(self):
+        """A probe rendering the registry while the sampler publishes
+        gauges must never order-couple the two locks (the sampler calls
+        the registry strictly outside its table lock)."""
+        registry = MetricsRegistry()
+        sampler = HostSampler(hz=1000.0, metrics=registry,
+                              service="engine")
+        sampler.ensure_started()
+        done = threading.Event()
+        rendered = [0]
+
+        def hammer_render():
+            while not done.is_set():
+                registry.render()
+                sampler.stats()
+                rendered[0] += 1
+
+        threads = [threading.Thread(target=hammer_render)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        done.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        sampler.stop()
+        assert all(not t.is_alive() for t in threads), "render deadlocked"
+        assert rendered[0] > 0
+        assert "seldon_profile_samples_total" in registry.render()
+
+    @pytest.mark.slow
+    def test_sampling_overhead_bounded_at_100hz(self):
+        """One sample must stay cheap enough that 100 Hz is a rounding
+        error on a serving core (lenient: CI boxes vary wildly)."""
+        sampler = HostSampler(hz=100.0)
+        stop = threading.Event()
+        t = threading.Thread(target=lambda: _spin_until(stop))
+        t.start()
+        try:
+            sampler.sample_once()  # warm imports
+            t0 = time.perf_counter()
+            for _ in range(100):
+                sampler.sample_once()
+            per_sample_ms = (time.perf_counter() - t0) * 10.0
+        finally:
+            stop.set()
+            t.join()
+        # 100 Hz * 5ms/sample would be 50% of a core — far past broken
+        assert per_sample_ms < 5.0
+
+
+def _spin_until(stop: threading.Event) -> None:
+    while not stop.is_set():
+        _spin(0.002)
+
+
+def _parse(folded_text: str) -> dict:
+    from seldon_core_tpu.tools.profview import parse_collapsed
+
+    return parse_collapsed(folded_text)
+
+
+# ---------------------------------------------------------------------------
+# capture windows + xla_profile re-entrancy
+# ---------------------------------------------------------------------------
+
+class TestDeviceTraceWindows:
+    def test_window_device_trace_while_xla_profile_active(self, tmp_path,
+                                                          caplog):
+        """A capture window asking for a device trace while xla_profile
+        is already active must warn-and-skip the device part, never crash
+        or corrupt the host-stack capture (jax allows one profiler
+        session per process)."""
+        from seldon_core_tpu.utils.tracing import xla_profile
+
+        sampler = HostSampler(hz=1000.0)
+        stop = threading.Event()
+        t = threading.Thread(target=lambda: _spin_until(stop))
+        t.start()
+        try:
+            with xla_profile(str(tmp_path / "outer")):
+                with caplog.at_level("WARNING"):
+                    w = sampler.open_window(
+                        30.0, device_dir=str(tmp_path / "inner"))
+                for _ in range(5):
+                    sampler.sample_once()
+                r = sampler.read_window(w["id"], stop=True)
+        finally:
+            stop.set()
+            t.join()
+            sampler.stop()
+        assert r["done"] and r["samples"] == 5
+        assert any("already active" in rec.message
+                   for rec in caplog.records)
+
+    def test_stop_closes_window_device_state(self, tmp_path):
+        sampler = HostSampler(hz=1.0)
+        sampler.open_window(30.0, device_dir=str(tmp_path / "trace"))
+        sampler.stop()  # must close the trace, not leak the session
+        # a fresh trace session starts cleanly afterwards
+        from seldon_core_tpu.utils.tracing import xla_profile
+
+        with xla_profile(str(tmp_path / "after")):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# compile watch
+# ---------------------------------------------------------------------------
+
+class TestCompileWatch:
+    def test_ledger_and_snapshot(self):
+        clock = FakeClock()
+        watch = CompileWatch(storm_threshold=4, clock=clock)
+        watch.note_compile("seg", bucket="1x784:float32", wall_ms=12.5,
+                           flops=1e9, bytes_accessed=2e6,
+                           peak_hbm_bytes=3e6)
+        snap = watch.snapshot()
+        seg = snap["segments"]["seg"]
+        assert seg["compiles"] == 1
+        assert seg["wallMsTotal"] == 12.5
+        assert seg["buckets"]["1x784:float32"]["flops"] == 1e9
+        assert snap["storm"] == [] and not seg["storm"]
+
+    def test_storm_threshold_within_window(self):
+        clock = FakeClock()
+        watch = CompileWatch(storm_threshold=3, clock=clock)
+        for i in range(2):
+            watch.note_compile("seg", bucket=f"{i}x:f32")
+            clock.t += 1.0
+        assert watch.storm_segments() == []
+        watch.note_compile("seg", bucket="2x:f32")
+        assert watch.storm_segments() == ["seg"]
+        assert watch.snapshot()["segments"]["seg"]["storm"]
+        # the storm clears once the churn ages out of the 60s window
+        clock.t += 120.0
+        assert watch.storm_segments() == []
+
+    def test_storm_is_per_segment(self):
+        clock = FakeClock()
+        watch = CompileWatch(storm_threshold=2, clock=clock)
+        watch.note_compile("calm", bucket="a")
+        for b in ("a", "b"):
+            watch.note_compile("churny", bucket=b)
+        assert watch.storm_segments() == ["churny"]
+
+    def test_storm_metric_exported(self):
+        registry = MetricsRegistry()
+        watch = CompileWatch(metrics=registry, storm_threshold=2,
+                             clock=FakeClock())
+        watch.note_compile("seg", bucket="a", wall_ms=5.0, flops=1e6)
+        watch.note_compile("seg", bucket="b", wall_ms=5.0, flops=1e6)
+        text = registry.render()
+        assert "seldon_compile_total" in text
+        assert 'seldon_compile_storm{segment="seg"} 1' in text
+
+    def test_bucket_ledger_bounded(self):
+        watch = CompileWatch(clock=FakeClock())
+        for i in range(100):
+            watch.note_compile("seg", bucket=f"{i}x:f32")
+        seg = watch.snapshot()["segments"]["seg"]
+        assert seg["compiles"] == 100
+        assert len(seg["buckets"]) <= 64
+
+
+# ---------------------------------------------------------------------------
+# cost attribution
+# ---------------------------------------------------------------------------
+
+class TestCostAttribution:
+    def test_scope_sums_segment_shares(self):
+        token = attribution_scope()
+        note_segment_cost("a", 100.0, 10.0)
+        note_segment_cost("a", 50.0, 5.0)
+        note_segment_cost("b", 25.0, 0.0)
+        out = token.close()
+        assert out["flops"] == 175.0
+        assert out["hbmBytes"] == 15.0
+        assert out["segments"] == {"a": 150.0, "b": 25.0}
+        # closed scope: further notes are no-ops, not leaks
+        note_segment_cost("c", 1.0, 1.0)
+
+    def test_concurrent_scopes_are_isolated(self):
+        async def request(flops):
+            token = attribution_scope()
+            await asyncio.sleep(0.01)
+            note_segment_cost("seg", flops, 0.0)
+            await asyncio.sleep(0.01)
+            return token.close()["flops"]
+
+        async def main():
+            return await asyncio.gather(*(request(float(i))
+                                          for i in range(1, 6)))
+
+        assert asyncio.run(main()) == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_capacity_estimate(self):
+        clock = FakeClock()
+        attr = CostAttribution(deployment="d", peak_tflops=100.0,
+                               clock=clock)
+        for _ in range(10):
+            attr.note_request(1e12)  # 1 TFLOP per request
+            clock.t += 1.0
+        cap = attr.capacity()
+        assert cap["requests"] == 10 and cap["attributed"] == 10
+        assert cap["avgRequestGflops"] == 1000.0
+        # 100 TFLOP/s peak / 1 TFLOP per request = 100 rps achievable
+        assert cap["achievableRps"] == pytest.approx(100.0)
+        assert cap["headroom"] == pytest.approx(100.0, rel=0.1)
+        assert 0.0 < cap["occupancyEst"] <= 1.0
+
+    def test_capacity_empty_window_hints(self):
+        attr = CostAttribution(clock=FakeClock())
+        cap = attr.capacity()
+        assert cap["requests"] == 0
+        assert "fused" in cap["hint"]
+
+    def test_device_peak_env_override(self, monkeypatch):
+        from seldon_core_tpu.profiling import device_peak_tflops
+
+        monkeypatch.setenv("SELDON_DEVICE_PEAK_TFLOPS", "459")
+        assert device_peak_tflops() == 459.0
+        monkeypatch.setenv("SELDON_DEVICE_PEAK_TFLOPS", "bogus")
+        assert device_peak_tflops() > 0  # falls through, never raises
+
+
+# ---------------------------------------------------------------------------
+# fused-segment compile telemetry + per-request attribution (end to end)
+# ---------------------------------------------------------------------------
+
+class TestFusedCostTelemetry:
+    def _engine(self, plane):
+        return GraphEngine(MLP_SPEC, resolver=resolver(), name="prof",
+                           plan_mode="fused", profiler=plane)
+
+    def test_segment_compile_lands_in_the_watch(self):
+        plane = ProfilePlane(ProfileConfig(enabled=True),
+                             deployment="prof")
+        eng = self._engine(plane)
+        try:
+            msg = SeldonMessage.from_ndarray(
+                np.zeros((1, 784), np.float32))
+            out = asyncio.run(eng.predict(msg))
+            assert out.status is None or out.status.status == "SUCCESS"
+            snap = plane.compile.snapshot()
+            seg = snap["segments"]["m"]
+            assert seg["compiles"] == 1
+            assert seg["wallMsTotal"] > 0
+            bucket = seg["buckets"]["1x784:float32"]
+            assert bucket["flops"] > 0
+            assert bucket["bytes_accessed"] > 0
+            # repeat shape: served from the AOT executable, no recompile
+            asyncio.run(eng.predict(msg))
+            assert plane.compile.snapshot()["segments"]["m"][
+                "compiles"] == 1
+        finally:
+            asyncio.run(plane.aclose())
+
+    def test_per_request_attribution_matches_bucket_cost(self):
+        plane = ProfilePlane(ProfileConfig(enabled=True),
+                             deployment="prof")
+        eng = self._engine(plane)
+        try:
+            msg = SeldonMessage.from_ndarray(
+                np.zeros((2, 784), np.float32))
+            asyncio.run(eng.predict(msg))
+            bucket = eng.plan.segments[0].cost_by_bucket[
+                ((2, 784), "float32")]
+            with plane.attribution._lock:
+                flops = [f for _, f in plane.attribution._requests]
+            assert len(flops) == 1
+            assert flops[0] == pytest.approx(bucket["flops"])
+        finally:
+            asyncio.run(plane.aclose())
+
+    def test_cost_for_rows_bucket_ranking(self):
+        class Stub:
+            cost_by_bucket = {
+                ((4, 8), "float32"): {"flops": 400.0,
+                                      "bytes_accessed": 40.0},
+                ((8, 8), "float32"): {"flops": 800.0,
+                                      "bytes_accessed": 80.0},
+                ((0,), "float32"): {"flops": 0.0},  # no cost data: skipped
+            }
+
+        from seldon_core_tpu.graph.plan import FusedSegment
+
+        cost = FusedSegment.cost_for_rows
+        # exact bucket
+        assert cost(Stub(), 4) == {"flops": 400.0, "hbm_bytes": 40.0}
+        # smallest covering bucket: 6 rows -> bucket 8, 6/8 share
+        assert cost(Stub(), 6)["flops"] == pytest.approx(600.0)
+        # beyond every bucket: largest scales up
+        assert cost(Stub(), 16)["flops"] == pytest.approx(1600.0)
+        # no usable buckets -> None
+        class Empty:
+            cost_by_bucket = {}
+
+        assert cost(Empty(), 1) is None
+
+    def test_coalesced_shares_sum_to_bucket_total(self):
+        from seldon_core_tpu.runtime.batcher import BatcherConfig
+
+        plane = ProfilePlane(ProfileConfig(enabled=True),
+                             deployment="prof")
+        eng = GraphEngine(
+            MLP_SPEC, resolver=resolver(), name="prof",
+            plan_mode="fused",
+            plan_batcher=BatcherConfig(max_batch_size=2, max_delay_ms=20.0,
+                                       buckets=[2]),
+            profiler=plane)
+        try:
+            msg = SeldonMessage.from_ndarray(
+                np.zeros((1, 784), np.float32))
+
+            async def two():
+                return await asyncio.gather(eng.predict(msg),
+                                            eng.predict(msg))
+
+            asyncio.run(two())
+            bucket = eng.plan.segments[0].cost_by_bucket[
+                ((2, 784), "float32")]
+            with plane.attribution._lock:
+                flops = [f for _, f in plane.attribution._requests]
+            assert len(flops) == 2
+            assert sum(flops) == pytest.approx(bucket["flops"])
+        finally:
+            asyncio.run(plane.aclose())
+
+
+# ---------------------------------------------------------------------------
+# plane + admin bodies
+# ---------------------------------------------------------------------------
+
+class TestAdminBodies:
+    def _plane(self, **kw):
+        cfg = ProfileConfig(enabled=True, hz=1000.0,
+                            **{k: v for k, v in kw.items()})
+        return ProfilePlane(cfg, service="engine", deployment="d")
+
+    def test_disabled_plane_404s_everywhere(self):
+        from seldon_core_tpu.profiling.http import (
+            capacity_body,
+            capture_body,
+            compile_body,
+            profile_body,
+        )
+
+        for body in (profile_body, capture_body, compile_body,
+                     capacity_body):
+            status, payload = body(None, {})
+            assert status == 404
+            assert "seldon.io/profile" in payload["hint"]
+
+    def test_profile_body_renders_and_resets(self):
+        from seldon_core_tpu.profiling.http import profile_body
+
+        plane = self._plane()
+        try:
+            stop = threading.Event()
+            t = threading.Thread(target=lambda: _spin_until(stop))
+            t.start()
+            try:
+                for _ in range(5):
+                    plane.sampler.sample_once()
+            finally:
+                stop.set()
+                t.join()
+            status, out = profile_body(plane, {"n": "3"})
+            assert status == 200
+            assert out["service"] == "engine"
+            assert len(out["folded"].splitlines()) <= 3
+            status, out = profile_body(plane, {"reset": "1"})
+            assert out["reset"] is True
+            assert plane.sampler.folded() == {}
+        finally:
+            asyncio.run(plane.aclose())
+
+    def test_capture_body_lifecycle(self):
+        from seldon_core_tpu.profiling.http import capture_body
+
+        plane = self._plane(window_s=30.0)
+        try:
+            status, payload = capture_body(plane, {"seconds": "60"})
+            assert status == 400
+            assert "profile-window-s" in payload["error"]
+            status, w = capture_body(plane, {"seconds": "20"})
+            assert status == 200 and w["id"]
+            status, r = capture_body(plane, {"id": w["id"], "stop": "1"})
+            assert status == 200 and r["done"]
+            status, payload = capture_body(plane, {"id": "w999"})
+            assert status == 404
+        finally:
+            asyncio.run(plane.aclose())
+
+    def test_capture_body_window_cap_429s(self):
+        from seldon_core_tpu.profiling.http import capture_body
+
+        plane = self._plane()
+        try:
+            for _ in range(8):
+                status, _w = capture_body(plane, {"seconds": "20"})
+                assert status == 200
+            status, payload = capture_body(plane, {"seconds": "20"})
+            assert status == 429
+            assert "concurrent" in payload["error"]
+        finally:
+            asyncio.run(plane.aclose())
+
+    def test_compile_and_capacity_bodies(self):
+        from seldon_core_tpu.profiling.http import (
+            capacity_body,
+            compile_body,
+        )
+
+        plane = self._plane()
+        plane.compile.note_compile("seg", bucket="1x4:f32", wall_ms=3.0,
+                                   flops=1e6)
+        status, out = compile_body(plane, {})
+        assert status == 200
+        assert out["service"] == "engine"
+        assert out["segments"]["seg"]["compiles"] == 1
+        status, out = capacity_body(plane, {})
+        assert status == 200
+        assert out["devicePeakTflops"] > 0
+
+    def test_plane_snapshot_posture(self):
+        plane = self._plane()
+        try:
+            snap = plane.snapshot()
+            assert snap["service"] == "engine"
+            assert snap["hz"] == 1000.0
+            assert snap["storm"] == []
+            assert {"sampler", "compile", "attribution"} <= set(snap)
+        finally:
+            asyncio.run(plane.aclose())
+
+
+# ---------------------------------------------------------------------------
+# health-verdict fusion
+# ---------------------------------------------------------------------------
+
+class TestStormVerdict:
+    def test_recompile_storm_degrades_health_verdict(self):
+        from seldon_core_tpu.health import HealthConfig, HealthPlane
+
+        clock = FakeClock()
+        health = HealthPlane(HealthConfig(enabled=True), service="engine")
+        plane = ProfilePlane(ProfileConfig(enabled=True, storm=2),
+                             clock=clock)
+        health.profiler = plane
+        before = health.verdict()
+        assert "recompile-storm" not in before.get("signals", [])
+        plane.compile.note_compile("seg", bucket="a")
+        plane.compile.note_compile("seg", bucket="b")
+        out = health.verdict()
+        assert "recompile-storm" in out["signals"]
+        assert out["verdict"] in ("warn", "critical")
+        assert out["recompileStorm"] == ["seg"]
+        # churn ages out -> the signal clears on its own
+        clock.t += 120.0
+        after = health.verdict()
+        assert "recompile-storm" not in after.get("signals", [])
+
+
+# ---------------------------------------------------------------------------
+# profview
+# ---------------------------------------------------------------------------
+
+class TestProfview:
+    FOLDED = ("thread:MainThread;task:serve;app:handle;model:predict 80\n"
+              "thread:MainThread;task:flush;batcher:flush 15\n"
+              "thread:sampler;introspect:sample 5\n")
+
+    def test_parse_raw_and_admin_json(self):
+        from seldon_core_tpu.tools.profview import parse_collapsed
+
+        raw = parse_collapsed(self.FOLDED)
+        assert raw["thread:MainThread;task:serve;app:handle;"
+                   "model:predict"] == 80
+        body = json.dumps({"service": "engine", "stats": {},
+                           "folded": self.FOLDED})
+        assert parse_collapsed(body) == raw
+        # garbage lines are skipped, duplicate stacks accumulate
+        assert parse_collapsed("a;b 2\nnot-a-count x\na;b 3") == {"a;b": 5}
+
+    def test_render_flame_tree(self):
+        from seldon_core_tpu.tools.profview import (
+            parse_collapsed,
+            render_flame,
+        )
+
+        text = render_flame(parse_collapsed(self.FOLDED), width=100)
+        lines = text.splitlines()
+        assert "100 samples" in lines[0]
+        assert any("model:predict" in ln and "80.0%" in ln
+                   for ln in lines)
+        # children indent under their parent, hottest subtree first
+        i_thread = next(i for i, ln in enumerate(lines)
+                        if ln.lstrip().startswith("thread:MainThread"))
+        i_serve = next(i for i, ln in enumerate(lines)
+                       if "task:serve" in ln)
+        assert i_serve == i_thread + 1
+        assert render_flame({}) == "empty profile (0 samples)"
+
+    def test_min_pct_prunes_cold_frames(self):
+        from seldon_core_tpu.tools.profview import (
+            parse_collapsed,
+            render_flame,
+        )
+
+        text = render_flame(parse_collapsed(self.FOLDED), min_pct=10.0)
+        assert "introspect:sample" not in text
+        assert "model:predict" in text
+
+    def test_frame_totals_dedupe_recursion(self):
+        from seldon_core_tpu.tools.profview import frame_totals
+
+        totals = frame_totals({"t:a;f;g;f 10": 0} | {"t:a;f;g;f": 10})
+        assert totals["f"] == 10  # counted once despite recursion
+
+    def test_diff_on_shares_not_counts(self):
+        from seldon_core_tpu.tools.profview import (
+            diff_profiles,
+            render_diff,
+        )
+
+        before = {"t;hot": 50, "t;cold": 50}
+        after = {"t;hot": 150, "t;cold": 50}  # longer window, hot grew
+        rows = {f: (b, a, d) for f, b, a, d in
+                diff_profiles(before, after)}
+        assert rows["hot"][2] == pytest.approx(25.0)
+        assert rows["cold"][2] == pytest.approx(-25.0)
+        text = render_diff(before, after)
+        assert "+25.0%" in text and "-25.0%" in text
+
+    def test_cli_render_and_diff(self, tmp_path, capsys):
+        from seldon_core_tpu.tools.profview import main
+
+        p = tmp_path / "prof.txt"
+        p.write_text(self.FOLDED)
+        assert main([str(p)]) == 0
+        assert "model:predict" in capsys.readouterr().out
+        q = tmp_path / "after.json"
+        q.write_text(json.dumps({"folded": self.FOLDED.replace("80",
+                                                               "20")}))
+        assert main(["--diff", str(p), str(q)]) == 0
+        assert "model:predict" in capsys.readouterr().out
+        empty = tmp_path / "empty.txt"
+        empty.write_text("")
+        assert main([str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# graphlint GL11xx + admission
+# ---------------------------------------------------------------------------
+
+class TestGraphlintProfile:
+    GRAPH = {"name": "m", "type": "MODEL",
+             "implementation": "SIMPLE_MODEL"}
+
+    def _codes(self, ann):
+        from seldon_core_tpu.analysis.graphlint import lint_graph
+
+        return {f.code: f for f in lint_graph(self.GRAPH, ann)
+                if f.code.startswith("GL11")}
+
+    def test_report_when_enabled(self):
+        found = self._codes({"seldon.io/profile": "true",
+                             "seldon.io/profile-hz": "97"})
+        assert set(found) == {"GL1103"}
+        assert found["GL1103"].severity == "INFO"
+        assert "97Hz" in found["GL1103"].message
+
+    def test_invalid_value_errors(self):
+        found = self._codes({"seldon.io/profile-hz": "-1"})
+        assert set(found) == {"GL1101"}
+        assert found["GL1101"].severity == "ERROR"
+
+    def test_knobs_without_enable_warns(self):
+        found = self._codes({"seldon.io/profile-storm": "8"})
+        assert set(found) == {"GL1102"}
+        assert found["GL1102"].severity == "WARN"
+
+    def test_silent_when_family_absent(self):
+        assert self._codes({}) == {}
+
+    def test_admission_rejects_invalid(self):
+        from seldon_core_tpu.operator.compile import profile_config
+        from seldon_core_tpu.operator.spec import (
+            DeploymentValidationError,
+            SeldonDeployment,
+        )
+
+        dep = SeldonDeployment.from_dict({
+            "apiVersion": "machinelearning.seldon.io/v1alpha2",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "iris-prof"},
+            "spec": {
+                "name": "iris-prof",
+                "predictors": [{
+                    "name": "main",
+                    "replicas": 1,
+                    "graph": {"name": "classifier", "type": "MODEL",
+                              "implementation": "SIMPLE_MODEL"},
+                }],
+            },
+        })
+        dep.annotations["seldon.io/profile-window-s"] = "0"
+        with pytest.raises(DeploymentValidationError) as ei:
+            profile_config(dep, dep.predictors[0])
+        assert "profile-window-s" in str(ei.value)
