@@ -1556,6 +1556,191 @@ def profile_smoke() -> int:
     return 1 if failures else 0
 
 
+def _shard_bench_deployment(name: str, extra_ann: dict):
+    """A single-node IrisClassifier LocalDeployment (the canonical
+    batch-invariant pure fn — XLA CPU matmul numerics for its K=4
+    contraction do not depend on batch size, so dp-sharded outputs are
+    bitwise equal to the unsharded program; docs/sharding.md)."""
+    from seldon_core_tpu.operator.local import LocalDeployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    dep = SeldonDeployment.from_dict({
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "annotations": {
+            "seldon.io/batching": "false",
+            **extra_ann,
+        }},
+        "spec": {"predictors": [{
+            "name": "p", "replicas": 1,
+            "graph": {
+                "name": "clf", "type": "MODEL",
+                "parameters": [{
+                    "name": "model_class",
+                    "value": "seldon_core_tpu.models.iris:IrisClassifier",
+                    "type": "STRING",
+                }],
+                "children": [],
+            },
+            "componentSpecs": [],
+        }]},
+    })
+    return LocalDeployment(dep)
+
+
+def shard_smoke() -> int:
+    """Fast CI gate (8 forced host devices): with seldon.io/mesh dp=4 a
+    fused-plan prediction must execute as ONE sharded dispatch whose
+    response bytes equal both the walk-mode and the unsharded fused-mode
+    responses; /admin/placement must report every segment placed; an
+    infeasible mesh (dp=16 on 8 devices) must be rejected at admission
+    by GL1202.  Returns a process exit code."""
+    import numpy as np
+
+    import jax
+
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.placement.http import placement_body
+
+    failures = []
+    report: dict = {}
+    n_dev = jax.device_count()
+    report["devices"] = n_dev
+    if n_dev < 8:
+        print(json.dumps({"shard_smoke": report, "failures": [
+            f"need 8 host devices (XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8), got {n_dev}"]}))
+        return 1
+
+    sharded = _shard_bench_deployment("shard-smoke", {
+        "seldon.io/graph-plan": "fused", "seldon.io/mesh": "dp=4"})
+    fused = _shard_bench_deployment("shard-smoke-fused", {
+        "seldon.io/graph-plan": "fused"})
+    walk = _shard_bench_deployment("shard-smoke-walk", {})
+
+    plane = sharded.placement
+    seg = sharded.predictors[0].engine.plan.segments[0]
+    report["mesh"] = plane.mesh_shape()
+    report["shard_parity"] = seg.shard_parity
+    if plane.sharded_segments != [seg.name]:
+        failures.append(f"segment {seg.name!r} did not arm sharding "
+                        f"(sharded: {plane.sharded_segments})")
+    if seg.shard_parity != "verified":
+        failures.append(f"arm-time parity probe: {seg.shard_parity!r}, "
+                        "expected 'verified'")
+
+    # -- one 64-row prediction: exactly ONE sharded dispatch ------------
+    x = np.random.RandomState(0).uniform(size=(64, 4)).astype("float32")
+
+    def msg():
+        m = SeldonMessage.from_ndarray(x)
+        m.meta.puid = "shard-smoke"  # response echoes the request puid
+        return m
+    n0, s0 = seg.n_calls, seg.n_sharded_calls
+    a = sharded.predictors[0].engine.predict_sync(msg())
+    report["dispatches"] = seg.n_calls - n0
+    report["sharded_dispatches"] = seg.n_sharded_calls - s0
+    if seg.n_calls - n0 != 1 or seg.n_sharded_calls - s0 != 1:
+        failures.append(
+            f"64 rows over dp=4 issued {seg.n_calls - n0} dispatch(es), "
+            f"{seg.n_sharded_calls - s0} sharded — expected exactly 1 "
+            "sharded dispatch")
+    bucket = next(iter(seg.shard_cost_by_bucket.values()), {})
+    if bucket.get("parity") != "verified":
+        failures.append(f"bucket parity gate: {bucket.get('parity')!r}, "
+                        "expected 'verified'")
+
+    # -- byte parity: walk == fused == sharded ---------------------------
+    b = fused.predictors[0].engine.predict_sync(msg())
+    c = walk.predictors[0].engine.predict_sync(msg())
+    parity = a.to_dict() == b.to_dict() == c.to_dict()
+    report["parity"] = parity
+    if not parity:
+        failures.append("sharded response != unsharded fused / walk "
+                        "response (byte parity broken)")
+
+    # -- /admin/placement: every segment placed --------------------------
+    status, payload = placement_body(plane, {})
+    segs = {s["segment"]: s["devices"] for s in payload.get("segments", [])}
+    report["placement"] = {"status": status, "segments": segs}
+    if status != 200:
+        failures.append(f"/admin/placement answered {status}")
+    elif set(segs) != {s.name for s in
+                       sharded.predictors[0].engine.plan.segments}:
+        failures.append(f"/admin/placement is missing segments: {segs}")
+    elif not all(segs.values()):
+        failures.append(f"segment with no device assignment: {segs}")
+
+    # -- admission: dp=16 on 8 devices rejects with GL1202 ---------------
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    fs = lint_graph(
+        {"name": "clf", "type": "MODEL", "parameters": [{
+            "name": "model_class",
+            "value": "seldon_core_tpu.models.iris:IrisClassifier",
+            "type": "STRING"}], "children": []},
+        {"seldon.io/graph-plan": "fused", "seldon.io/mesh": "dp=16"},
+    )
+    codes = {f.code for f in fs if f.severity == "ERROR"}
+    report["oversubscribed_codes"] = sorted(codes)
+    if "GL1202" not in codes:
+        failures.append(f"dp=16 on {n_dev} devices must reject with "
+                        f"GL1202, got {sorted(codes)}")
+
+    print(json.dumps({"shard_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
+def bench_sharded_throughput(seconds: float = 2.0) -> dict:
+    """dp=1 vs dp=4 sharded-dispatch microbench on the Iris fused
+    segment (64-row batches).  On forced-host-device CPU the dp=4 path
+    measures sharding MACHINERY overhead, not speedup — the devices are
+    threads of one CPU; on a real multi-chip mesh the same dispatch path
+    splits real HBM and FLOPs."""
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    x = np.random.RandomState(0).uniform(size=(64, 4)).astype("float32")
+
+    def p50_us(ld) -> tuple[float, float]:
+        eng = ld.predictors[0].engine
+        for _ in range(10):
+            eng.predict_sync(SeldonMessage.from_ndarray(x))
+        lat = []
+        t_end = time.perf_counter() + seconds / 2
+        n = 0
+        t_start = time.perf_counter()
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            eng.predict_sync(SeldonMessage.from_ndarray(x))
+            lat.append(time.perf_counter() - t0)
+            n += 1
+        wall = time.perf_counter() - t_start
+        lat.sort()
+        return lat[len(lat) // 2] * 1e6, n / wall if wall else 0.0
+
+    unsharded = _shard_bench_deployment("shard-bench-1", {
+        "seldon.io/graph-plan": "fused"})
+    sharded = _shard_bench_deployment("shard-bench-4", {
+        "seldon.io/graph-plan": "fused", "seldon.io/mesh": "dp=4"})
+    base_p50, base_rps = p50_us(unsharded)
+    shard_p50, shard_rps = p50_us(sharded)
+    seg = sharded.predictors[0].engine.plan.segments[0]
+    return {
+        "batch_rows": 64,
+        "dp1_p50_us": round(base_p50, 1),
+        "dp4_p50_us": round(shard_p50, 1),
+        "dp1_req_per_s": round(base_rps, 1),
+        "dp4_req_per_s": round(shard_rps, 1),
+        "dp4_sharded_dispatches": seg.n_sharded_calls,
+        "shard_parity": seg.shard_parity,
+        # headline keys (tail-safe summary picks these)
+        "sharded_overhead_ratio": round(
+            shard_p50 / base_p50, 3) if base_p50 else None,
+    }
+
+
 RESNET50_GFLOPS = 8.2  # fwd FLOPs per 224x224 image: 4.1 GMACs x 2 FLOPs/MAC
 V5E_PEAK_TFLOPS = 197.0  # bf16 peak, TPU v5e
 
@@ -2869,6 +3054,15 @@ def main() -> None:
                          "executed bucket total, and the host sampler "
                          "stays within the p50 overhead budget; then "
                          "exit")
+    ap.add_argument("--shard-smoke", action="store_true",
+                    help="fast CI gate (XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8): "
+                         "seldon.io/mesh dp=4 serves a 64-row fused-plan "
+                         "prediction as ONE sharded dispatch, "
+                         "byte-identical to walk and unsharded fused "
+                         "modes, /admin/placement reports every segment "
+                         "placed, and dp=16 on 8 devices rejects at "
+                         "admission with GL1202; then exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -2884,6 +3078,8 @@ def main() -> None:
         sys.exit(health_smoke())
     if args.profile_smoke:
         sys.exit(profile_smoke())
+    if args.shard_smoke:
+        sys.exit(shard_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice
@@ -2911,6 +3107,13 @@ def main() -> None:
         extras["qos_overload"] = bench_qos_overload(min(args.seconds, 3.0))
     except Exception as e:
         extras["qos_overload_error"] = f"{type(e).__name__}: {e}"
+    # sharded fused-segment execution (dp=1 vs dp=4; needs forced host
+    # devices on CPU — degrades to an error note otherwise)
+    try:
+        extras["sharded_throughput"] = bench_sharded_throughput(
+            min(args.seconds, 2.0))
+    except Exception as e:
+        extras["sharded_throughput_error"] = f"{type(e).__name__}: {e}"
     # headline wire tier: native servers + Python engine + native loadgen
     try:
         rest = bench_rest_socket_native(args.seconds)

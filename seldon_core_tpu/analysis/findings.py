@@ -27,6 +27,11 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL11xx`` — profiling-plane admission (``seldon.io/profile*``
   annotation validation, knobs set while the plane is off, effective
   sampler/compile-watch report)
+- ``GL12xx`` — placement-plane admission (``seldon.io/mesh`` /
+  ``seldon.io/placement`` annotation validation, mesh oversubscription
+  vs the visible device count, overrides naming unknown segments,
+  per-device HBM feasibility against the GL3xx budget, effective
+  mesh/placement report)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -83,6 +88,12 @@ HEALTH_CONFIG_REPORT = "GL1003"     # health report: effective config
 PROFILE_ANNOTATION_INVALID = "GL1101"  # seldon.io/profile* value invalid
 PROFILE_KNOBS_WITHOUT_PROFILE = "GL1102"  # profile-* knobs set, plane off
 PROFILE_CONFIG_REPORT = "GL1103"    # profile report: effective config
+MESH_ANNOTATION_INVALID = "GL1201"  # seldon.io/mesh / placement value invalid
+MESH_OVERSUBSCRIBED = "GL1202"      # mesh axis product > visible devices
+PLACEMENT_UNKNOWN_SEGMENT = "GL1203"  # override names no fused segment
+PLACEMENT_HBM_INFEASIBLE = "GL1204"  # per-device HBM exceeds the GL3xx budget
+PLACEMENT_CONFIG_REPORT = "GL1205"  # placement report: mesh + assignments
+PLACEMENT_WITHOUT_MESH = "GL1206"   # placement overrides set, mesh absent
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -131,6 +142,12 @@ CODE_SEVERITY = {
     PROFILE_ANNOTATION_INVALID: ERROR,
     PROFILE_KNOBS_WITHOUT_PROFILE: WARN,
     PROFILE_CONFIG_REPORT: INFO,
+    MESH_ANNOTATION_INVALID: ERROR,
+    MESH_OVERSUBSCRIBED: ERROR,
+    PLACEMENT_UNKNOWN_SEGMENT: ERROR,
+    PLACEMENT_HBM_INFEASIBLE: ERROR,
+    PLACEMENT_CONFIG_REPORT: INFO,
+    PLACEMENT_WITHOUT_MESH: WARN,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

@@ -45,7 +45,13 @@ from seldon_core_tpu.analysis.findings import (
     HEALTH_CONFIG_REPORT,
     HEALTH_KNOBS_WITHOUT_HEALTH,
     IMPL_TYPE_MISMATCH,
+    MESH_ANNOTATION_INVALID,
+    MESH_OVERSUBSCRIBED,
     METHOD_TYPE_MISMATCH,
+    PLACEMENT_CONFIG_REPORT,
+    PLACEMENT_HBM_INFEASIBLE,
+    PLACEMENT_UNKNOWN_SEGMENT,
+    PLACEMENT_WITHOUT_MESH,
     PLAN_MODE_INVALID,
     PLAN_NODE_BOUNDARY,
     PLAN_NOTHING_FUSED,
@@ -175,6 +181,7 @@ def lint_graph(
         findings.extend(_trace_pass(unit, ann, path_prefix))
         findings.extend(_health_pass(unit, ann, path_prefix))
         findings.extend(_profile_pass(unit, ann, path_prefix))
+        findings.extend(_placement_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -1003,6 +1010,178 @@ def _profile_pass(root: PredictiveUnit, ann: dict,
               f"{cfg.window_s:g}s); recompile storm at "
               f">= {cfg.storm} compiles/segment/min")
     return [make_finding(PROFILE_CONFIG_REPORT, path0, detail)]
+
+
+def _static_segments(root: PredictiveUnit) -> list[list[PredictiveUnit]]:
+    """The fused segments the plan compiler will form, derived from the
+    spec exactly as :func:`_plan_pass` derives them (whole fusible
+    subtrees, else maximal MODEL/TRANSFORMER chains).  A segment's name
+    at runtime is its first member's node name — placement overrides
+    reference these."""
+    segments: list[list[PredictiveUnit]] = []
+
+    def subtree_fusible(u: PredictiveUnit) -> bool:
+        if _plan_boundary_reason(u) is not None:
+            return False
+        if u.resolved_type == "COMBINER" and not u.children:
+            return False
+        return all(subtree_fusible(c) for c in u.children)
+
+    def visit(u: PredictiveUnit) -> None:
+        if subtree_fusible(u):
+            segments.append(list(u.walk()))
+            return
+        run: list[PredictiveUnit] = []
+        cur = u
+        while (cur.resolved_type in ("MODEL", "TRANSFORMER")
+               and len(cur.children) == 1
+               and _plan_boundary_reason(cur) is None):
+            run.append(cur)
+            cur = cur.children[0]
+        if run:
+            segments.append(run)
+            visit(cur)
+            return
+        for c in u.children:
+            visit(c)
+
+    visit(root)
+    return segments
+
+
+def _visible_devices() -> int:
+    """Device count, but ONLY when jax is already loaded in this process
+    (the operator and runtimes always have it; a spec-only lint run must
+    not pay the import).  0 → the oversubscription check is skipped."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        return int(jax.device_count())
+    except Exception:
+        return 0
+
+
+def _placement_pass(root: PredictiveUnit, ann: dict,
+                    prefix: str) -> list[Finding]:
+    """Placement-plane admission (GL12xx, active when ``seldon.io/mesh``
+    or ``seldon.io/placement`` is set): validates both annotations
+    through the same parser the operator and runtimes use (GL1201),
+    rejects meshes whose axis product exceeds the visible device
+    inventory (GL1202 — ``dp=16`` on 8 devices fails here, not at the
+    first sharded dispatch), rejects overrides naming segments the plan
+    compiler will not form (GL1203), proves per-device HBM feasibility
+    against the GL3xx budget split across the mesh (GL1204), warns when
+    overrides are set without a mesh (GL1206), and reports the effective
+    mesh + assignments (GL1205)."""
+    from seldon_core_tpu.placement.config import (
+        MESH_ANNOTATION,
+        PLACEMENT_ANNOTATION,
+        placement_config_from_annotations,
+    )
+
+    family = {MESH_ANNOTATION, PLACEMENT_ANNOTATION}
+    placement_keys = [k for k in ann if k in family]
+    if not placement_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = placement_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(MESH_ANNOTATION_INVALID, path0, str(e))]
+    if not cfg.enabled:
+        if cfg.overrides:
+            return [make_finding(
+                PLACEMENT_WITHOUT_MESH, path0,
+                f"{PLACEMENT_ANNOTATION} set but {MESH_ANNOTATION} is "
+                "absent — without a mesh there is no placement plane and "
+                "the pins have no effect",
+            )]
+        return []
+    findings: list[Finding] = []
+    visible = _visible_devices()
+    if visible and cfg.n_devices > visible:
+        findings.append(make_finding(
+            MESH_OVERSUBSCRIBED, path0,
+            f"{MESH_ANNOTATION}={cfg.spec()!r} wants {cfg.n_devices} "
+            f"device(s) but only {visible} are visible — the runtime "
+            "would fail to build the mesh",
+        ))
+    mode = str(ann.get(PLAN_ANNOTATION, "walk")).strip().lower()
+    segments = _static_segments(root) if mode == "fused" else []
+    seg_names = [seg[0].name for seg in segments]
+    if mode == "fused":
+        for seg_name in cfg.override_map():
+            if seg_name not in seg_names:
+                known = ", ".join(seg_names) or "none"
+                findings.append(make_finding(
+                    PLACEMENT_UNKNOWN_SEGMENT, path0,
+                    f"{PLACEMENT_ANNOTATION} pins segment {seg_name!r} "
+                    "but the plan compiler will not form a segment with "
+                    f"that root (segments: {known})",
+                ))
+    # per-device HBM feasibility: the GL3xx slice budget divided across
+    # the mesh must hold the planner's worst-loaded device
+    budget_gb = _num(ann.get(HBM_BUDGET_ANNOTATION))
+    if budget_gb is None:
+        chips = _num(ann.get(CHIPS_ANNOTATION))
+        budget_gb = chips * HBM_PER_CHIP_GB if chips and chips > 0 else None
+    if budget_gb is not None and budget_gb > 0 and segments:
+        from seldon_core_tpu.placement.planner import (
+            SegmentFacts,
+            plan_placement,
+        )
+
+        facts = []
+        for seg in segments:
+            hbm = 0
+            shardable = True
+            for u in seg:
+                sig, _ = _node_signature(u)
+                if sig is None:
+                    shardable = False
+                    continue
+                hbm += sig.hbm_bytes
+                if not sig.batch_shardable:
+                    shardable = False
+            facts.append(SegmentFacts(
+                name=seg[0].name, hbm_bytes=hbm, measured_hbm_bytes=0,
+                shardable=shardable and cfg.dp > 1,
+                members=tuple(sorted(u.name for u in seg)),
+            ))
+        per_device = budget_gb * (1 << 30) / cfg.n_devices
+        plan = plan_placement(
+            facts, n_devices=cfg.n_devices, dp=cfg.dp,
+            mesh_spec=cfg.spec(),
+            overrides={k: min(v, cfg.n_devices - 1)
+                       for k, v in cfg.override_map().items()},
+            capacity_bytes=int(per_device),
+        )
+        if plan.over_capacity:
+            worst = max(plan.device_hbm_bytes.values(), default=0)
+            findings.append(make_finding(
+                PLACEMENT_HBM_INFEASIBLE, path0,
+                f"worst-loaded device holds {worst / (1 << 30):.2f} GiB "
+                f"of weights but the {budget_gb:g} GiB slice budget "
+                f"leaves only {per_device / (1 << 30):.2f} GiB per "
+                f"device across {cfg.n_devices} device(s) "
+                f"(over-capacity devices: "
+                f"{', '.join(str(d) for d in plan.over_capacity)})",
+            ))
+    detail = f"placement plane on: mesh {cfg.spec()!r} over {cfg.n_devices} device(s)"
+    if cfg.override_map():
+        pins = ", ".join(f"{s}->{d}" for s, d in
+                         sorted(cfg.override_map().items()))
+        detail += f"; pinned: {pins}"
+    if mode == "fused":
+        detail += f"; {len(segments)} fused segment(s) to place"
+    else:
+        detail += ("; graph-plan is not 'fused' — no segments to place "
+                   "until it is")
+    findings.append(make_finding(PLACEMENT_CONFIG_REPORT, path0, detail))
+    return findings
 
 
 def _join(prefix: str, name: str) -> str:

@@ -78,6 +78,7 @@ class Gateway:
         tracer: Optional[Tracer] = None,
         health=None,
         profiler=None,
+        placement=None,
     ):
         self.store = store
         # SELDON_TOKEN_SIGNING_KEY (chart Secret) selects stateless signed
@@ -176,6 +177,13 @@ class Gateway:
             if pcfg is not None and pcfg.enabled:
                 self.profiler = ProfilePlane(pcfg, metrics=self.registry,
                                              service="gateway")
+        # Placement plane (docs/sharding.md): meshes live in the ENGINE
+        # runtimes — the gateway only forwards — so no plane is built
+        # here; a colocated dev harness may hand one in so /admin/placement
+        # answers from the gateway too.  Without one the endpoint returns
+        # 404 + the enablement hint (and ?meshes still reports the
+        # process-wide mesh registry via the engine surface).
+        self.placement = placement
         if self.health is not None:
             from seldon_core_tpu.health import (
                 device_memory_probe,
@@ -268,6 +276,7 @@ class Gateway:
                            self._handle_profile_compile)
         app.router.add_get("/admin/profile/capacity",
                            self._handle_profile_capacity)
+        app.router.add_get("/admin/placement", self._handle_placement)
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -853,6 +862,17 @@ class Gateway:
         from seldon_core_tpu.profiling.http import capacity_body
 
         return await self._handle_profile_endpoint(request, capacity_body)
+
+    async def _handle_placement(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.placement.http import placement_body
+
+        try:
+            status, payload = placement_body(self.placement, request.query)
+        except ValueError:
+            return web.json_response(
+                {"error": "numeric query parameter expected"}, status=400
+            )
+        return web.json_response(payload, status=status)
 
     # ------------------------------------------------------------------
     # gRPC front (Seldon service, forwards to engine gRPC)

@@ -87,6 +87,7 @@ class GraphEngine:
         qos: Optional[Any] = None,
         health: Optional[Any] = None,
         profiler: Optional[Any] = None,
+        placement: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -183,6 +184,15 @@ class GraphEngine:
         if profiler is not None and self.plan is not None:
             for seg in self.plan.segments:
                 seg.compile_watch = profiler.compile
+        # placement plane (placement/, docs/sharding.md): owns the device
+        # mesh and the segment→device plan; attaching the compiled plan
+        # arms the sharded executor (dp batch splitting) on every segment
+        # that passes the shardability gate and the byte-parity probe.
+        # Wired AFTER compile_watch so sharded-bucket compiles also land
+        # on the ledger.
+        self.placement = placement
+        if placement is not None and self.plan is not None:
+            placement.attach_plan(self.plan)
         self._fallback_node: Optional[_Node] = None
         if qos is not None and qos.config.fallback_node:
             node = self._nodes.get(qos.config.fallback_node)
@@ -645,6 +655,11 @@ class GraphEngine:
                 "degraded": meta.tags.get(DEGRADED_TAG, False),
                 "mode": "fused" if self.plan is not None else "walk",
             }
+            if self.placement is not None:
+                # placement plane on: flight records carry the mesh shape
+                # so an operator reading one record knows the topology
+                # that served it
+                flags["mesh"] = self.placement.mesh_shape()
             if meta.routing:
                 flags["routing"] = dict(meta.routing)
             if cost is not None and cost["flops"] > 0:
@@ -866,6 +881,11 @@ class GraphEngine:
                         s.name for s in getattr(seg, "members", ())
                     ),
                 )
+                if self.placement is not None:
+                    sp.attributes.update(
+                        mesh=self.placement.mesh_shape(),
+                        sharded=getattr(seg, "shard_rows", 1) > 1,
+                    )
             if self.profiler is not None:
                 # per-request cost attribution: this request's rows x the
                 # executed bucket's per-row cost_analysis cost — shares

@@ -305,6 +305,17 @@ class FusedSegment:
         self._compile_lock = threading.Lock()
         self.cost_by_bucket: dict = {}
         self._names_cache: dict = {}
+        # sharded executor (placement plane, enable_sharding): a second
+        # jitted callable whose in/out shardings split the batch dim over
+        # the mesh's dp axis — one dispatch spanning every dp device
+        self._shard_fn = None
+        self._shard_mesh = None
+        self.shard_rows = 1          # batch must be a multiple of this
+        self.n_sharded_calls = 0
+        self._shard_compiled: dict = {}
+        self.shard_cost_by_bucket: dict = {}
+        self._on_sharded_dispatch = None
+        self.shard_parity = None     # "verified" | "unprobed" | "failed"
         # prediction-cache eligibility: every member is a pure tensor fn by
         # construction, so the segment caches unless a member opted out or
         # declared itself non-deterministic (graph/engine.py consults this)
@@ -368,9 +379,192 @@ class FusedSegment:
             return self._fence(st.fn(p, merged))
         return merged
 
+    # -- sharded execution (placement plane) -----------------------------
+    def enable_sharding(self, mesh, on_dispatch=None,
+                        tp_param_specs=None, probe=None) -> bool:
+        """Arm the sharded executor on ``mesh``.
+
+        Builds ``jax.jit(self._traced)`` with ``in_shardings`` splitting
+        the batch (leading) dim over the mesh's ``dp`` axis and
+        replicating params, and ``out_shardings`` matching — the whole
+        segment then runs as ONE SPMD dispatch spanning every dp device.
+        The trace is the SAME ``_traced`` (same per-stage
+        ``optimization_barrier`` fences) and dp splits rows only.
+
+        Rows-only splitting is necessary but NOT sufficient for byte
+        parity: backends tile a matmul differently for different batch
+        shapes, so a per-device N/dp-row program can differ from the
+        N-row program in the last ULP.  When ``probe`` (an example
+        batch, rows divisible by dp) is given, sharded and unsharded
+        executables run it and must agree BITWISE — a mismatch disarms
+        sharding and returns False, so a segment only ever shards when
+        the walk↔fused↔sharded parity contract actually holds on this
+        backend (``shard_parity`` records the outcome).
+
+        ``tp_param_specs`` optionally maps member name → {param key →
+        axis tuple} (from the signature registry's ``tp_param_specs``)
+        to shard large weights over the ``tp`` axis instead of
+        replicating them.  Returns False when jax's sharding API is
+        unavailable or the mesh has no usable dp axis.
+        """
+        try:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+        except Exception:
+            return False
+        dp = int(dict(mesh.shape).get("dp", 1))
+        if dp < 2:
+            return False
+        tp = int(dict(mesh.shape).get("tp", 1))
+        repl = NamedSharding(mesh, PartitionSpec())
+        params_shardings: dict = {}
+        for st in self.members:
+            spec_map = (tp_param_specs or {}).get(st.name) if tp > 1 else None
+            if spec_map and isinstance(st.params, dict):
+                params_shardings[st.name] = {
+                    k: (NamedSharding(mesh, PartitionSpec(*spec_map[k]))
+                        if k in spec_map else repl)
+                    for k in st.params
+                }
+            else:
+                params_shardings[st.name] = repl
+        rows = NamedSharding(mesh, PartitionSpec("dp"))
+        self._shard_fn = jax.jit(self._traced,
+                                 in_shardings=(params_shardings, rows),
+                                 out_shardings=rows)
+        self._shard_mesh = mesh
+        self.shard_rows = dp
+        self._on_sharded_dispatch = on_dispatch
+        self._shard_compiled = {}
+        if probe is None:
+            self.shard_parity = "unprobed"
+            return True
+        if self._probe_parity(probe):
+            self.shard_parity = "verified"
+            return True
+        self._shard_fn = None
+        self._shard_mesh = None
+        self.shard_rows = 1
+        self._on_sharded_dispatch = None
+        self.shard_parity = "failed"
+        return False
+
+    def _probe_parity(self, probe) -> bool:
+        """Bitwise-compare sharded vs unsharded execution of ``probe``."""
+        import numpy as np
+
+        try:
+            ref = np.asarray(self._fn(self._params, probe))
+            got = np.asarray(self._shard_fn(self._params, probe))
+        except Exception:
+            logger.debug("segment %s: sharding parity probe errored",
+                         self.label, exc_info=True)
+            return False
+        return ref.dtype == got.dtype and ref.shape == got.shape \
+            and np.array_equal(ref, got, equal_nan=True)
+
+    def _compile_shard_bucket(self, key: tuple, x):
+        """First sharded dispatch of a shape bucket: AOT-compile the
+        sharded executable (mirror of ``_compile_bucket``; the ledger and
+        CompileWatch rows carry a ``@dp`` label so attribution can tell
+        the two programs apart), then run the **bucket parity gate** —
+        the live input goes through BOTH executables and the outputs must
+        agree bitwise.  Backend tiling is shape-dependent, so the
+        arm-time probe cannot vouch for every batch size; this gate can:
+        a bucket whose sharded program diverges in even one ULP is
+        permanently routed to the unsharded executable (``None`` in the
+        bucket map), and a bucket that passed serves sharded knowing its
+        program is bitwise-equivalent.  Costs one extra dispatch per
+        bucket, once."""
+        with self._compile_lock:
+            hit = self._shard_compiled.get(key, _UNCOMPILED)
+            if hit is not _UNCOMPILED:
+                return hit
+            t0 = time.perf_counter()
+            compiled = None
+            cost: dict = {}
+            try:
+                compiled = self._shard_fn.lower(self._params, x).compile()
+                cost = _cost_summary(compiled)
+            except Exception:
+                logger.debug("segment %s: sharded AOT compile "
+                             "unavailable for bucket %s", self.label, key,
+                             exc_info=True)
+            fn = compiled if compiled is not None else self._shard_fn
+            try:
+                ok = self._bucket_parity(fn, x)
+            except Exception:
+                logger.debug("segment %s: sharded parity gate errored "
+                             "for bucket %s", self.label, key,
+                             exc_info=True)
+                ok = False
+            wall_ms = (time.perf_counter() - t0) * 1000.0
+            cost["compile_ms"] = round(wall_ms, 3)
+            cost["parity"] = "verified" if ok else "failed"
+            self._shard_compiled[key] = fn if ok else None
+            self.shard_cost_by_bucket[key] = cost
+        watch = self.compile_watch
+        if watch is not None:
+            try:
+                shape, dtype = key
+                watch.note_compile(
+                    f"{self.label}@dp{self.shard_rows}",
+                    bucket="x".join(str(d) for d in shape) + f":{dtype}",
+                    wall_ms=wall_ms,
+                    flops=cost.get("flops", 0.0),
+                    bytes_accessed=cost.get("bytes_accessed", 0.0),
+                    peak_hbm_bytes=cost.get("peak_hbm_bytes", 0.0),
+                )
+            except Exception:
+                pass
+        return self._shard_compiled[key]
+
+    def _bucket_parity(self, shard_fn, x) -> bool:
+        import numpy as np
+
+        got = np.asarray(shard_fn(self._params, x))
+        ref = np.asarray(self._fn(self._params, x))
+        return ref.dtype == got.dtype and ref.shape == got.shape \
+            and np.array_equal(ref, got, equal_nan=True)
+
+    def _sharded_call(self, x):
+        """One sharded dispatch, or None when this bucket must serve
+        unsharded (parity gate failed / executable rejected)."""
+        key = self.bucket_key(x)
+        compiled = self._shard_compiled.get(key, _UNCOMPILED)
+        if compiled is _UNCOMPILED:
+            compiled = self._compile_shard_bucket(key, x)
+        if compiled is None:
+            return None
+        try:
+            y = compiled(self._params, x)
+        except Exception:
+            # sharding/layout drift at call time: retire the bucket to
+            # the unsharded path for good — parity over performance
+            self._shard_compiled[key] = None
+            return None
+        self.n_sharded_calls += 1
+        cb = self._on_sharded_dispatch
+        if cb is not None:
+            try:
+                cb(self.name, int(x.shape[0]))
+            except Exception:
+                pass
+        return y
+
     # -- request-time ----------------------------------------------------
     def __call__(self, x):
         self.n_calls += 1
+        if (self._shard_fn is not None
+                and len(getattr(x, "shape", ())) >= 1
+                and x.shape[0] >= self.shard_rows
+                and x.shape[0] % self.shard_rows == 0):
+            # batch divides the dp axis → one sharded dispatch; any other
+            # shape (or a bucket that failed its parity gate) falls
+            # through to the unsharded executable — never an error
+            y = self._sharded_call(x)
+            if y is not None:
+                return y
         key = self.bucket_key(x)
         compiled = self._compiled.get(key, _UNCOMPILED)
         if compiled is _UNCOMPILED:
@@ -511,11 +705,16 @@ class FusedSegment:
         return list(names)
 
     def describe(self) -> dict:
-        return {
+        out = {
             "root": self.name,
             "members": [s.name for s in self.members],
             "n_nodes": len(self.members),
         }
+        if self._shard_fn is not None:
+            out["shardRows"] = self.shard_rows
+        if self.shard_parity is not None:
+            out["shardParity"] = self.shard_parity
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,7 @@ from seldon_core_tpu.health.introspect import (
     device_memory_probe,
     device_registry_probe,
     engine_probe,
+    placement_probe,
     profile_probe,
     qos_probe,
 )
@@ -83,6 +84,7 @@ __all__ = [
     "device_memory_probe",
     "device_registry_probe",
     "engine_probe",
+    "placement_probe",
     "profile_probe",
     "qos_probe",
     "HealthPlane",

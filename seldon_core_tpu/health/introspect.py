@@ -68,6 +68,11 @@ GAUGES = {
     "shed_level": "seldon_runtime_shed_level",
     "device_registry_entries": "seldon_runtime_device_registry_entries",
     "device_registry_bytes": "seldon_runtime_device_registry_bytes",
+    "placement_devices": "seldon_runtime_placement_devices",
+    "placement_segments_sharded": "seldon_runtime_placement_segments_sharded",
+    "placement_sharded_dispatches":
+        "seldon_runtime_placement_sharded_dispatches",
+    "placement_device_bytes_max": "seldon_runtime_placement_device_bytes_max",
 }
 
 
@@ -187,6 +192,63 @@ def profile_probe(profiler) -> Callable[[], dict]:
             "recompile_storm": 1.0 if profiler.storm_segments() else 0.0,
             "compile_cache_enabled":
                 1.0 if compile_cache_enabled() else 0.0,
+        }
+
+    return probe
+
+
+#: labeled per-device gauge the placement probe sets directly (the flat
+#: GAUGES table cannot carry a ``device`` label)
+PLACEMENT_DEVICE_BYTES_GAUGE = "seldon_runtime_placement_device_bytes"
+
+
+def placement_probe(placement, metrics=None) -> Callable[[], dict]:
+    """Placement-plane posture (placement/plane.py PlacementPlane):
+    mesh size, how many segments serve sharded, the sharded-dispatch
+    count, and per-device live buffer bytes.  Accelerator backends
+    report ``memory_stats()['bytes_in_use']``; the CPU backend has no
+    allocator stats, so live ``jax.Array`` shard bytes are attributed
+    to their devices instead.  Per-device bytes land in the labeled
+    ``seldon_runtime_placement_device_bytes{device=...}`` gauge."""
+
+    def probe() -> dict:
+        import jax
+
+        devices = list(placement.mesh.devices.flat)
+        per_dev: dict[int, float] = {d.id: 0.0 for d in devices}
+        for d in devices:
+            try:
+                stats = d.memory_stats() or {}
+                per_dev[d.id] = float(stats.get("bytes_in_use", 0) or 0)
+            except Exception:
+                pass
+        if not any(per_dev.values()):
+            try:
+                for arr in jax.live_arrays():
+                    holders = [d for d in arr.sharding.device_set
+                               if d.id in per_dev]
+                    if holders:
+                        share = float(arr.nbytes) / len(
+                            arr.sharding.device_set)
+                        for d in holders:
+                            per_dev[d.id] += share
+            except Exception:
+                pass
+        if metrics is not None:
+            try:
+                for did, b in per_dev.items():
+                    metrics.gauge_set(PLACEMENT_DEVICE_BYTES_GAUGE, b,
+                                      {"device": str(did)})
+            except Exception:
+                pass
+        return {
+            "placement_devices": float(len(devices)),
+            "placement_segments_sharded":
+                float(len(placement.sharded_segments)),
+            "placement_sharded_dispatches":
+                float(placement.n_sharded_dispatches),
+            "placement_device_bytes_max":
+                max(per_dev.values(), default=0.0),
         }
 
     return probe

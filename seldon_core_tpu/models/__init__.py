@@ -44,6 +44,19 @@ class ModelSignature:
     prediction cache (``seldon_core_tpu/caching``) and its GL7xx
     admission pass read it from HERE, not from hardcoded class names, so
     third-party components opt out by registering a signature.
+
+    ``batch_shardable`` declares that the serving function is row-wise
+    over the leading batch dim (row *i* of the output depends only on row
+    *i* of the input) — the precondition the placement plane
+    (``seldon_core_tpu/placement``) needs to split a batch over the
+    mesh's ``dp`` axis and still return byte-identical results.  Classes
+    with cross-row math (batch statistics, ragged attention over the
+    whole batch) must register False.
+
+    ``tp_param_specs`` optionally maps parameter pytree keys to
+    ``PartitionSpec`` axis tuples (e.g. ``{"w1": (None, "tp")}``) so the
+    sharded executor can shard large weight matrices over the ``tp``
+    axis instead of replicating them; ``None`` replicates everything.
     """
 
     input_shape: Optional[Shape] = None
@@ -53,6 +66,8 @@ class ModelSignature:
     hbm_bytes: int = 0
     pure_fn: bool = False
     deterministic: bool = True
+    batch_shardable: bool = True
+    tp_param_specs: Optional[dict] = None
 
 
 def _dense_bytes(sizes: tuple, dtype_bytes: int = 4) -> int:

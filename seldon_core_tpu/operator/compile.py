@@ -370,6 +370,23 @@ def profile_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def placement_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/mesh`` / ``seldon.io/placement`` annotations → a
+    validated :class:`~seldon_core_tpu.placement.PlacementConfig`.
+    Invalid values — an unknown mesh axis, a non-positive axis size, a
+    duplicate or out-of-range placement pin — reject at admission;
+    graphlint's GL12xx pass reports the same defects, this is the hard
+    stop for callers that skip linting."""
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+    from seldon_core_tpu.placement import placement_config_from_annotations
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return placement_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

@@ -138,6 +138,34 @@ def _batcher_config(ann: dict) -> BatcherConfig:
     return cfg
 
 
+def _placement_capacity(ann: dict, n_devices: int) -> Optional[int]:
+    """Per-device HBM capacity in bytes: the GL3xx slice budget
+    (``seldon.io/tpu-hbm-gb``, else chips × 16 GiB) split across the
+    mesh.  None when no budget is declared — the planner then reports
+    loads without an over-capacity verdict."""
+    from seldon_core_tpu.analysis.graphlint import (
+        CHIPS_ANNOTATION,
+        HBM_BUDGET_ANNOTATION,
+        HBM_PER_CHIP_GB,
+    )
+
+    def _num(v):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+
+    budget_gb = _num(ann.get(HBM_BUDGET_ANNOTATION))
+    if budget_gb is None:
+        chips = _num(ann.get(CHIPS_ANNOTATION))
+        if not chips or chips <= 0:
+            return None
+        budget_gb = chips * HBM_PER_CHIP_GB
+    if budget_gb <= 0:
+        return None
+    return int(budget_gb * (1 << 30) / max(1, n_devices))
+
+
 class LocalPredictor:
     """One predictor graph, compiled to a GraphEngine with live components."""
 
@@ -153,6 +181,7 @@ class LocalPredictor:
         from seldon_core_tpu.operator.compile import (
             graph_plan_mode,
             health_config,
+            placement_config,
             prediction_cache_config,
             profile_config,
             qos_config,
@@ -219,6 +248,29 @@ class LocalPredictor:
             )
             if self.health is not None:
                 self.health.profiler = self.profiler
+        # Placement plane (docs/sharding.md): device mesh from
+        # seldon.io/mesh, HBM-aware segment→device assignment, and the
+        # dp-sharded executor on shardable fused segments.  A mesh the
+        # local inventory cannot honor (admission checks GL1202 against
+        # the *admission* host's devices, not necessarily this one's)
+        # degrades to single-device serving with a warning rather than
+        # failing the deployment start.
+        placement_cfg = placement_config(dep, pred)
+        self.placement = None
+        if placement_cfg is not None and placement_cfg.enabled:
+            from seldon_core_tpu.parallel import MeshPlanError
+            from seldon_core_tpu.placement import PlacementPlane
+
+            try:
+                self.placement = PlacementPlane(
+                    placement_cfg, metrics=self.metrics.registry,
+                    deployment=dep.name,
+                    capacity_bytes=_placement_capacity(
+                        ann, placement_cfg.n_devices),
+                )
+            except (MeshPlanError, ValueError) as e:
+                logger.warning(
+                    "placement plane disabled (mesh unavailable): %s", e)
         # persistent XLA compile cache: seldon.io/compile-cache is either a
         # boolean (default dir) or a cache-dir path; idempotent across
         # predictors (utils.enable_compile_cache)
@@ -247,6 +299,7 @@ class LocalPredictor:
             qos=self.qos,
             health=self.health,
             profiler=self.profiler,
+            placement=self.placement,
         )
         if (self.engine.plan is not None
                 and ann.get("seldon.io/graph-plan-warmup", "").lower()
@@ -265,6 +318,7 @@ class LocalPredictor:
             device_memory_probe,
             device_registry_probe,
             engine_probe,
+            placement_probe,
             profile_probe,
             qos_probe,
         )
@@ -283,6 +337,11 @@ class LocalPredictor:
             sampler.add_probe("qos", qos_probe(self.qos))
         if self.profiler is not None:
             sampler.add_probe("profile", profile_probe(self.profiler))
+        if self.placement is not None:
+            sampler.add_probe(
+                "placement",
+                placement_probe(self.placement,
+                                metrics=self.metrics.registry))
         plan = self.engine.plan
         if plan is not None:
             for seg in plan.segments:
@@ -371,6 +430,20 @@ class LocalDeployment:
                 }
 
             health_publish(dep.name, _health_snapshot)
+        # same pattern for the placement plane: mesh + segment→device
+        # assignments land in status.placement (reconcile compute_status)
+        if any(p.placement is not None for p in self.predictors):
+            from seldon_core_tpu.placement import publish as placement_publish
+
+            def _placement_snapshot(preds=self.predictors):
+                return {
+                    "predictors": [
+                        {"name": p.spec.name, **p.placement.snapshot()}
+                        for p in preds if p.placement is not None
+                    ]
+                }
+
+            placement_publish(dep.name, _placement_snapshot)
         self._rng = random.Random(seed)
         weights = [max(p.spec.replicas, 0) * max(p.spec.traffic, 0)
                    for p in self.predictors]
@@ -418,6 +491,16 @@ class LocalDeployment:
         for p in self.predictors:
             if p.profiler is not None:
                 return p.profiler
+        return None
+
+    @property
+    def placement(self):
+        """First placement-enabled predictor's plane (the
+        ``/admin/placement`` endpoint reads ``engine.placement`` — same
+        delegation rationale as ``tracer``/``health``)."""
+        for p in self.predictors:
+            if p.placement is not None:
+                return p.placement
         return None
 
     async def predict(self, msg):

@@ -426,6 +426,17 @@ class SeldonDeploymentController:
         health = health_snapshot(owner)
         if health is not None:
             status["health"] = health
+        # Placement posture (docs/sharding.md): mesh shape and
+        # segment→device assignments, published by the same process-local
+        # pattern (placement/registry.py) — status.placement beside
+        # status.qos/status.health.
+        from seldon_core_tpu.placement import (
+            snapshot as placement_snapshot,
+        )
+
+        placement = placement_snapshot(owner)
+        if placement is not None:
+            status["placement"] = placement
         return status
 
     # -- internals -------------------------------------------------------

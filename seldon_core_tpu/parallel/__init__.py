@@ -1,0 +1,53 @@
+"""Parallelism primitives: mesh planning plus the three sharded building
+blocks (pipeline schedule, MoE dispatch, ring attention).
+
+The placement plane (``seldon_core_tpu/placement/``) consumes
+:func:`plan_mesh`/:func:`make_mesh` to turn a ``seldon.io/mesh``
+annotation into the ``jax.sharding.Mesh`` fused segments execute over;
+the model zoo consumes the rest (docs/sharding.md).
+"""
+
+from seldon_core_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshPlan,
+    MeshPlanError,
+    make_mesh,
+    named_sharding,
+    plan_mesh,
+    pspec,
+    single_axis_mesh,
+)
+from seldon_core_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_capacity,
+    moe_forward,
+    moe_param_specs,
+)
+from seldon_core_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from seldon_core_tpu.parallel.ring_attention import (
+    dense_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshPlan",
+    "MeshPlanError",
+    "MoEConfig",
+    "dense_attention",
+    "init_moe_params",
+    "make_mesh",
+    "moe_capacity",
+    "moe_forward",
+    "moe_param_specs",
+    "named_sharding",
+    "pipeline_apply",
+    "plan_mesh",
+    "pspec",
+    "ring_attention",
+    "ring_attention_sharded",
+    "single_axis_mesh",
+    "stack_stage_params",
+]

@@ -28,6 +28,15 @@ import numpy as np
 AXIS_ORDER = ("dp", "pp", "tp")
 
 
+class MeshPlanError(ValueError):
+    """A requested mesh factorization cannot be realized on the available
+    devices (axis does not divide the device count, zero/negative sizes,
+    plan/device mismatch).  Subclasses ``ValueError`` so existing callers
+    that guard the old bare errors keep working; new callers (the
+    placement plane, graphlint GL12xx) catch the typed error instead of
+    whatever jax would throw at Mesh construction."""
+
+
 @dataclass
 class MeshPlan:
     """A named factorization of a device count into mesh axes."""
@@ -56,18 +65,25 @@ def plan_mesh(
     Explicit tp/pp must divide n_devices.
     """
     if n_devices < 1:
-        raise ValueError("n_devices must be >= 1")
+        raise MeshPlanError("n_devices must be >= 1")
     if tp is None:
         tp = 1
         while tp * 2 <= min(max_tp, n_devices) and n_devices % (tp * 2) == 0:
             tp *= 2
+    if tp < 1:
+        raise MeshPlanError(f"tp={tp} must be >= 1")
     if n_devices % tp != 0:
-        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+        raise MeshPlanError(
+            f"tp={tp} does not divide n_devices={n_devices}")
     rem = n_devices // tp
     if pp is None:
         pp = 1
+    if pp < 1:
+        raise MeshPlanError(f"pp={pp} must be >= 1")
     if rem % pp != 0:
-        raise ValueError(f"pp={pp} does not divide {rem}")
+        raise MeshPlanError(
+            f"pp={pp} does not divide n_devices/tp={rem} "
+            f"(n_devices={n_devices}, tp={tp})")
     return MeshPlan(dp=rem // pp, pp=pp, tp=tp)
 
 
@@ -88,7 +104,7 @@ def make_mesh(
     if plan is None:
         plan = plan_mesh(len(devices), **plan_kw)
     if plan.n_devices != len(devices):
-        raise ValueError(
+        raise MeshPlanError(
             f"plan wants {plan.n_devices} devices, have {len(devices)}"
         )
     arr = np.array(devices).reshape(plan.dp, plan.pp, plan.tp)

@@ -1,0 +1,58 @@
+"""Placement plane: device meshes, HBM-aware segment placement, and
+sharded fused-segment execution.
+
+Annotation-driven (``seldon.io/mesh``, ``seldon.io/placement`` —
+docs/sharding.md): the mesh manager (``meshes.py``) builds one
+``jax.sharding.Mesh`` per spec per process, the planner (``planner.py``)
+bin-packs fused segments onto mesh devices from the compile-ledger HBM
+peaks, and :class:`PlacementPlane` (``plane.py``) wires both into the
+engine so segments with shardable batch dims execute one sharded
+dispatch over the ``dp`` axis.  Admission validation lives in graphlint
+(GL12xx); the admin surface is ``/admin/placement``; the control-plane
+surface is ``status.placement`` via ``registry.py``.
+"""
+
+from seldon_core_tpu.placement.config import (
+    MESH_ANNOTATION,
+    PLACEMENT_ANNOTATION,
+    PlacementConfig,
+    placement_config_from_annotations,
+)
+from seldon_core_tpu.placement.http import placement_body
+from seldon_core_tpu.placement.meshes import (
+    device_count,
+    mesh_for,
+    registry_stats,
+)
+from seldon_core_tpu.placement.plane import PlacementPlane, segment_facts
+from seldon_core_tpu.placement.planner import (
+    Assignment,
+    PlacementPlan,
+    SegmentFacts,
+    plan_placement,
+)
+from seldon_core_tpu.placement.registry import (
+    publish,
+    snapshot,
+    unpublish,
+)
+
+__all__ = [
+    "MESH_ANNOTATION",
+    "PLACEMENT_ANNOTATION",
+    "Assignment",
+    "PlacementConfig",
+    "PlacementPlan",
+    "PlacementPlane",
+    "SegmentFacts",
+    "device_count",
+    "mesh_for",
+    "placement_body",
+    "placement_config_from_annotations",
+    "plan_placement",
+    "publish",
+    "registry_stats",
+    "segment_facts",
+    "snapshot",
+    "unpublish",
+]

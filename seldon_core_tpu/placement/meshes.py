@@ -1,0 +1,88 @@
+"""Process-local device-mesh registry (the "mesh manager").
+
+One ``jax.sharding.Mesh`` per distinct ``seldon.io/mesh`` spec per
+process: every deployment (and every fused segment) asking for
+``dp=2,tp=2`` shares the same Mesh object, so XLA's compiled-computation
+cache keys stay stable and the admin surfaces can enumerate what
+topology the process is actually committed to.
+
+CPU-testable: with ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+``jax.devices()`` reports 8 host devices and every mesh here behaves as
+it would on an 8-chip slice (minus the ICI bandwidth, which is exactly
+what the tier-1 tests don't need).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from seldon_core_tpu.parallel.mesh import MeshPlan, MeshPlanError, make_mesh
+from seldon_core_tpu.placement.config import PlacementConfig
+
+__all__ = ["mesh_for", "device_count", "registry_stats", "lookup", "clear"]
+
+_lock = threading.Lock()
+#: canonical spec string → live Mesh
+_meshes: dict[str, object] = {}
+
+
+def device_count() -> int:
+    """Visible accelerator devices (0 when jax is unavailable)."""
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:
+        return 0
+
+
+def mesh_for(config: PlacementConfig):
+    """The process-wide Mesh for this config, built on first use.
+
+    Raises :class:`MeshPlanError` when the axis product exceeds the
+    visible device count — the same defect graphlint rejects at
+    admission (GL1202), re-checked here because the runtime may see a
+    different device inventory than the linter did."""
+    import jax
+
+    key = config.spec()
+    with _lock:
+        mesh = _meshes.get(key)
+        if mesh is not None:
+            return mesh
+        devices = jax.devices()
+        want = config.n_devices
+        if want > len(devices):
+            raise MeshPlanError(
+                f"mesh {key!r} wants {want} device(s) but only "
+                f"{len(devices)} visible"
+            )
+        plan = MeshPlan(dp=config.dp, pp=config.pp, tp=config.tp)
+        mesh = make_mesh(plan, devices=devices[:want])
+        _meshes[key] = mesh
+        return mesh
+
+
+def registry_stats() -> dict:
+    """Admin-surface view: which meshes this process holds."""
+    with _lock:
+        out = {}
+        for key, mesh in _meshes.items():
+            out[key] = {
+                "axes": {a: int(s) for a, s in mesh.shape.items()},
+                "devices": [str(d) for d in mesh.devices.flat],
+            }
+        return out
+
+
+def clear() -> None:
+    """Test helper: forget every mesh."""
+    with _lock:
+        _meshes.clear()
+
+
+def lookup(spec: str) -> Optional[object]:
+    """The registered Mesh for a canonical spec string, or None."""
+    with _lock:
+        return _meshes.get(spec)

@@ -1,0 +1,246 @@
+"""PlacementPlane: one object per engine/deployment owning the device
+mesh, the segment→device placement plan, and the sharded-dispatch
+telemetry the admin surfaces read.
+
+Construction builds (or fetches from the process-local registry) the
+``jax.sharding.Mesh`` for the deployment's ``seldon.io/mesh`` annotation;
+``attach_plan`` binds the engine's compiled :class:`GraphPlan` and
+enables the sharded executor on every segment whose members declare
+shardable batch dims.  ``/admin/placement`` and ``status.placement``
+read :meth:`describe`/:meth:`snapshot`; each sharded dispatch lands in
+the ``seldon_placement_*`` metrics with per-device counts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from seldon_core_tpu.placement.config import PlacementConfig
+from seldon_core_tpu.placement.meshes import device_count, mesh_for
+from seldon_core_tpu.placement.planner import (
+    PlacementPlan,
+    SegmentFacts,
+    plan_placement,
+)
+
+__all__ = ["PlacementPlane", "segment_facts"]
+
+_DISPATCH_COUNTER = "seldon_placement_dispatches_total"
+_SHARDED_COUNTER = "seldon_placement_sharded_dispatches_total"
+_SEGMENTS_GAUGE = "seldon_placement_segments"
+_DEVICE_HBM_GAUGE = "seldon_placement_device_hbm_bytes"
+
+
+def _member_units(root_node, names: set) -> dict:
+    """name → spec unit for the segment members under ``root_node``."""
+    units: dict = {}
+
+    def visit(n) -> None:
+        if n.unit.name in names:
+            units[n.unit.name] = n
+        for c in n.children:
+            visit(c)
+
+    visit(root_node)
+    return units
+
+
+def _member_signature(node):
+    """The member's static signature: model_class registry first, then
+    the built-in table (mirrors graphlint's _node_signature)."""
+    from seldon_core_tpu.models import BUILTIN_SIGNATURES, signature_for
+
+    mc = node.unit.parameters.get("model_class")
+    if isinstance(mc, str) and mc:
+        return signature_for(mc)
+    if node.unit.implementation:
+        return BUILTIN_SIGNATURES.get(node.unit.implementation)
+    return None
+
+
+def _parity_probe(seg, dp: int):
+    """Deterministic example batch (rows = 2·dp) for the byte-parity
+    probe, derived from the entry node's static signature the same way
+    ``GraphPlan.warmup`` derives its example row.  None when the
+    signature does not pin every non-batch dim — the segment then arms
+    unprobed and the CI shard-smoke gate is the parity evidence."""
+    import numpy as np
+
+    from seldon_core_tpu.graph.plan import _entry_signature
+
+    sig = _entry_signature(seg.root_node)
+    if sig is None or sig.input_shape is None or any(
+            d is None for d in sig.input_shape[1:]):
+        return None
+    shape = (2 * dp,) + tuple(sig.input_shape[1:])
+    dt = np.dtype(sig.input_dtype or "float32")
+    rng = np.random.RandomState(0)
+    if dt.kind in ("i", "u"):
+        return rng.randint(0, 8, size=shape).astype(dt)
+    return rng.uniform(size=shape).astype(dt)
+
+
+def _tp_specs(seg) -> dict:
+    """member name → {param key → tp axis tuple} from the signature
+    registry, for weight sharding over the mesh's ``tp`` axis."""
+    names = {s.name for s in seg.members}
+    out: dict = {}
+    for name, node in _member_units(seg.root_node, names).items():
+        sig = _member_signature(node)
+        if sig is not None and sig.tp_param_specs:
+            out[name] = dict(sig.tp_param_specs)
+    return out
+
+
+def segment_facts(seg) -> SegmentFacts:
+    """Planner inputs for one live :class:`FusedSegment`.
+
+    Static HBM comes from the signature registry; the measured peak
+    prefers PR 9's compile ledger (``cost_by_bucket``) once the segment
+    has compiled.  Shardability requires EVERY member to carry a
+    signature declaring a row-wise serving fn (``batch_shardable``) —
+    one cross-row member poisons the whole segment, because the fused
+    trace is one program."""
+    names = {s.name for s in seg.members}
+    units = _member_units(seg.root_node, names)
+    hbm = 0
+    shardable = len(units) == len(names) and bool(names)
+    for name in names:
+        node = units.get(name)
+        sig = _member_signature(node) if node is not None else None
+        if sig is None:
+            shardable = False
+            continue
+        hbm += sig.hbm_bytes
+        if not sig.batch_shardable:
+            shardable = False
+    measured = 0
+    for cost in seg.cost_by_bucket.values():
+        measured = max(measured, int(cost.get("peak_hbm_bytes", 0) or 0))
+    for cost in getattr(seg, "shard_cost_by_bucket", {}).values():
+        measured = max(measured, int(cost.get("peak_hbm_bytes", 0) or 0))
+    return SegmentFacts(
+        name=seg.name, hbm_bytes=hbm, measured_hbm_bytes=measured,
+        shardable=shardable, members=tuple(sorted(names)),
+    )
+
+
+class PlacementPlane:
+    def __init__(self, config: PlacementConfig, metrics=None,
+                 deployment: str = "",
+                 capacity_bytes: Optional[int] = None):
+        self.config = config
+        self.metrics = metrics
+        self.deployment = deployment
+        self.capacity_bytes = capacity_bytes
+        #: raises MeshPlanError when the spec oversubscribes the visible
+        #: devices — admission (GL1202) rejects that first, but a runtime
+        #: with a smaller inventory must fail loudly at construction, not
+        #: at the first sharded dispatch
+        self.mesh = mesh_for(config)
+        self._plan_lock = threading.Lock()
+        self._graph_plan = None
+        self._segments: list = []
+        self.sharded_segments: list[str] = []
+        self.n_sharded_dispatches = 0
+
+    # -- wiring ---------------------------------------------------------
+    def attach_plan(self, graph_plan) -> None:
+        """Bind the engine's compiled GraphPlan; enable the sharded
+        executor on every shardable segment."""
+        with self._plan_lock:
+            self._graph_plan = graph_plan
+            self._segments = list(graph_plan.segments)
+            self.sharded_segments = []
+            for seg in self._segments:
+                facts = segment_facts(seg)
+                if facts.shardable and self.config.dp > 1 and seg.enable_sharding(
+                        self.mesh, on_dispatch=self._note_sharded,
+                        tp_param_specs=_tp_specs(seg),
+                        probe=_parity_probe(seg, self.config.dp)):
+                    self.sharded_segments.append(seg.name)
+                    if seg.batcher is not None:
+                        # shard_rows mode: the batcher pads its buckets to
+                        # a multiple of the dp span so every coalesced
+                        # batch splits evenly across the mesh
+                        seg.batcher.config.shard_rows = seg.shard_rows
+        if self.metrics is not None:
+            try:
+                self.metrics.gauge_set(
+                    _SEGMENTS_GAUGE, len(self._segments),
+                    {"deployment": self.deployment or "engine"})
+            except Exception:
+                pass
+
+    # -- telemetry ------------------------------------------------------
+    def _note_sharded(self, seg_name: str, rows: int) -> None:
+        """One sharded dispatch: every device in the dp span executed
+        rows/dp of the batch."""
+        self.n_sharded_dispatches += 1
+        if self.metrics is None:
+            return
+        try:
+            dep = self.deployment or "engine"
+            self.metrics.counter_inc(
+                _SHARDED_COUNTER, {"deployment": dep, "segment": seg_name})
+            for d in self.mesh.devices.flat:
+                self.metrics.counter_inc(
+                    _DISPATCH_COUNTER,
+                    {"deployment": dep, "device": str(d.id)})
+        except Exception:
+            pass
+
+    # -- posture --------------------------------------------------------
+    def placement(self) -> PlacementPlan:
+        """The current placement plan, re-derived on read so the HBM
+        estimates sharpen as compile ledgers fill in."""
+        with self._plan_lock:
+            segs = list(self._segments)
+        facts = [segment_facts(s) for s in segs]
+        overrides = self.config.override_map()
+        plan = plan_placement(
+            facts, n_devices=self.config.n_devices, dp=self.config.dp,
+            mesh_spec=self.config.spec(), overrides=overrides,
+            capacity_bytes=self.capacity_bytes,
+        )
+        if self.metrics is not None:
+            try:
+                dep = self.deployment or "engine"
+                for d, b in plan.device_hbm_bytes.items():
+                    self.metrics.gauge_set(
+                        _DEVICE_HBM_GAUGE, float(b),
+                        {"deployment": dep, "device": str(d)})
+            except Exception:
+                pass
+        return plan
+
+    def mesh_shape(self) -> str:
+        return self.config.spec()
+
+    def describe(self) -> dict:
+        """Full admin-surface payload (``/admin/placement``)."""
+        plan = self.placement()
+        out = plan.to_dict()
+        out.update({
+            "deployment": self.deployment,
+            "devicesVisible": device_count(),
+            "shardedSegments": list(self.sharded_segments),
+            "shardedDispatches": self.n_sharded_dispatches,
+        })
+        if self.capacity_bytes:
+            out["deviceCapacityBytes"] = int(self.capacity_bytes)
+        return out
+
+    # -- control-plane snapshot (status.placement) ----------------------
+    def snapshot(self) -> dict:
+        """Compact posture for the CR's ``status.placement`` block."""
+        plan = self.placement()
+        return {
+            "mesh": self.config.spec(),
+            "devices": self.config.n_devices,
+            "segments": {
+                a.segment: list(a.devices) for a in plan.assignments
+            },
+            "shardedSegments": list(self.sharded_segments),
+        }

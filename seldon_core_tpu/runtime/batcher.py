@@ -111,6 +111,12 @@ class BatcherConfig:
     shed_after_ms: float = 0.0
     # max batches dispatched-but-unfinished (host mode only); 0 → unbounded
     max_inflight: int = 4
+    # sharded-execution mode (placement plane): pad every dispatched batch
+    # to a multiple of this row count so the fused segment's dp-sharded
+    # executable sees a batch it can split evenly across devices.  1 → off.
+    # The owning PlacementPlane sets it to the mesh's dp size when it arms
+    # sharding on the segment this batcher feeds.
+    shard_rows: int = 1
 
 
 @dataclass
@@ -191,15 +197,24 @@ class DynamicBatcher:
 
     # ------------------------------------------------------------------
     def bucket_for(self, rows: int) -> int:
+        bucket = self.buckets[-1]
         for b in self.buckets:
             if rows <= b:
-                return b
-        return self.buckets[-1]
+                bucket = b
+                break
+        # shard_rows mode: round the bucket up to a multiple of the dp
+        # span so the sharded executable always sees an evenly-splittable
+        # batch (the extra rows are ordinary pad rows, sliced off on
+        # delivery like any other padding)
+        sr = max(1, int(getattr(self.config, "shard_rows", 1) or 1))
+        if sr > 1 and bucket % sr:
+            bucket = ((bucket + sr - 1) // sr) * sr
+        return bucket
 
     def warmup(self, example_row: np.ndarray) -> None:
         """Pre-compile every bucket size (first TPU compile is seconds; do it
         before traffic, not during)."""
-        for b in self.buckets:
+        for b in sorted({self.bucket_for(b) for b in self.buckets}):
             batch = np.broadcast_to(example_row, (b,) + tuple(example_row.shape))
             y = self.fn(np.ascontiguousarray(batch))
             if self.returns_aux:

@@ -332,6 +332,32 @@ def _profile_paths() -> dict:
     }
 
 
+def _placement_paths() -> dict:
+    """The placement-plane admin surface — identical on gateway and
+    engine (docs/sharding.md)."""
+    return {
+        "/admin/placement": {
+            "get": {
+                "summary": "device mesh, segment->device assignments, "
+                           "per-device HBM loads, sharded-dispatch "
+                           "counters",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "meshes", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "return only the process-wide mesh "
+                                    "registry"},
+                ],
+                "responses": {
+                    "200": {"description": "placement plan + mesh "
+                                           "registry"},
+                    "404": {"description": "placement plane disabled"},
+                },
+            }
+        },
+    }
+
+
 def gateway_spec() -> dict:
     """External API (reference apife.oas3.json)."""
     paths = {
@@ -398,6 +424,7 @@ def gateway_spec() -> dict:
         },
         **_health_paths(),
         **_profile_paths(),
+        **_placement_paths(),
         **_ops_paths(),
     }
     return {
@@ -441,6 +468,7 @@ def engine_spec() -> dict:
                            "responses": {"200": {"description": "traces"}}}},
         **_health_paths(),
         **_profile_paths(),
+        **_placement_paths(),
         **_ops_paths(),
     }
     return {

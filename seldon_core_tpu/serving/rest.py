@@ -445,6 +445,26 @@ class EngineServer:
 
         return await self._profile_endpoint(request, capacity_body)
 
+    def _placement_plane(self):
+        """The engine's placement plane (duck attr, like ``health``)."""
+        return getattr(self.engine, "placement", None)
+
+    async def placement(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.placement.http import placement_body
+
+        try:
+            status, payload = placement_body(
+                self._placement_plane(), request.query)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, "numeric query parameter expected"),
+                content_type="application/json",
+            )
+        return web.Response(
+            status=status, text=json.dumps(payload),
+            content_type="application/json",
+        )
+
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
         app.router.add_post("/api/v0.1/stream", self.stream)
@@ -463,6 +483,7 @@ class EngineServer:
         app.router.add_get("/admin/profile/capture", self.profile_capture)
         app.router.add_get("/admin/profile/compile", self.profile_compile)
         app.router.add_get("/admin/profile/capacity", self.profile_capacity)
+        app.router.add_get("/admin/placement", self.placement)
         app.router.add_get("/seldon.json", _openapi_handler("engine"))
 
 

@@ -490,6 +490,67 @@ CATALOG: tuple[MetricInfo, ...] = (
         "kind=shm for orphaned shared-memory segments)",
         ("kind",),
     ),
+    # -- placement plane (docs/sharding.md): device meshes, HBM-aware
+    #    segment placement, dp-sharded fused-segment execution ----------
+    MetricInfo(
+        "seldon_placement_dispatches_total", "counter",
+        "Per-device executions from sharded fused-segment dispatches "
+        "(each sharded dispatch runs rows/dp on every device of the dp "
+        "span — an uneven rate across devices means a skewed mesh)",
+        ("deployment", "device"),
+    ),
+    MetricInfo(
+        "seldon_placement_sharded_dispatches_total", "counter",
+        "Fused-segment dispatches served by the dp-sharded executable "
+        "(compare to seldon_batcher_batches_total for sharding "
+        "coverage; a parity-failed bucket serves unsharded and does "
+        "not count here)",
+        ("deployment", "segment"),
+    ),
+    MetricInfo(
+        "seldon_placement_segments", "gauge",
+        "Fused segments under placement management for this deployment",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_placement_device_hbm_bytes", "gauge",
+        "Planner-estimated HBM load per device (static signature bytes, "
+        "sharpened by compile-ledger peaks once segments compile; the "
+        "/admin/placement deviceHbmBytes map)",
+        ("deployment", "device"),
+    ),
+    MetricInfo(
+        "seldon_runtime_placement_devices", "gauge",
+        "Mesh size seen by the placement plane at sample time "
+        "(introspection sampler placement probe)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_placement_segments_sharded", "gauge",
+        "Segments currently serving through the dp-sharded executable "
+        "at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_placement_sharded_dispatches", "gauge",
+        "Cumulative sharded dispatches at sample time (sampler twin of "
+        "seldon_placement_sharded_dispatches_total)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_placement_device_bytes_max", "gauge",
+        "Largest per-device live-buffer byte count across the mesh at "
+        "sample time (skew indicator; per-device detail in "
+        "seldon_runtime_placement_device_bytes)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_placement_device_bytes", "gauge",
+        "Live buffer bytes per mesh device at sample time (accelerator "
+        "allocator stats, or live-array attribution on backends "
+        "without memory_stats)",
+        ("device",),
+    ),
 )
 
 

@@ -34,6 +34,43 @@ def test_plan_mesh_factorization():
         plan_mesh(8, tp=3)
 
 
+def test_plan_mesh_small_device_layouts():
+    # 1 and 2 device layouts — the laptop/single-host degenerate cases
+    assert plan_mesh(1, tp=1, pp=1).axis_sizes() == {"dp": 1, "pp": 1, "tp": 1}
+    assert plan_mesh(2).axis_sizes() == {"dp": 1, "pp": 1, "tp": 2}
+    assert plan_mesh(2, tp=1).axis_sizes() == {"dp": 2, "pp": 1, "tp": 1}
+    assert plan_mesh(8, tp=1, pp=1).axis_sizes() == {"dp": 8, "pp": 1, "tp": 1}
+
+
+def test_plan_mesh_errors_are_typed():
+    from seldon_core_tpu.parallel import MeshPlanError
+
+    # MeshPlanError is a ValueError subclass so legacy callers still catch it
+    assert issubclass(MeshPlanError, ValueError)
+    with pytest.raises(MeshPlanError):
+        plan_mesh(0)
+    with pytest.raises(MeshPlanError):
+        plan_mesh(8, tp=0)
+    with pytest.raises(MeshPlanError):
+        plan_mesh(8, pp=0)
+    with pytest.raises(MeshPlanError):
+        plan_mesh(8, pp=3)  # non-dividing pipeline factor
+    with pytest.raises(MeshPlanError):
+        plan_mesh(8, tp=3)  # non-dividing tensor factor
+    from seldon_core_tpu.parallel import MeshPlan
+
+    with pytest.raises(MeshPlanError):
+        make_mesh(plan=MeshPlan(dp=4), n_devices=2)  # oversubscribed plan
+
+
+def test_parallel_public_exports_importable():
+    import seldon_core_tpu.parallel as parallel
+
+    assert parallel.__all__ == sorted(parallel.__all__)
+    for name in parallel.__all__:
+        assert getattr(parallel, name) is not None, name
+
+
 def test_make_mesh_axes():
     mesh = make_mesh(n_devices=8, tp=2, pp=2)
     assert mesh.axis_names == ("dp", "pp", "tp")

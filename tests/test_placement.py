@@ -1,0 +1,447 @@
+"""Placement-plane tests (docs/sharding.md): annotation parsing, the
+process-local mesh registry, the HBM-aware planner, GL12xx admission
+lint, the sharded executor's byte-parity contract on the virtual
+8-device CPU mesh, and the admin/status surfaces.
+
+The contract under test: ``seldon.io/mesh`` turns the plane on; a
+batch-shardable fused segment executes one dp-sharded dispatch whose
+response bytes equal the walk and unsharded-fused responses (the
+two-tier parity gate falls back rather than ever serving divergent
+bytes); ``/admin/placement`` and the registry report every segment with
+a device assignment.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.placement import (
+    PlacementConfig,
+    placement_config_from_annotations,
+)
+from seldon_core_tpu.placement.planner import SegmentFacts, plan_placement
+
+MESH = "seldon.io/mesh"
+PINS = "seldon.io/placement"
+IRIS = "seldon_core_tpu.models.iris:IrisClassifier"
+MLP = "seldon_core_tpu.models.mlp:MNISTMLP"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# annotation parsing
+# ---------------------------------------------------------------------------
+
+
+class TestConfigParsing:
+    def test_mesh_specs(self):
+        cfg = placement_config_from_annotations({MESH: "dp=4"})
+        assert cfg.enabled and cfg.n_devices == 4
+        assert cfg.axis_sizes() == {"dp": 4, "pp": 1, "tp": 1}
+        assert cfg.spec() == "dp=4"
+
+        cfg = placement_config_from_annotations({MESH: " dp=2 , tp=2 "})
+        assert cfg.axis_sizes() == {"dp": 2, "pp": 1, "tp": 2}
+        assert cfg.spec() == "dp=2,tp=2"
+
+    def test_absent_mesh_disables(self):
+        cfg = placement_config_from_annotations({})
+        assert cfg == PlacementConfig(enabled=False)
+        assert cfg.spec() == "dp=1"  # canonical degenerate spec
+
+    def test_overrides_validated_even_without_mesh(self):
+        cfg = placement_config_from_annotations({PINS: "clf=0,prep=3"})
+        assert not cfg.enabled
+        assert cfg.override_map() == {"clf": 0, "prep": 3}
+        with pytest.raises(ValueError, match="device ordinal"):
+            placement_config_from_annotations({PINS: "clf=x"})
+
+    @pytest.mark.parametrize("raw", [
+        "dp",            # not an axis=size pair
+        "sp=4",          # unknown axis
+        "dp=4,dp=2",     # axis given twice
+        "dp=four",       # non-integer size
+        "dp=0",          # size < 1
+        "  ,  ",         # empty spec
+    ])
+    def test_invalid_mesh_specs(self, raw):
+        with pytest.raises(ValueError):
+            placement_config_from_annotations({MESH: raw})
+
+    def test_pin_beyond_mesh_rejected(self):
+        with pytest.raises(ValueError, match="only 4 device"):
+            placement_config_from_annotations({MESH: "dp=4", PINS: "clf=4"})
+
+    def test_duplicate_pin_rejected(self):
+        with pytest.raises(ValueError, match="placed twice"):
+            placement_config_from_annotations(
+                {MESH: "dp=2", PINS: "clf=0,clf=1"})
+
+
+# ---------------------------------------------------------------------------
+# mesh registry
+# ---------------------------------------------------------------------------
+
+
+class TestMeshRegistry:
+    def test_identical_specs_share_one_mesh(self):
+        from seldon_core_tpu.placement import meshes
+
+        cfg = placement_config_from_annotations({MESH: "dp=4"})
+        m1 = meshes.mesh_for(cfg)
+        m2 = meshes.mesh_for(cfg)
+        assert m1 is m2
+        assert dict(m1.shape)["dp"] == 4
+        stats = meshes.registry_stats()
+        assert "dp=4" in stats
+        assert meshes.lookup("dp=4") is m1
+
+    def test_oversubscribed_mesh_raises_typed(self):
+        from seldon_core_tpu.parallel import MeshPlanError
+        from seldon_core_tpu.placement import meshes
+
+        cfg = placement_config_from_annotations({MESH: "dp=16"})
+        assert meshes.device_count() == 8  # conftest forces 8 host devices
+        with pytest.raises(MeshPlanError):
+            meshes.mesh_for(cfg)
+        assert meshes.lookup("dp=16") is None  # failures are not cached
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+
+def _facts(name, hbm, shardable=False):
+    return SegmentFacts(name=name, hbm_bytes=hbm, shardable=shardable)
+
+
+class TestPlanner:
+    def test_lpt_balances_devices(self):
+        plan = plan_placement(
+            [_facts("a", 100), _facts("b", 60), _facts("c", 50)],
+            n_devices=2, mesh_spec="dp=2")
+        by_seg = {a.segment: a for a in plan.assignments}
+        # largest lands first; b+c pack opposite a
+        assert by_seg["a"].devices != by_seg["b"].devices
+        assert by_seg["b"].devices == by_seg["c"].devices
+        assert plan.device_hbm_bytes == {0: 100, 1: 110}
+        assert all(a.source == "bin-pack" for a in plan.assignments)
+
+    def test_override_pins_win(self):
+        plan = plan_placement(
+            [_facts("a", 100), _facts("b", 10)],
+            n_devices=4, mesh_spec="dp=4", overrides={"a": 3})
+        by_seg = {a.segment: a for a in plan.assignments}
+        assert by_seg["a"].devices == (3,)
+        assert by_seg["a"].source == "override"
+
+    def test_shardable_spans_all_devices_and_charges_each(self):
+        plan = plan_placement(
+            [_facts("a", 100, shardable=True)],
+            n_devices=4, dp=4, mesh_spec="dp=4")
+        (a,) = plan.assignments
+        assert a.devices == (0, 1, 2, 3)
+        assert a.source == "sharded"
+        # replicated weights: every device holds a copy
+        assert plan.device_hbm_bytes == {0: 100, 1: 100, 2: 100, 3: 100}
+
+    def test_shardable_without_dp_bin_packs(self):
+        plan = plan_placement(
+            [_facts("a", 100, shardable=True)],
+            n_devices=2, dp=1, mesh_spec="pp=2")
+        assert plan.assignments[0].source == "bin-pack"
+
+    def test_capacity_marks_overflow(self):
+        plan = plan_placement(
+            [_facts("a", 100), _facts("b", 10)],
+            n_devices=2, mesh_spec="dp=2", capacity_bytes=50)
+        assert plan.over_capacity == [0] or plan.over_capacity == [1]
+        assert "overCapacity" in plan.to_dict()
+
+    def test_measured_bytes_sharpen_estimate(self):
+        f = SegmentFacts(name="a", hbm_bytes=10, measured_hbm_bytes=999)
+        assert f.estimate == 999
+
+    def test_to_dict_preserves_caller_order(self):
+        plan = plan_placement(
+            [_facts("z", 1), _facts("a", 100)],
+            n_devices=2, mesh_spec="dp=2")
+        assert [s["segment"] for s in plan.to_dict()["segments"]] == ["z", "a"]
+
+
+# ---------------------------------------------------------------------------
+# GL12xx admission lint
+# ---------------------------------------------------------------------------
+
+
+def _iris_node(name="clf"):
+    return {"name": name, "type": "MODEL", "parameters": [{
+        "name": "model_class", "value": IRIS, "type": "STRING"}],
+        "children": []}
+
+
+def _lint(ann, node=None):
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    return {f.code: f for f in lint_graph(node or _iris_node(), ann)}
+
+
+class TestGraphlint:
+    def test_invalid_annotation_gl1201(self):
+        fs = _lint({MESH: "sp=4"})
+        assert fs["GL1201"].severity == "ERROR"
+
+    def test_oversubscribed_gl1202(self):
+        fs = _lint({MESH: "dp=16"})
+        assert fs["GL1202"].severity == "ERROR"
+        assert "16" in fs["GL1202"].message
+
+    def test_unknown_pin_gl1203_only_in_fused_mode(self):
+        ann = {MESH: "dp=2", PINS: "ghost=0"}
+        assert "GL1203" not in _lint(ann)  # walk mode: no segments yet
+        fs = _lint({**ann, "seldon.io/graph-plan": "fused"})
+        assert fs["GL1203"].severity == "ERROR"
+        assert "ghost" in fs["GL1203"].message
+
+    def test_hbm_infeasible_gl1204(self):
+        node = {"name": "mlp", "type": "MODEL", "parameters": [{
+            "name": "model_class", "value": MLP, "type": "STRING"}],
+            "children": []}
+        # MNISTMLP weights ~2.1 MB; 0.001 GiB split over 2 devices cannot
+        # hold a replicated shardable segment
+        fs = _lint({MESH: "dp=2", "seldon.io/graph-plan": "fused",
+                    "seldon.io/tpu-hbm-gb": "0.001"}, node=node)
+        assert fs["GL1204"].severity == "ERROR"
+
+    def test_config_report_gl1205(self):
+        fs = _lint({MESH: "dp=4", "seldon.io/graph-plan": "fused"})
+        assert fs["GL1205"].severity == "INFO"
+        assert "dp=4" in fs["GL1205"].message
+
+    def test_pins_without_mesh_gl1206(self):
+        fs = _lint({PINS: "clf=0"})
+        assert fs["GL1206"].severity == "WARN"
+        assert "GL1205" not in fs
+
+    def test_no_placement_annotations_no_findings(self):
+        codes = set(_lint({}))
+        assert not any(c.startswith("GL12") for c in codes)
+
+
+# ---------------------------------------------------------------------------
+# sharded execution (virtual 8-device CPU mesh from conftest)
+# ---------------------------------------------------------------------------
+
+
+def _deployment(name, extra_ann, model_class=IRIS, node_name="clf"):
+    from seldon_core_tpu.operator.local import LocalDeployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    dep = SeldonDeployment.from_dict({
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "annotations": {
+            "seldon.io/batching": "false", **extra_ann}},
+        "spec": {"predictors": [{
+            "name": "p", "replicas": 1,
+            "graph": {"name": node_name, "type": "MODEL", "parameters": [{
+                "name": "model_class", "value": model_class,
+                "type": "STRING"}], "children": []},
+            "componentSpecs": [],
+        }]},
+    })
+    return LocalDeployment(dep)
+
+
+def _msg(x, puid="placement-parity"):
+    from seldon_core_tpu.messages import SeldonMessage
+
+    m = SeldonMessage.from_ndarray(np.asarray(x))
+    m.meta.puid = puid  # responses echo the request puid
+    return m
+
+
+class TestShardedExecution:
+    def test_iris_one_sharded_dispatch_byte_parity(self):
+        from seldon_core_tpu.placement import unpublish
+
+        sharded = _deployment("pl-sharded", {
+            "seldon.io/graph-plan": "fused", MESH: "dp=4"})
+        fused = _deployment("pl-fused", {"seldon.io/graph-plan": "fused"})
+        walk = _deployment("pl-walk", {})
+        try:
+            plane = sharded.placement
+            assert plane is not None
+            seg = sharded.predictors[0].engine.plan.segments[0]
+            assert plane.sharded_segments == [seg.name]
+            assert seg.shard_parity == "verified"
+            assert seg.shard_rows == 4
+
+            x = np.random.RandomState(0).uniform(size=(64, 4)).astype(
+                "float32")
+            n0, s0 = seg.n_calls, seg.n_sharded_calls
+            a = sharded.predictors[0].engine.predict_sync(_msg(x))
+            assert seg.n_calls - n0 == 1
+            assert seg.n_sharded_calls - s0 == 1
+            bucket = next(iter(seg.shard_cost_by_bucket.values()))
+            assert bucket["parity"] == "verified"
+
+            b = fused.predictors[0].engine.predict_sync(_msg(x))
+            c = walk.predictors[0].engine.predict_sync(_msg(x))
+            assert a.to_dict() == b.to_dict() == c.to_dict()
+        finally:
+            unpublish("pl-sharded")
+
+    def test_dp1_mesh_never_arms_sharding(self):
+        from seldon_core_tpu.placement import unpublish
+
+        dep = _deployment("pl-dp1", {
+            "seldon.io/graph-plan": "fused", MESH: "dp=1"})
+        try:
+            assert dep.placement is not None
+            assert dep.placement.sharded_segments == []
+            out = dep.predictors[0].engine.predict_sync(
+                _msg(np.zeros((4, 4), np.float32)))
+            assert out.status is None or out.status.status == "SUCCESS"
+        finally:
+            unpublish("pl-dp1")
+
+    def test_parity_gate_never_serves_divergent_bytes(self):
+        """Whatever the XLA CPU backend decides about MNISTMLP's K=784
+        contraction at each batch size, the response must be byte-equal
+        to the walk — verified buckets serve sharded, failed buckets
+        fall back, and both paths are invisible on the wire."""
+        from seldon_core_tpu.placement import unpublish
+
+        sharded = _deployment("pl-mlp", {
+            "seldon.io/graph-plan": "fused", MESH: "dp=4"},
+            model_class=MLP, node_name="mlp")
+        walk = _deployment("pl-mlp-walk", {}, model_class=MLP,
+                           node_name="mlp")
+        try:
+            seg = sharded.predictors[0].engine.plan.segments[0]
+            x = np.random.RandomState(1).uniform(
+                size=(64, 784)).astype("float32")
+            a = sharded.predictors[0].engine.predict_sync(_msg(x))
+            b = walk.predictors[0].engine.predict_sync(_msg(x))
+            assert a.to_dict() == b.to_dict()
+            if seg.name in sharded.placement.sharded_segments:
+                # the bucket gate recorded an explicit verdict either way
+                bucket = next(iter(seg.shard_cost_by_bucket.values()))
+                assert bucket["parity"] in ("verified", "failed")
+        finally:
+            unpublish("pl-mlp")
+
+
+# ---------------------------------------------------------------------------
+# batcher shard_rows
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_shard_rows_rounds_buckets():
+    from seldon_core_tpu.runtime.batcher import BatcherConfig, DynamicBatcher
+
+    b = DynamicBatcher(lambda x: x, BatcherConfig(
+        max_batch_size=32, buckets=[1, 2, 6, 32], shard_rows=4))
+    assert b.bucket_for(1) == 4    # 1 → pad to the dp span
+    assert b.bucket_for(3) == 8    # bucket 6 → next multiple of 4
+    assert b.bucket_for(7) == 32   # already a multiple
+    # off by default: buckets untouched
+    b1 = DynamicBatcher(lambda x: x, BatcherConfig(
+        max_batch_size=32, buckets=[1, 2, 6, 32]))
+    assert b1.bucket_for(3) == 6
+
+
+# ---------------------------------------------------------------------------
+# admin + status surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_placement_body_disabled_404(self):
+        from seldon_core_tpu.placement.http import placement_body
+
+        status, payload = placement_body(None, {})
+        assert status == 404
+        assert "seldon.io/mesh" in payload["hint"]
+
+    def test_placement_body_reports_every_segment(self):
+        from seldon_core_tpu.placement import unpublish
+        from seldon_core_tpu.placement.http import placement_body
+
+        dep = _deployment("pl-http", {
+            "seldon.io/graph-plan": "fused", MESH: "dp=4"})
+        try:
+            status, payload = placement_body(dep.placement, {})
+            assert status == 200
+            segs = {s["segment"]: s["devices"] for s in payload["segments"]}
+            assert set(segs) == {
+                s.name for s in dep.predictors[0].engine.plan.segments}
+            assert all(segs.values())
+            assert payload["mesh"] == "dp=4"
+            assert "dp=4" in payload["meshes"]
+
+            status, payload = placement_body(dep.placement, {"meshes": "1"})
+            assert status == 200 and set(payload) == {"meshes"}
+        finally:
+            unpublish("pl-http")
+
+    def test_registry_publish_snapshot_unpublish(self):
+        from seldon_core_tpu.placement import snapshot, unpublish
+
+        dep = _deployment("pl-reg", {
+            "seldon.io/graph-plan": "fused", MESH: "dp=2,tp=2"})
+        try:
+            snap = snapshot("pl-reg")
+            assert snap is not None
+            pred = snap["predictors"][0]
+            assert pred["mesh"] == "dp=2,tp=2"
+            assert pred["devices"] == 4
+            assert all(pred["segments"].values())
+        finally:
+            unpublish("pl-reg")
+        assert snapshot("pl-reg") is None
+
+    def test_disabled_deployment_stays_unpublished(self):
+        from seldon_core_tpu.placement import snapshot
+
+        dep = _deployment("pl-off", {})
+        assert dep.placement is None
+        assert snapshot("pl-off") is None
+
+    def test_snapshot_shields_provider_errors(self):
+        from seldon_core_tpu.placement import publish, snapshot, unpublish
+
+        def boom():
+            raise RuntimeError("provider died")
+
+        publish("pl-boom", boom)
+        try:
+            assert snapshot("pl-boom") is None
+        finally:
+            unpublish("pl-boom")
+
+    def test_placement_probe_reports_device_bytes(self):
+        from seldon_core_tpu.health import placement_probe
+        from seldon_core_tpu.placement import unpublish
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+        dep = _deployment("pl-probe", {
+            "seldon.io/graph-plan": "fused", MESH: "dp=4"})
+        try:
+            reg = MetricsRegistry()
+            dep.predictors[0].engine.predict_sync(
+                _msg(np.zeros((8, 4), np.float32)))
+            sample = placement_probe(dep.placement, metrics=reg)()
+            assert sample["placement_devices"] == 4.0
+            assert sample["placement_segments_sharded"] >= 1.0
+            assert sample["placement_sharded_dispatches"] >= 1.0
+            rendered = reg.render()
+            assert "seldon_runtime_placement_device_bytes{" in rendered
+        finally:
+            unpublish("pl-probe")
