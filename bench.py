@@ -1691,6 +1691,340 @@ def shard_smoke() -> int:
     return 1 if failures else 0
 
 
+def _fleet_bench_spec(name: str, extra_ann: dict = None):
+    """A single-node MNISTMLP SeldonDeployment spec for LocalFleet —
+    batching off so every HTTP request is one engine invocation (the
+    fleet drills count forwards and cache hits per request)."""
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    return SeldonDeployment.from_dict({
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "annotations": {
+            "seldon.io/batching": "false",
+            **(extra_ann or {}),
+        }},
+        "spec": {"predictors": [{
+            "name": "p", "replicas": 3,
+            "graph": {
+                "name": "clf", "type": "MODEL",
+                "parameters": [{
+                    "name": "model_class",
+                    "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+                    "type": "STRING",
+                }],
+                "children": [],
+            },
+            "componentSpecs": [],
+        }]},
+    })
+
+
+def fleet_smoke() -> int:
+    """Fast CI gate for the fleet plane (CPU-only, docs/scale-out.md):
+    (1) failover — 3 in-process replicas behind one gateway, one killed
+        mid-drill: every admitted request still answers 200 (goodput
+        >= 95%% post-kill; the dead replica costs one failed connect,
+        not a 503) and the gateway's ``/admin/fleet`` shows it ejected,
+    (2) least-loaded routing demonstrably shifts traffic away from a
+        chaos-slowed replica (per-replica forward skew at
+        ``/admin/fleet``),
+    (3) consistent-hash routing keeps engine-tier cache locality on a
+        Zipfian workload: aggregate hit-rate >= 2x round-robin under the
+        same per-replica byte budget, and within 10%% of a single
+        replica (scale-out must not cost cache efficiency),
+    (4) the autoscaler scales 1 -> 3 when demand runs at 2x capacity
+        (the drill signal the profiling plane's ``/admin/profile/
+        capacity`` feeds in production) and back down only after the
+        cooldown.
+    Returns a process exit code."""
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    failures: list[str] = []
+    report: dict = {}
+
+    # Zipfian key schedule, deterministic: 62 distinct bodies; per cycle
+    # the head keys repeat with frequencies 8/4/3/2/2 and the tail once
+    # (~1/k). Cycle length 76 = 1 (mod 3) so under round-robin every key
+    # changes replica each cycle — the LRU-hostile pattern consistent
+    # hashing exists to fix.
+    K = 62
+    cycle = list(range(K)) + [0] * 7 + [1] * 3 + [2] * 2 + [3] + [4]
+    CYCLES = 6
+    schedule = cycle * CYCLES
+    bodies = [
+        json.dumps(SeldonMessage.from_ndarray(
+            np.full((1, 784), (k + 1) / K, np.float32)).to_dict()
+        ).encode()
+        for k in range(K)
+    ]
+
+    async def drive(sess, base: str, token: str, keys,
+                    concurrency: int = 1) -> list[int]:
+        """POST one prediction per key: sequential by default; a
+        continuous semaphore-limited stream for the load-skew drill (a
+        wave barrier would reset every replica's in-flight count between
+        waves and erase the least-loaded signal)."""
+        headers = {"Authorization": f"Bearer {token}",
+                   "Content-Type": "application/json"}
+
+        async def one(k: int) -> int:
+            async with sess.post(f"{base}/api/v0.1/predictions",
+                                 data=bodies[k], headers=headers) as resp:
+                await resp.read()
+                return resp.status
+        if concurrency == 1:
+            return [await one(k) for k in keys]
+        sem = asyncio.Semaphore(concurrency)
+
+        async def gated(k: int) -> int:
+            async with sem:
+                return await one(k)
+        return list(await asyncio.gather(*(gated(k) for k in keys)))
+
+    async def run_all() -> dict:
+        import aiohttp
+        from aiohttp import web
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+        from seldon_core_tpu.operator.local import LocalFleet
+        from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+
+        store = DeploymentStore()
+        gw = Gateway(store)
+        gw_runner = web.AppRunner(gw.build_app(), access_log=None)
+        await gw_runner.setup()
+        await web.TCPSite(gw_runner, "127.0.0.1", 0).start()
+        base = f"http://127.0.0.1:{gw_runner.addresses[0][1]}"
+        out: dict = {}
+        fleets: list = []
+
+        try:
+            async with aiohttp.ClientSession() as sess:
+
+                async def record(name: str, urls, ann=None) -> str:
+                    """Register the deployment (its name doubles as the
+                    oauth client key) and mint its bearer token."""
+                    store.put(DeploymentRecord(
+                        name=name, oauth_key=name, oauth_secret="s",
+                        engine_urls=tuple(urls),
+                        annotations=dict(ann or {})))
+                    async with sess.post(
+                        f"{base}/oauth/token",
+                        data={"grant_type": "client_credentials"},
+                        auth=aiohttp.BasicAuth(name, "s"),
+                    ) as resp:
+                        return (await resp.json())["access_token"]
+
+                # ---- (1) failover: kill one of three mid-drill -------
+                fl = await LocalFleet(_fleet_bench_spec("fleet-kill"),
+                                      replicas=3).start()
+                fleets.append(fl)
+                token = await record("fleet-kill", fl.urls(),
+                                     {"seldon.io/fleet-replicas": "3"})
+                pre = await drive(sess, base, token, [0] * 24)
+                await fl.kill(0)
+                post = await drive(sess, base, token, [0] * 36)
+                async with sess.get(
+                    f"{base}/admin/fleet?deployment=fleet-kill"
+                ) as resp:
+                    out["kill_fleet"] = (resp.status, await resp.json())
+                out["kill_pre"] = pre
+                out["kill_post"] = post
+                out["gw_metrics"] = gw.registry.render()
+
+                # ---- (2) least-loaded skew off a chaos-slowed replica
+                def slow_first(idx, handle):
+                    if idx == 0:
+                        return ChaosWrapper(handle,
+                                            ChaosPolicy(latency_ms=150.0))
+                    return handle
+
+                fl = await LocalFleet(_fleet_bench_spec("fleet-slow"),
+                                      replicas=3,
+                                      component_wrap=slow_first).start()
+                fleets.append(fl)
+                token = await record("fleet-slow", fl.urls(),
+                                     {"seldon.io/fleet-replicas": "3"})
+                out["slow_statuses"] = await drive(
+                    sess, base, token, [0] * 48, concurrency=12)
+                async with sess.get(
+                    f"{base}/admin/fleet?deployment=fleet-slow"
+                ) as resp:
+                    out["slow_fleet"] = (resp.status, await resp.json())
+
+                # ---- (3) cache locality: single vs CH vs RR ----------
+                cache_ann = {"seldon.io/prediction-cache": "true"}
+                arms: dict = {}
+                fl = await LocalFleet(
+                    _fleet_bench_spec("fleet-one", cache_ann),
+                    replicas=1).start()
+                fleets.append(fl)
+                token = await record("fleet-one", fl.urls())
+                arms["single"] = {
+                    "statuses": await drive(sess, base, token, schedule),
+                    "caches": [r["local"].predictors[0].cache.stats
+                               for r in fl.replicas()],
+                }
+                # size the bounded arms off the observed entry size: 50
+                # entries per replica holds any replica's consistent-hash
+                # arc of the 62 keys, but NOT the full working set the
+                # round-robin scatter forces through every replica
+                st = arms["single"]["caches"][0]
+                entry_bytes = max(1, st["bytes"] // max(1, st["entries"]))
+                budget = str(entry_bytes * 50)
+                for arm, policy in (("ch", "consistent-hash"),
+                                    ("rr", "round-robin")):
+                    fl = await LocalFleet(
+                        _fleet_bench_spec(
+                            f"fleet-{arm}",
+                            {**cache_ann,
+                             "seldon.io/prediction-cache-bytes": budget}),
+                        replicas=3).start()
+                    fleets.append(fl)
+                    token = await record(f"fleet-{arm}", fl.urls(), {
+                        "seldon.io/fleet-replicas": "3",
+                        "seldon.io/fleet-policy": policy})
+                    arms[arm] = {
+                        "statuses": await drive(sess, base, token,
+                                                schedule),
+                        "caches": [r["local"].predictors[0].cache.stats
+                                   for r in fl.replicas()],
+                    }
+                out["arms"] = arms
+                out["cache_budget_bytes"] = int(budget)
+
+                # ---- (4) autoscale 1 -> 3 -> 1 ------------------------
+                fl = await LocalFleet(_fleet_bench_spec("fleet-auto", {
+                    "seldon.io/fleet-replicas": "1",
+                    "seldon.io/fleet-autoscale": "true",
+                    "seldon.io/fleet-max-replicas": "3",
+                    "seldon.io/fleet-cooldown-s": "0.2",
+                })).start()
+                fleets.append(fl)
+                auto: dict = {"boot": len(fl)}
+                # the 2x-capacity drill signal (in production summed from
+                # each replica's /admin/profile/capacity)
+                d = await fl.autoscale_tick(
+                    {"demandRps": 20.0, "capacityRps": 10.0})
+                auto["up"] = {**d.to_dict(), "replicas": len(fl)}
+                d = await fl.autoscale_tick(
+                    {"demandRps": 1.0, "capacityRps": 30.0})
+                auto["held"] = {**d.to_dict(), "replicas": len(fl)}
+                await asyncio.sleep(0.25)
+                d = await fl.autoscale_tick(
+                    {"demandRps": 1.0, "capacityRps": 30.0})
+                auto["down"] = {**d.to_dict(), "replicas": len(fl)}
+                out["autoscale"] = auto
+        finally:
+            for fl in fleets:
+                await fl.stop()
+            await gw.close()
+            await gw_runner.cleanup()
+        return out
+
+    r = asyncio.run(run_all())
+
+    # -- (1) failover gates ---------------------------------------------
+    post_ok = sum(1 for s in r["kill_post"] if s == 200)
+    goodput = post_ok / len(r["kill_post"])
+    report["failover"] = {
+        "pre_ok": sum(1 for s in r["kill_pre"] if s == 200),
+        "post_ok": post_ok, "post_total": len(r["kill_post"]),
+        "goodput": round(goodput, 4),
+    }
+    if any(s != 200 for s in r["kill_pre"]):
+        failures.append(f"warmup requests failed: {r['kill_pre']}")
+    if goodput < 0.95:
+        failures.append(
+            f"post-kill goodput {goodput:.2%} < 95% — the replica kill "
+            "lost admitted requests")
+    status, snap = r["kill_fleet"]
+    states = {rep["replica"]: rep["state"] for rep in snap.get("replicas", [])}
+    report["failover"]["states"] = states
+    if status != 200:
+        failures.append(f"/admin/fleet answered {status} after the kill")
+    elif states.get("r0") not in ("ejected", "probing"):
+        failures.append(f"killed replica r0 not health-gated out: {states}")
+    if "seldon_fleet_ejections_total" not in r["gw_metrics"]:
+        failures.append("no seldon_fleet_ejections_total series in the "
+                        "gateway exposition after a replica kill")
+
+    # -- (2) least-loaded skew gates --------------------------------------
+    status, snap = r["slow_fleet"]
+    fwd = {rep["replica"]: rep["forwards"]
+           for rep in snap.get("replicas", [])}
+    total = sum(fwd.values()) or 1
+    report["least_loaded"] = {"forwards": fwd,
+                              "slow_share": round(fwd.get("r0", 0) / total,
+                                                  3)}
+    if any(s != 200 for s in r["slow_statuses"]):
+        failures.append("least-loaded drill had non-200 responses")
+    if status != 200:
+        failures.append(f"/admin/fleet answered {status} for fleet-slow")
+    elif not (fwd.get("r0", 0) < fwd.get("r1", 0)
+              and fwd.get("r0", 0) < fwd.get("r2", 0)):
+        failures.append(
+            f"least-loaded did not shift traffic off the slowed replica: "
+            f"{fwd}")
+    elif fwd.get("r0", 0) / total > 0.30:
+        failures.append(
+            f"slowed replica still took {fwd['r0'] / total:.0%} of "
+            f"forwards — EWMA load signal too weak: {fwd}")
+
+    # -- (3) cache locality gates -----------------------------------------
+    rates: dict = {}
+    for arm, data in r["arms"].items():
+        hits = sum(c["hits"] for c in data["caches"])
+        misses = sum(c["misses"] for c in data["caches"])
+        rates[arm] = hits / max(1, hits + misses)
+        if any(s != 200 for s in data["statuses"]):
+            failures.append(f"cache arm {arm!r} had non-200 responses")
+    report["cache"] = {
+        "requests": len(schedule), "distinct_keys": K,
+        "budget_bytes": r["cache_budget_bytes"],
+        "hit_rates": {a: round(v, 4) for a, v in rates.items()},
+    }
+    if rates.get("single", 0) <= 0.5:
+        failures.append(
+            f"single-replica hit rate {rates.get('single', 0):.2%} — the "
+            "engine cache never engaged; the locality comparison is void")
+    if rates.get("ch", 0) < 2 * rates.get("rr", 1):
+        failures.append(
+            f"consistent-hash hit rate {rates.get('ch', 0):.2%} < 2x "
+            f"round-robin {rates.get('rr', 0):.2%} on the Zipfian "
+            "workload")
+    if rates.get("ch", 0) < 0.9 * rates.get("single", 1):
+        failures.append(
+            f"consistent-hash hit rate {rates.get('ch', 0):.2%} more than "
+            f"10% below single-replica {rates.get('single', 0):.2%} — "
+            "scale-out lost cache locality")
+
+    # -- (4) autoscale gates ----------------------------------------------
+    auto = r["autoscale"]
+    report["autoscale"] = auto
+    if auto["boot"] != 1:
+        failures.append(f"autoscale fleet booted {auto['boot']} replicas, "
+                        "expected 1")
+    if auto["up"]["replicas"] != 3 or auto["up"]["desired"] != 3:
+        failures.append(f"2x-capacity drill did not scale 1 -> 3: "
+                        f"{auto['up']}")
+    if auto["held"]["replicas"] != 3:
+        failures.append(f"scale-down ignored the cooldown: {auto['held']}")
+    if auto["down"]["replicas"] != 1:
+        failures.append(f"fleet did not scale back down after cooldown: "
+                        f"{auto['down']}")
+
+    print(json.dumps({"fleet_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 def bench_sharded_throughput(seconds: float = 2.0) -> dict:
     """dp=1 vs dp=4 sharded-dispatch microbench on the Iris fused
     segment (64-row batches).  On forced-host-device CPU the dp=4 path
@@ -3054,6 +3388,17 @@ def main() -> None:
                          "executed bucket total, and the host sampler "
                          "stays within the p50 overhead budget; then "
                          "exit")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="fast CI gate: 3 in-process engine replicas "
+                         "behind one gateway — a replica kill mid-drill "
+                         "keeps goodput >= 95%% with the dead replica "
+                         "ejected at /admin/fleet, least-loaded routing "
+                         "shifts traffic off a chaos-slowed replica, "
+                         "consistent-hash keeps Zipfian cache hit-rate "
+                         ">= 2x round-robin and within 10%% of a single "
+                         "replica, and the autoscaler goes 1 -> 3 under "
+                         "a 2x-capacity drill and back down after the "
+                         "cooldown; then exit")
     ap.add_argument("--shard-smoke", action="store_true",
                     help="fast CI gate (XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8): "
@@ -3078,6 +3423,8 @@ def main() -> None:
         sys.exit(health_smoke())
     if args.profile_smoke:
         sys.exit(profile_smoke())
+    if args.fleet_smoke:
+        sys.exit(fleet_smoke())
     if args.shard_smoke:
         sys.exit(shard_smoke())
     if os.environ.get("JAX_PLATFORMS"):

@@ -94,6 +94,11 @@ PLACEMENT_UNKNOWN_SEGMENT = "GL1203"  # override names no fused segment
 PLACEMENT_HBM_INFEASIBLE = "GL1204"  # per-device HBM exceeds the GL3xx budget
 PLACEMENT_CONFIG_REPORT = "GL1205"  # placement report: mesh + assignments
 PLACEMENT_WITHOUT_MESH = "GL1206"   # placement overrides set, mesh absent
+FLEET_ANNOTATION_INVALID = "GL1301"  # seldon.io/fleet-* value invalid
+FLEET_KNOBS_WITHOUT_FLEET = "GL1302"  # fleet knobs set, fleet-replicas absent
+FLEET_AUTOSCALE_BLIND = "GL1303"    # autoscale on, no health/profile signals
+FLEET_REPLICAS_MISMATCH = "GL1304"  # fleet-replicas != predictor replicas
+FLEET_CONFIG_REPORT = "GL1305"      # fleet report: effective config
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -148,6 +153,11 @@ CODE_SEVERITY = {
     PLACEMENT_HBM_INFEASIBLE: ERROR,
     PLACEMENT_CONFIG_REPORT: INFO,
     PLACEMENT_WITHOUT_MESH: WARN,
+    FLEET_ANNOTATION_INVALID: ERROR,
+    FLEET_KNOBS_WITHOUT_FLEET: WARN,
+    FLEET_AUTOSCALE_BLIND: WARN,
+    FLEET_REPLICAS_MISMATCH: WARN,
+    FLEET_CONFIG_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

@@ -38,6 +38,11 @@ from seldon_core_tpu.analysis.findings import (
     DEADLINE_INFEASIBLE,
     DTYPE_MISMATCH,
     DUPLICATE_NAME,
+    FLEET_ANNOTATION_INVALID,
+    FLEET_AUTOSCALE_BLIND,
+    FLEET_CONFIG_REPORT,
+    FLEET_KNOBS_WITHOUT_FLEET,
+    FLEET_REPLICAS_MISMATCH,
     GRAPH_CYCLE,
     HBM_NEAR_BUDGET,
     HBM_OVER_BUDGET,
@@ -182,6 +187,7 @@ def lint_graph(
         findings.extend(_health_pass(unit, ann, path_prefix))
         findings.extend(_profile_pass(unit, ann, path_prefix))
         findings.extend(_placement_pass(unit, ann, path_prefix))
+        findings.extend(_fleet_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -202,7 +208,35 @@ def lint_deployment(dep: Any) -> list[Finding]:
     for p in dep.predictors:
         ann = {**dep.annotations, **p.annotations}
         findings.extend(lint_graph(p.graph, ann, path_prefix=p.name))
+        findings.extend(_fleet_replicas_check(p, ann))
     return findings
+
+
+def _fleet_replicas_check(p: Any, ann: dict) -> list[Finding]:
+    """GL1304: ``seldon.io/fleet-replicas`` disagreeing with the
+    predictor's ``replicas`` field means the gateway pool and the
+    compiled workload will run DIFFERENT sizes — the pool routes over
+    phantom (or missing) members until reconcile converges.  Deployment
+    scope only: lint_graph has no predictor spec to compare against."""
+    from seldon_core_tpu.fleet import (
+        FLEET_REPLICAS_ANNOTATION,
+        fleet_config_from_annotations,
+    )
+
+    if FLEET_REPLICAS_ANNOTATION not in ann:
+        return []
+    try:
+        cfg = fleet_config_from_annotations(ann, "lint")
+    except ValueError:
+        return []  # GL1301 (in _fleet_pass) already reported it
+    if not cfg.enabled or cfg.replicas == p.replicas:
+        return []
+    return [make_finding(
+        FLEET_REPLICAS_MISMATCH, _join(p.name, p.graph.name),
+        f"{FLEET_REPLICAS_ANNOTATION}={cfg.replicas} but the predictor "
+        f"declares replicas={p.replicas} — the gateway pool and the "
+        "compiled workload would disagree on fleet size",
+    )]
 
 
 # ---------------------------------------------------------------------------
@@ -1181,6 +1215,68 @@ def _placement_pass(root: PredictiveUnit, ann: dict,
         detail += ("; graph-plan is not 'fused' — no segments to place "
                    "until it is")
     findings.append(make_finding(PLACEMENT_CONFIG_REPORT, path0, detail))
+    return findings
+
+
+def _fleet_pass(root: PredictiveUnit, ann: dict,
+                prefix: str) -> list[Finding]:
+    """Fleet-plane admission (GL13xx, active when any ``seldon.io/fleet-*``
+    annotation is set): validates the family through the same parser the
+    gateway and operator use (GL1301), warns when routing/autoscale knobs
+    are set without ``seldon.io/fleet-replicas`` — they are dead without
+    the pool (GL1302) — and when autoscale is on but neither the health
+    plane nor the profiling plane is, leaving the scaler blind to burn
+    and demand signals (GL1303), and reports the effective config
+    (GL1305).  GL1304 (replicas vs predictor spec) runs at deployment
+    scope in lint_deployment."""
+    from seldon_core_tpu.fleet import (
+        FLEET_AUTOSCALE_ANNOTATION,
+        FLEET_REPLICAS_ANNOTATION,
+        fleet_config_from_annotations,
+    )
+
+    fleet_keys = [k for k in ann if k.startswith("seldon.io/fleet-")]
+    if not fleet_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = fleet_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(FLEET_ANNOTATION_INVALID, path0, str(e))]
+    if not cfg.enabled:
+        return [make_finding(
+            FLEET_KNOBS_WITHOUT_FLEET, path0,
+            f"{', '.join(sorted(fleet_keys))} set but "
+            f"{FLEET_REPLICAS_ANNOTATION} is absent — without a replica "
+            "count there is no pool and the knobs have no effect",
+        )]
+    findings: list[Finding] = []
+    if cfg.autoscale:
+        health_on = any(
+            k.startswith("seldon.io/health") or k == "seldon.io/slo-availability"
+            for k in ann
+        )
+        profile_on = any(k.startswith("seldon.io/profile") for k in ann)
+        if not health_on and not profile_on:
+            findings.append(make_finding(
+                FLEET_AUTOSCALE_BLIND, path0,
+                f"{FLEET_AUTOSCALE_ANNOTATION} is on but neither the "
+                "health plane (seldon.io/health / slo-availability) nor "
+                "the profiling plane (seldon.io/profile) is — the "
+                "autoscaler has no burn or demand signal and will only "
+                "ever hold",
+            ))
+    detail = (
+        f"fleet plane on: {cfg.replicas} replica(s), policy "
+        f"{cfg.policy!r}, autoscale "
+        f"{'on' if cfg.autoscale else 'off'}"
+    )
+    if cfg.autoscale:
+        detail += (
+            f" (bounds [{cfg.min_replicas}, {cfg.max_replicas}], "
+            f"cooldown {cfg.cooldown_s:g}s)"
+        )
+    findings.append(make_finding(FLEET_CONFIG_REPORT, path0, detail))
     return findings
 
 

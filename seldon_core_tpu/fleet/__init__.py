@@ -1,0 +1,67 @@
+"""Fleet plane: a multi-replica engine pool behind one gateway.
+
+Scale-out data plane (docs/scale-out.md, ROADMAP item 3): the gateway's
+single ``engine_url`` becomes a :class:`ReplicaPool` with health-gated
+membership, pluggable routing policies (least-loaded / consistent-hash /
+round-robin + SSE session affinity), retry-next-replica on connection
+failure, and an operator autoscale loop driven by the SLO burn-rate and
+attributed-FLOP capacity signals the observability planes publish.
+"""
+
+from seldon_core_tpu.fleet.autoscale import (
+    AutoscaleDecision,
+    Autoscaler,
+    TARGET_UTILIZATION,
+)
+from seldon_core_tpu.fleet.config import (
+    FLEET_AUTOSCALE_ANNOTATION,
+    FLEET_COOLDOWN_ANNOTATION,
+    FLEET_MAX_ANNOTATION,
+    FLEET_MIN_ANNOTATION,
+    FLEET_POLICY_ANNOTATION,
+    FLEET_REPLICAS_ANNOTATION,
+    POLICIES,
+    FleetConfig,
+    fleet_config_from_annotations,
+)
+from seldon_core_tpu.fleet.http import fleet_body
+from seldon_core_tpu.fleet.pool import (
+    EJECTED,
+    HEALTHY,
+    PROBING,
+    Replica,
+    ReplicaPool,
+)
+from seldon_core_tpu.fleet.registry import (
+    clear,
+    publish,
+    snapshot,
+    unpublish,
+)
+from seldon_core_tpu.fleet.ring import HashRing
+
+__all__ = [
+    "AutoscaleDecision",
+    "Autoscaler",
+    "TARGET_UTILIZATION",
+    "FLEET_AUTOSCALE_ANNOTATION",
+    "FLEET_COOLDOWN_ANNOTATION",
+    "FLEET_MAX_ANNOTATION",
+    "FLEET_MIN_ANNOTATION",
+    "FLEET_POLICY_ANNOTATION",
+    "FLEET_REPLICAS_ANNOTATION",
+    "POLICIES",
+    "FleetConfig",
+    "fleet_config_from_annotations",
+    "fleet_body",
+    "EJECTED",
+    "HEALTHY",
+    "PROBING",
+    "Replica",
+    "ReplicaPool",
+    "HashRing",
+    "publish",
+    "unpublish",
+    "snapshot",
+    "clear",
+]

@@ -15,6 +15,7 @@ import asyncio
 import contextlib
 import json
 import logging
+import random
 import time
 from typing import Optional
 
@@ -26,6 +27,12 @@ from seldon_core_tpu.caching import (
     SingleFlight,
     config_from_annotations,
     raw_key,
+)
+from seldon_core_tpu.fleet import (
+    FleetConfig,
+    ReplicaPool,
+    fleet_body,
+    fleet_config_from_annotations,
 )
 from seldon_core_tpu.gateway.firehose import NullFirehose, make_firehose
 from seldon_core_tpu.gateway.oauth import OAuthProvider, default_token_store
@@ -64,6 +71,25 @@ def _shed_reason(body: bytes) -> str:
         return "UNKNOWN"
 
 WATCH_INTERVAL_S = 5.0  # reference @Scheduled(fixedDelay=5000)
+
+# retry backoff never sleeps longer than this regardless of how the
+# decorrelated jitter walks (the deadline budget caps it further)
+RETRY_BACKOFF_CAP_S = 1.0
+# at most one active health sweep over a deployment's replicas per window
+FLEET_PROBE_INTERVAL_S = 2.0
+# SSE session-affinity key: streams carrying it pin to one replica
+SESSION_HEADER = "X-Seldon-Session"
+
+
+def _decorrelated_backoff(rng: random.Random, base_s: float, prev_s: float,
+                          cap_s: float = RETRY_BACKOFF_CAP_S) -> float:
+    """Decorrelated-jitter backoff (Exponential Backoff And Jitter, AWS
+    architecture blog): ``sleep = min(cap, U(base, prev * 3))``.  Unlike
+    the plain ``base * 2**attempt`` ladder, concurrent retries against a
+    recovering engine spread out instead of arriving in synchronized
+    waves that knock it straight back over."""
+    hi = max(base_s, prev_s * 3.0)
+    return min(cap_s, rng.uniform(base_s, hi))
 
 
 class Gateway:
@@ -106,6 +132,13 @@ class Gateway:
         # Retry-After, in microseconds (the shed path never queues).
         # Keyed like _caches; rebuilt when the annotation changes.
         self._admission: dict[str, tuple[float, Optional[AdmissionController]]] = {}
+        # Fleet plane (docs/scale-out.md): one ReplicaPool per deployment
+        # whose record lists engine replicas (or sets seldon.io/fleet-*),
+        # keyed like _caches — rebuilt on annotation change, membership
+        # reconciled in place on URL-list change so stats survive.
+        self._pools: dict[str, tuple] = {}
+        self._retry_rng = random.Random()
+        self.fleet_probe_interval_s = FLEET_PROBE_INTERVAL_S
         # Distributed tracing (docs/observability.md): the gateway is the
         # ingress — it accepts inbound W3C traceparent or mints a fresh
         # 128-bit context with the head-sampling decision, opens the root
@@ -220,6 +253,12 @@ class Gateway:
                 sum(a.inflight for a in admissions))
             out["shed_level"] = float(
                 max(a.shed_level for a in admissions))
+        pools = [p for _, _, p in self._pools.values() if p is not None]
+        if pools:
+            out["fleet_replicas"] = float(
+                sum(len(p) for p in pools))
+            out["fleet_healthy"] = float(
+                sum(p.snapshot()["healthy"] for p in pools))
         return out
 
     # ------------------------------------------------------------------
@@ -277,6 +316,7 @@ class Gateway:
         app.router.add_get("/admin/profile/capacity",
                            self._handle_profile_capacity)
         app.router.add_get("/admin/placement", self._handle_placement)
+        app.router.add_get("/admin/fleet", self._handle_fleet)
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -501,18 +541,38 @@ class Gateway:
         through.  Persistent unreachability becomes the 503 FAILURE body
         (never cached: the caller only stores 200s).
 
+        With a fleet pool (docs/scale-out.md) each attempt picks a replica
+        under the routing policy, and a connection failure EXCLUDES the
+        observed replica and tries the next one — a dead replica costs one
+        failed connect, not three.  The failed replica is ejected from
+        pool membership and re-probed half-open-style.
+
         Retries live inside the request's deadline budget: each attempt's
-        timeout is the REMAINING budget (not a fixed per-attempt window),
-        and when backoff + a further attempt cannot fit, the retry is
-        skipped and the 504 answers immediately — three 30s attempts
-        against a 100ms deadline helped nobody."""
+        timeout is the REMAINING budget (not a fixed per-attempt window);
+        backoff uses decorrelated jitter so synchronized retry waves
+        spread out; and when backoff + a further attempt cannot fit, the
+        retry is skipped and the 504 answers immediately — three 30s
+        attempts against a 100ms deadline helped nobody."""
         sess = await self.session()
         deadline = qctx.deadline if qctx is not None else None
+        pool = self._dep_pool(rec)
+        route_key = (
+            raw_key(rec.name, path, body)
+            if pool is not None and pool.config.policy == "consistent-hash"
+            else None
+        )
+        if pool is not None and pool.probe_due(self.fleet_probe_interval_s):
+            # active health sweep, off this request's critical path
+            asyncio.get_running_loop().create_task(self._pool_probe(pool))
         last_err: Optional[Exception] = None
+        excluded: list[str] = []
         out_body, out_status = b"", 0
+        backoff = 0.0
         for attempt in range(self.retries + 1):
             if attempt:
-                backoff = self.retry_backoff_s * (2 ** (attempt - 1))
+                backoff = _decorrelated_backoff(
+                    self._retry_rng, self.retry_backoff_s, backoff
+                )
                 if (deadline is not None
                         and deadline.remaining_s() <= backoff):
                     # budget exhausted: the retry could never answer in
@@ -530,6 +590,12 @@ class Gateway:
                     "seldon_api_gateway_retries_total",
                     {"deployment": rec.name, "path": path},
                 )
+            url = rec.engine_url
+            replica = None
+            if pool is not None:
+                replica = pool.pick(key=route_key, exclude=excluded)
+                if replica is not None:
+                    url = replica.url
             hop_headers = {"Content-Type": content_type}
             kwargs = {}
             if qctx is not None:
@@ -548,9 +614,11 @@ class Gateway:
                                     "gateway"}}
                     ).encode()
                 kwargs["timeout"] = aiohttp.ClientTimeout(total=rem)
+            if replica is not None:
+                pool.acquire(replica)
             try:
                 async with sess.post(
-                    rec.engine_url.rstrip("/") + path,
+                    url.rstrip("/") + path,
                     data=body,
                     headers=hop_headers,
                     **kwargs,
@@ -558,14 +626,24 @@ class Gateway:
                     out_body = await resp.read()
                     out_status = resp.status
                 last_err = None
+                if replica is not None:
+                    pool.release(replica, ok=out_status < 500)
                 break
             except aiohttp.ClientConnectorError as e:
                 # connection never established — the request cannot have
-                # reached the engine, so replaying it is safe
+                # reached the engine, so replaying it is safe; a pooled
+                # replica is excluded for this request AND ejected from
+                # membership (half-open re-probe readmits it)
                 last_err = e
+                if replica is not None:
+                    pool.release(replica, ok=False)
+                    pool.eject(replica, "connect-error")
+                    excluded.append(replica.url)
             except asyncio.TimeoutError:
                 # the deadline budget expired mid-forward: the engine may
                 # still be computing, but the answer is already worthless
+                if replica is not None:
+                    pool.release(replica, ok=False)
                 return 504, json.dumps(
                     {"status": {
                         "code": 504, "status": "FAILURE",
@@ -578,6 +656,8 @@ class Gateway:
                 # executed the (non-idempotent) request before dying — a
                 # replay could e.g. apply a MAB feedback reward twice
                 last_err = e
+                if replica is not None:
+                    pool.release(replica, ok=False)
                 break
         if last_err is not None:
             return 503, json.dumps(
@@ -637,6 +717,76 @@ class Gateway:
         self._caches[rec.name] = cache
         return cache
 
+    def _dep_pool(self, rec) -> Optional["ReplicaPool"]:
+        """The deployment's replica pool, built (and rebuilt on annotation
+        or membership change) from its ``seldon.io/fleet-*`` annotations
+        and the record's ``engine_urls``.  Invalid values log once and
+        route with defaults — the gateway must keep serving; admission
+        (GL1301) rejects them upstream.  Single-URL records without fleet
+        annotations return None: the legacy direct-forward path."""
+        urls = rec.urls
+        try:
+            cfg = fleet_config_from_annotations(rec.annotations, rec.name)
+        except ValueError as e:
+            cur = self._pools.get(rec.name)
+            if cur is None or cur[0] is not None:
+                logger.warning("deployment %s: %s — fleet defaults in "
+                               "effect", rec.name, e)
+            cfg = None
+        effective = cfg if cfg is not None else FleetConfig(enabled=True)
+        if len(urls) <= 1 and not effective.enabled:
+            self._pools.pop(rec.name, None)
+            return None
+        cur = self._pools.get(rec.name)
+        if cur is not None and cur[0] == cfg:
+            pool = cur[2]
+            if cur[1] != urls:
+                pool.set_members(urls)
+                self._pools[rec.name] = (cfg, urls, pool)
+            return pool
+        pool = ReplicaPool(
+            rec.name, config=effective, members=urls,
+            metrics=self.registry,
+        )
+        self._pools[rec.name] = (cfg, urls, pool)
+        return pool
+
+    async def _pool_probe(self, pool: "ReplicaPool") -> None:
+        """Active health sweep: every member's ``/admin/health`` verdict
+        (breaker state rides along) and ``/admin/profile/capacity``
+        headroom feed the pool's eject/readmit and least-loaded scoring.
+        Replicas that refuse the connection are ejected; half-open
+        re-probes readmit them once the verdict clears.  Best-effort per
+        replica — a probe failure must never take the data path down."""
+        sess = await self.session()
+        timeout = aiohttp.ClientTimeout(total=2)
+        for rep in pool.replicas():
+            base = rep.url.rstrip("/")
+            try:
+                async with sess.get(base + "/admin/health",
+                                    timeout=timeout) as resp:
+                    if resp.status == 200:
+                        payload = await resp.json()
+                        pool.note_verdict(
+                            rep.url,
+                            payload.get("verdict", ""),
+                            payload.get("openBreakers") or (),
+                        )
+            except (aiohttp.ClientConnectorError, asyncio.TimeoutError):
+                pool.eject(rep, "probe-failed")
+                continue
+            except (aiohttp.ClientError, ValueError):
+                pass  # plane off / malformed body: no verdict signal
+            try:
+                async with sess.get(base + "/admin/profile/capacity",
+                                    timeout=timeout) as resp:
+                    if resp.status == 200:
+                        payload = await resp.json()
+                        pool.note_headroom(rep.url,
+                                           payload.get("headroom"))
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                pass  # capacity signal is optional for routing
+
     async def _handle_predict(self, request: web.Request) -> web.Response:
         return await self._forward(request, "/api/v0.1/predictions")
 
@@ -663,23 +813,43 @@ class Gateway:
             )
         body = await request.read()
         sess = await self.session()
+        # session affinity: SSE consumers resume against the SAME replica
+        # (KV/stream state is replica-local); the session key is the
+        # caller-provided header, falling back to the oauth principal
+        pool = self._dep_pool(rec)
+        session_key = request.headers.get(SESSION_HEADER) or principal
         # pre-connection retry, same safety argument as _forward: a
         # ClientConnectorError provably never reached the engine
         last_err: Optional[Exception] = None
+        excluded: list[str] = []
+        backoff = 0.0
         try:
             for attempt in range(self.retries + 1):
                 if attempt:
-                    await asyncio.sleep(
-                        self.retry_backoff_s * (2 ** (attempt - 1))
+                    backoff = _decorrelated_backoff(
+                        self._retry_rng, self.retry_backoff_s, backoff
                     )
+                    await asyncio.sleep(backoff)
                     self.registry.counter_inc(
                         "seldon_api_gateway_retries_total",
                         {"deployment": rec.name, "path": "/api/v0.1/stream"},
                     )
+                url = rec.engine_url
+                replica = None
+                if pool is not None:
+                    replica = pool.pick(session=session_key,
+                                        exclude=excluded)
+                    if replica is not None:
+                        url = replica.url
                 try:
-                    return await self._relay_stream(request, rec, sess, body)
+                    return await self._relay_stream(
+                        request, rec, sess, body, url
+                    )
                 except aiohttp.ClientConnectorError as e:
                     last_err = e
+                    if replica is not None:
+                        pool.eject(replica, "connect-error")
+                        excluded.append(replica.url)
             return web.json_response(
                 {"status": {"code": 503, "status": "FAILURE",
                             "info": f"engine unreachable: {last_err}"}},
@@ -695,10 +865,11 @@ class Gateway:
                 {"deployment": rec.name, "path": "/api/v0.1/stream"},
             )
 
-    async def _relay_stream(self, request, rec, sess, body) -> web.StreamResponse:
+    async def _relay_stream(self, request, rec, sess, body,
+                            url: str = "") -> web.StreamResponse:
         try:
             async with sess.post(
-                rec.engine_url.rstrip("/") + "/api/v0.1/stream",
+                (url or rec.engine_url).rstrip("/") + "/api/v0.1/stream",
                 data=body,
                 headers={"Content-Type": request.headers.get(
                     "Content-Type", "application/json")},
@@ -868,6 +1039,27 @@ class Gateway:
 
         try:
             status, payload = placement_body(self.placement, request.query)
+        except ValueError:
+            return web.json_response(
+                {"error": "numeric query parameter expected"}, status=400
+            )
+        return web.json_response(payload, status=status)
+
+    async def _handle_fleet(self, request: web.Request) -> web.Response:
+        """Per-replica fleet view of every pooled deployment: membership,
+        health state, in-flight load, hash-ring arcs, session bindings.
+        ``?deployment=name`` narrows to one pool."""
+        # pools materialize lazily on first forward; build them here too so
+        # the admin view reflects the store even before traffic arrives
+        for name in self.store.names():
+            rec = self.store.by_name(name)
+            if rec is not None:
+                self._dep_pool(rec)
+        try:
+            status, payload = fleet_body(
+                {name: entry[2] for name, entry in self._pools.items()},
+                request.query,
+            )
         except ValueError:
             return web.json_response(
                 {"error": "numeric query parameter expected"}, status=400

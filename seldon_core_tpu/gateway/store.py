@@ -27,6 +27,23 @@ class DeploymentRecord:
     engine_url: str = ""          # REST base, e.g. http://dep-name:8000
     engine_grpc: str = ""         # gRPC target, e.g. dep-name:5001
     annotations: dict = field(default_factory=dict)
+    # fleet plane (docs/scale-out.md): every engine replica's REST base.
+    # With one entry (or empty) the record behaves exactly as before —
+    # engine_url stays the single source of truth for N=1 callers.
+    engine_urls: tuple = ()
+
+    def __post_init__(self):
+        self.engine_urls = tuple(self.engine_urls)
+        if self.engine_urls and not self.engine_url:
+            self.engine_url = self.engine_urls[0]
+
+    @property
+    def urls(self) -> tuple:
+        """Every engine replica URL (fleet members), falling back to the
+        single ``engine_url`` — callers route over this, never both."""
+        if self.engine_urls:
+            return self.engine_urls
+        return (self.engine_url,) if self.engine_url else ()
 
 
 class DeploymentStore:
@@ -77,7 +94,11 @@ class DeploymentStore:
 
             {"deployments": [{"name": "...", "oauth_key": "...",
                               "oauth_secret": "...", "engine_url": "...",
+                              "engine_urls": ["...", "..."],
                               "engine_grpc": "..."}]}
+
+        ``engine_urls`` (optional) lists every engine replica for the
+        fleet plane; ``engine_url`` alone keeps the single-replica shape.
         """
         path = self._config_path
         if not path or not os.path.exists(path):
@@ -94,6 +115,7 @@ class DeploymentStore:
                 oauth_key=d.get("oauth_key", ""),
                 oauth_secret=d.get("oauth_secret", ""),
                 engine_url=d.get("engine_url", ""),
+                engine_urls=tuple(d.get("engine_urls", ()) or ()),
                 engine_grpc=d.get("engine_grpc", ""),
                 annotations=dict(d.get("annotations", {})),
             )

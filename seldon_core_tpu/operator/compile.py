@@ -387,6 +387,23 @@ def placement_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def fleet_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/fleet-*`` annotations → a validated
+    :class:`~seldon_core_tpu.fleet.FleetConfig`.  Invalid values — an
+    unknown routing policy, a replica count outside [min, max], a
+    negative cooldown — reject at admission; graphlint's GL13xx pass
+    reports the same defects, this is the hard stop for callers that
+    skip linting."""
+    from seldon_core_tpu.fleet import fleet_config_from_annotations
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return fleet_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``
@@ -504,20 +521,25 @@ def _colocated_predictor(
             "cloud.google.com/gke-tpu-topology": topology,
         }
         if hosts > 1:
+            from seldon_core_tpu.runtime.multihost import (
+                ENV_NUM_HOSTS,
+                ENV_WORKER_ID,
+            )
+
             # StatefulSet pods (k8s >= 1.28) carry the pod-index label that
             # supplies the jax.distributed worker ordinal; Deployments never
             # set it, so multi-host slices MUST be StatefulSets.
             container["env"].extend(
                 [
                     {
-                        "name": "TPU_WORKER_ID",
+                        "name": ENV_WORKER_ID,
                         "valueFrom": {
                             "fieldRef": {
                                 "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
                             }
                         },
                     },
-                    {"name": "NUM_TPU_HOSTS", "value": str(hosts)},
+                    {"name": ENV_NUM_HOSTS, "value": str(hosts)},
                 ]
             )
     labels = _engine_labels(dep, p)
@@ -558,6 +580,11 @@ def _colocated_predictor(
     # replicas == hosts, so every pod-index is a valid jax.distributed
     # worker id in [0, hosts) (a single hosts*replicas StatefulSet would
     # hand out ordinals >= NUM_TPU_HOSTS).
+    from seldon_core_tpu.runtime.multihost import (
+        COORDINATOR_PORT,
+        ENV_COORDINATOR,
+    )
+
     out: list[dict] = []
     for r in range(p.replicas):
         sts_name = workload_name if p.replicas == 1 else f"{workload_name}-r{r}"
@@ -568,10 +595,10 @@ def _colocated_predictor(
         tmpl = copy.deepcopy(_pod_template(rlabels))
         coord = (
             f"{sts_name}-0.{sts_name}-hosts."
-            f"{dep.namespace}.svc.cluster.local:8476"
+            f"{dep.namespace}.svc.cluster.local:{COORDINATOR_PORT}"
         )
         tmpl["spec"]["containers"][0]["env"].append(
-            {"name": "TPU_COORDINATOR_ADDRESS", "value": coord}
+            {"name": ENV_COORDINATOR, "value": coord}
         )
         out.append(
             {

@@ -174,6 +174,7 @@ class LocalPredictor:
         dep: SeldonDeployment,
         pred: PredictorSpec,
         metrics: Optional[EngineMetrics] = None,
+        component_wrap=None,
     ):
         self.spec = pred
         self.metrics = metrics or EngineMetrics(deployment=dep.name)
@@ -281,11 +282,18 @@ class LocalPredictor:
             enable_compile_cache(
                 None if cc.lower() in ("1", "true", "yes", "on") else cc
             )
+        # component_wrap lets a harness decorate every resolved node
+        # handle (e.g. LocalFleet chaos-slowing ONE replica's components
+        # via tools/chaos.ChaosWrapper to prove least-loaded steering)
+        def _resolve(u):
+            handle = resolve_component(
+                u, ann, self.metrics.registry, qos=self.qos
+            )
+            return component_wrap(handle) if component_wrap else handle
+
         self.engine = GraphEngine(
             pred.graph,
-            resolver=lambda u: resolve_component(
-                u, ann, self.metrics.registry, qos=self.qos
-            ),
+            resolver=_resolve,
             name=pred.name,
             metrics_sink=self.metrics,
             tracer=_tracer_from_config(ann),
@@ -394,16 +402,28 @@ class LocalDeployment:
     (reference: predictors share one Service, traffic ∝ replicas —
     ``SeldonDeploymentOperatorImpl.java:619-626``)."""
 
-    def __init__(self, dep: SeldonDeployment, seed: Optional[int] = None):
+    def __init__(self, dep: SeldonDeployment, seed: Optional[int] = None,
+                 publish_status: bool = True, component_wrap=None):
         validate_deployment(dep)
         defaulting(dep)
         self.spec = dep
+        # fleet harness hook: LocalFleet points this at itself so the
+        # engine's /admin/fleet answers with the replica-set snapshot;
+        # a plain single-replica deployment keeps it None (404 + hint)
+        self.fleet = None
         self.metrics = EngineMetrics(MetricsRegistry(), deployment=dep.name)
-        self.predictors = [LocalPredictor(dep, p, self.metrics) for p in dep.predictors]
+        self.predictors = [
+            LocalPredictor(dep, p, self.metrics,
+                           component_wrap=component_wrap)
+            for p in dep.predictors
+        ]
         # surface live QoS posture (limits, shed level, open breakers) to
         # the reconcile loop's status.qos block via the process-local
-        # registry (qos/registry.py) — only when some predictor runs QoS
-        if any(p.qos is not None for p in self.predictors):
+        # registry (qos/registry.py) — only when some predictor runs QoS.
+        # publish_status=False leaves the registries alone: fleet replicas
+        # publish ONE aggregated replica-keyed snapshot via LocalFleet
+        # instead of N single-replica ones clobbering each other.
+        if publish_status and any(p.qos is not None for p in self.predictors):
             from seldon_core_tpu.qos import publish
 
             def _qos_snapshot(preds=self.predictors):
@@ -418,7 +438,8 @@ class LocalDeployment:
         # same pattern for the health plane: verdict + burn state +
         # sampler/flight-recorder stats land in status.health beside
         # status.qos (operator/reconcile.py compute_status)
-        if any(p.health is not None for p in self.predictors):
+        if publish_status and any(p.health is not None
+                                  for p in self.predictors):
             from seldon_core_tpu.health import publish as health_publish
 
             def _health_snapshot(preds=self.predictors):
@@ -432,7 +453,8 @@ class LocalDeployment:
             health_publish(dep.name, _health_snapshot)
         # same pattern for the placement plane: mesh + segment→device
         # assignments land in status.placement (reconcile compute_status)
-        if any(p.placement is not None for p in self.predictors):
+        if publish_status and any(p.placement is not None
+                                  for p in self.predictors):
             from seldon_core_tpu.placement import publish as placement_publish
 
             def _placement_snapshot(preds=self.predictors):
@@ -517,6 +539,281 @@ class LocalDeployment:
         for p in self.predictors:
             out = await p.engine.send_feedback(fb)
         return out
+
+
+class LocalFleet:
+    """N in-process engine replicas of ONE deployment behind real HTTP —
+    the CPU-testable analog of ``replicas: N`` pods (docs/scale-out.md).
+
+    Each replica is its own :class:`LocalDeployment` (own metrics
+    registry, own planes) served by an aiohttp runner on an ephemeral
+    port; the gateway routes over ``urls()`` through its ReplicaPool.
+    Registry publishes are aggregated HERE, keyed by replica id, so the
+    reconcile loop's ``status.qos``/``status.health``/``status.placement``
+    blocks stay truthful at N>1 (a plain LocalDeployment keeps the N=1
+    shape).  ``autoscale_tick`` closes the loop: demand/capacity/burn
+    signals → Autoscaler decision → replicas added or drained.
+    """
+
+    def __init__(self, dep: SeldonDeployment, replicas: Optional[int] = None,
+                 seed: Optional[int] = None, component_wrap=None,
+                 host: str = "127.0.0.1"):
+        import dataclasses
+
+        from seldon_core_tpu.fleet import (
+            Autoscaler,
+            FleetConfig,
+            fleet_config_from_annotations,
+        )
+
+        validate_deployment(dep)
+        self.spec = dep
+        merged = {**dep.annotations,
+                  **(dep.predictors[0].annotations if dep.predictors else {})}
+        try:
+            cfg = fleet_config_from_annotations(merged, dep.name)
+        except ValueError as e:
+            logger.warning("deployment %s: %s — fleet defaults in effect",
+                           dep.name, e)
+            cfg = None
+        if cfg is None or not cfg.enabled:
+            n = replicas or 1
+            cfg = FleetConfig(enabled=True, replicas=n, max_replicas=max(n, 1))
+        if replicas is not None and replicas != cfg.replicas:
+            cfg = dataclasses.replace(
+                cfg, replicas=replicas,
+                min_replicas=min(cfg.min_replicas, replicas),
+                max_replicas=max(cfg.max_replicas, replicas),
+            )
+        self.config = cfg
+        self.autoscaler = Autoscaler(cfg)
+        #: manual demand/capacity/burn override for tests and drills —
+        #: when None the live profiling/health planes are summed instead
+        self.signals_override: Optional[dict] = None
+        self.last_decision = None
+        self._seed = seed
+        self._component_wrap = component_wrap
+        self._host = host
+        self._replicas: list = []
+        self._seq = 0
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> "LocalFleet":
+        for _ in range(self.config.replicas):
+            await self.add_replica()
+        return self
+
+    async def stop(self) -> None:
+        for rep in self._replicas:
+            if not rep["killed"]:
+                try:
+                    await rep["runner"].cleanup()
+                except Exception:
+                    pass
+        self._replicas.clear()
+        self._unpublish()
+
+    async def add_replica(self):
+        """Spawn one more in-process replica (autoscale up / initial
+        boot): fresh LocalDeployment + REST server on an ephemeral port,
+        registered into membership and the aggregated status publish."""
+        from aiohttp import web
+
+        from seldon_core_tpu.serving.rest import build_app
+
+        idx = self._seq
+        self._seq += 1
+        wrap = None
+        if self._component_wrap is not None:
+            cw = self._component_wrap
+
+            def wrap(handle, _i=idx):
+                return cw(_i, handle)
+
+        local = LocalDeployment(self.spec, seed=self._seed,
+                                publish_status=False, component_wrap=wrap)
+        local.fleet = self
+        runner = web.AppRunner(
+            build_app(engine=local, metrics=local.metrics), access_log=None
+        )
+        await runner.setup()
+        site = web.TCPSite(runner, self._host, 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        rep = {
+            "rid": f"r{idx}",
+            "local": local,
+            "runner": runner,
+            "url": f"http://{self._host}:{port}",
+            "killed": False,
+        }
+        self._replicas.append(rep)
+        self._publish()
+        return rep
+
+    async def remove_replica(self):
+        """Drain the newest replica (autoscale down); never drops below
+        one live replica."""
+        live = [r for r in self._replicas if not r["killed"]]
+        if len(live) <= 1:
+            return None
+        rep = live[-1]
+        self._replicas.remove(rep)
+        try:
+            await rep["runner"].cleanup()
+        except Exception:
+            pass
+        self._publish()
+        return rep
+
+    async def kill(self, idx: int):
+        """Chaos: stop replica ``idx``'s server WITHOUT removing it from
+        membership — connections now refuse, exactly like a crashed pod
+        whose endpoint has not yet been reconciled away.  The gateway's
+        retry-next-replica + pool ejection must absorb it."""
+        rep = self._replicas[idx]
+        if not rep["killed"]:
+            await rep["runner"].cleanup()
+            rep["killed"] = True
+        return rep
+
+    # -- membership / routing ------------------------------------------
+    def urls(self) -> tuple:
+        """Every member URL, killed ones included — membership is the
+        operator's view; the pool's health gating ejects the dead."""
+        return tuple(rep["url"] for rep in self._replicas)
+
+    def replicas(self) -> list:
+        return list(self._replicas)
+
+    def __len__(self) -> int:
+        return len(self._replicas)
+
+    # -- status / signals ----------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``status.fleet`` / engine ``/admin/fleet`` posture."""
+        return {
+            "deployment": self.spec.name,
+            "policy": self.config.policy,
+            "autoscale": self.config.autoscale,
+            "desired": len(self._replicas),
+            "replicas": [
+                {"replica": rep["rid"], "url": rep["url"],
+                 "state": "killed" if rep["killed"] else "healthy"}
+                for rep in self._replicas
+            ],
+            "signals": self._signals(),
+        }
+
+    def _signals(self) -> dict:
+        """Autoscale inputs: attributed-FLOP demand vs achievable fleet
+        capacity (profiling plane's capacity model, summed over live
+        replicas) and the worst SLO burn verdict (health plane)."""
+        if self.signals_override is not None:
+            return dict(self.signals_override)
+        from seldon_core_tpu.profiling.http import capacity_body
+
+        demand = capacity = 0.0
+        have = False
+        burn_warn = burn_critical = False
+        for rep in self._replicas:
+            if rep["killed"]:
+                continue
+            prof = rep["local"].profiler
+            if prof is not None:
+                try:
+                    status, payload = capacity_body(prof, {})
+                except ValueError:
+                    status, payload = 0, {}
+                if status == 200:
+                    demand += float(payload.get("observedRps") or 0.0)
+                    capacity += float(payload.get("achievableRps") or 0.0)
+                    have = True
+            plane = rep["local"].health
+            if plane is not None:
+                level = plane.verdict().get("level", 0)
+                burn_warn = burn_warn or level >= 1
+                burn_critical = burn_critical or level >= 2
+        out = {"burnWarn": burn_warn, "burnCritical": burn_critical}
+        if have:
+            out["demandRps"] = round(demand, 3)
+            out["capacityRps"] = round(capacity, 3)
+        return out
+
+    async def autoscale_tick(self, signals: Optional[dict] = None):
+        """One autoscale evaluation: signals → Autoscaler decision →
+        replicas added/drained to match.  Returns the decision."""
+        sig = signals if signals is not None else self._signals()
+        decision = self.autoscaler.decide(
+            current=len(self._replicas),
+            demand_rps=sig.get("demandRps"),
+            capacity_rps=sig.get("capacityRps"),
+            burn_critical=bool(sig.get("burnCritical")),
+            burn_warn=bool(sig.get("burnWarn")),
+        )
+        self.last_decision = decision
+        while len(self._replicas) < decision.desired:
+            await self.add_replica()
+        while len(self._replicas) > decision.desired:
+            if await self.remove_replica() is None:
+                break
+        return decision
+
+    # -- registry publish ----------------------------------------------
+    def _plane_status(self, attr: str) -> dict:
+        """Replica-keyed plane snapshot: one list entry per (predictor,
+        replica) pair, each tagged with its replica id — the N>1 truth
+        behind ``status.qos``/``status.health``/``status.placement``."""
+        preds: dict[str, list] = {}
+        for rep in self._replicas:
+            if rep["killed"]:
+                continue
+            for p in rep["local"].predictors:
+                plane = getattr(p, attr)
+                if plane is None:
+                    continue
+                preds.setdefault(p.spec.name, []).append(
+                    {"replica": rep["rid"], **plane.snapshot()}
+                )
+        return {
+            "predictors": [
+                {"name": name, "replicas": reps}
+                for name, reps in preds.items()
+            ]
+        }
+
+    def _publish(self) -> None:
+        from seldon_core_tpu.fleet import publish as fleet_publish
+        from seldon_core_tpu.health import publish as health_publish
+        from seldon_core_tpu.placement import publish as placement_publish
+        from seldon_core_tpu.qos import publish as qos_publish
+
+        dep = self.spec.name
+        fleet_publish(dep, self.snapshot)
+        live = [r for r in self._replicas if not r["killed"]]
+        if not live:
+            return
+        sample = live[0]["local"].predictors
+        if any(p.qos is not None for p in sample):
+            qos_publish(dep, lambda: self._plane_status("qos"))
+        if any(p.health is not None for p in sample):
+            health_publish(dep, lambda: self._plane_status("health"))
+        if any(p.placement is not None for p in sample):
+            placement_publish(dep, lambda: self._plane_status("placement"))
+
+    def _unpublish(self) -> None:
+        from seldon_core_tpu.fleet import unpublish as fleet_unpublish
+        from seldon_core_tpu.health import unpublish as health_unpublish
+        from seldon_core_tpu.placement import (
+            unpublish as placement_unpublish,
+        )
+        from seldon_core_tpu.qos import unpublish as qos_unpublish
+
+        dep = self.spec.name
+        fleet_unpublish(dep)
+        qos_unpublish(dep)
+        health_unpublish(dep)
+        placement_unpublish(dep)
 
 
 def load_deployment_file(path: str) -> SeldonDeployment:

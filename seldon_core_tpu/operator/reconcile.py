@@ -276,6 +276,10 @@ class SeldonDeploymentController:
 
     def __init__(self, api: KubeApi):
         self.api = api
+        # fleet autoscale loop state: "owner/predictor" → (FleetConfig,
+        # Autoscaler) so cooldown clocks survive across sweeps and a
+        # config change rebuilds the scaler (docs/scale-out.md)
+        self._autoscalers: dict[str, tuple] = {}
 
     # -- public ----------------------------------------------------------
     def reconcile(self, cr: dict) -> dict:
@@ -437,7 +441,82 @@ class SeldonDeploymentController:
         placement = placement_snapshot(owner)
         if placement is not None:
             status["placement"] = placement
+        # Fleet posture (docs/scale-out.md): replica membership/health,
+        # routing policy, and autoscale signals, published by the same
+        # process-local pattern (fleet/registry.py).  When the CR opts in
+        # to autoscale this is also where the loop RUNS — both reconcile()
+        # and the watcher's availability refresh funnel through here, so
+        # scaling reacts on every sweep, not only on spec edits.
+        from seldon_core_tpu.fleet import snapshot as fleet_snapshot
+
+        fleet = fleet_snapshot(owner)
+        if fleet is not None:
+            decisions = self.maybe_autoscale(dep, ns, owner, fleet)
+            if decisions:
+                fleet = {**fleet, "autoscale": decisions}
+            status["fleet"] = fleet
         return status
+
+    def maybe_autoscale(
+        self, dep: SeldonDeployment, ns: str, owner: str, fleet: dict
+    ) -> dict:
+        """Operator autoscale loop: the fleet registry's demand/capacity/
+        burn signals drive one Autoscaler per fleet-enabled predictor
+        (cooldown + min/max bounds live in the scaler).  A changed
+        decision patches the owned workload's ``spec.replicas`` DIRECTLY —
+        the spec-hash annotation is untouched, so the hash-guarded
+        reconcile path will not revert the scale (the same mechanism that
+        lets a human ``kubectl scale`` an owned workload).  Returns
+        {predictor: decision dict} for ``status.fleet.autoscale``;
+        decisions carry no timestamps so the status prev-guard stays
+        stable across idle sweeps."""
+        from seldon_core_tpu.fleet import (
+            Autoscaler,
+            fleet_config_from_annotations,
+        )
+
+        sig = fleet.get("signals") or {}
+        decisions: dict[str, dict] = {}
+        for p in dep.predictors:
+            ann = {**dep.annotations, **p.annotations}
+            try:
+                cfg = fleet_config_from_annotations(ann, f"{owner}/{p.name}")
+            except ValueError:
+                continue  # admission (GL1301) already surfaced it
+            if cfg is None or not cfg.enabled or not cfg.autoscale:
+                continue
+            key = f"{owner}/{p.name}"
+            entry = self._autoscalers.get(key)
+            if entry is None or entry[0] != cfg:
+                entry = (cfg, Autoscaler(cfg))
+                self._autoscalers[key] = entry
+            scaler = entry[1]
+            sel = {OWNER_LABEL: owner, PREDICTOR_LABEL: p.name}
+            workloads = [
+                obj
+                for kind in WORKLOAD_KINDS
+                for obj in self.api.list(kind, ns, sel)
+            ]
+            current = sum(
+                int(obj.get("spec", {}).get("replicas", 1))
+                for obj in workloads
+            ) or p.replicas
+            decision = scaler.decide(
+                current=current,
+                demand_rps=sig.get("demandRps"),
+                capacity_rps=sig.get("capacityRps"),
+                burn_critical=bool(sig.get("burnCritical")),
+                burn_warn=bool(sig.get("burnWarn")),
+            )
+            decisions[p.name] = decision.to_dict()
+            if decision.changed and workloads:
+                obj = workloads[0]
+                obj.setdefault("spec", {})["replicas"] = decision.desired
+                try:
+                    self.api.update(obj)
+                except Exception:
+                    logger.exception("autoscale patch failed for %s", key)
+        return decisions
 
     # -- internals -------------------------------------------------------
     def _owner_ref(self, cr: dict) -> Optional[dict]:

@@ -24,10 +24,23 @@ from typing import Optional
 logger = logging.getLogger(__name__)
 
 __all__ = [
+    "ENV_NUM_HOSTS",
+    "ENV_WORKER_ID",
+    "ENV_COORDINATOR",
+    "COORDINATOR_PORT",
     "multihost_env",
     "maybe_initialize_distributed",
     "run_multihost_dryrun",
 ]
+
+# The operator/runtime env contract, defined ONCE here: operator/compile.py
+# materializes these names into the StatefulSet manifest and this module
+# parses them back — both sides import the constants so the contract
+# cannot drift silently.
+ENV_NUM_HOSTS = "NUM_TPU_HOSTS"
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_COORDINATOR = "TPU_COORDINATOR_ADDRESS"
+COORDINATOR_PORT = 8476
 
 
 def multihost_env() -> Optional[dict]:
@@ -38,15 +51,15 @@ def multihost_env() -> Optional[dict]:
     slice at its first collective with a shape mismatch — fail at boot with
     the reason instead.
     """
-    hosts = int(os.environ.get("NUM_TPU_HOSTS", "1") or 1)
+    hosts = int(os.environ.get(ENV_NUM_HOSTS, "1") or 1)
     if hosts <= 1:
         return None
-    wid = os.environ.get("TPU_WORKER_ID", "")
-    coord = os.environ.get("TPU_COORDINATOR_ADDRESS", "")
+    wid = os.environ.get(ENV_WORKER_ID, "")
+    coord = os.environ.get(ENV_COORDINATOR, "")
     if wid == "" or not coord:
         raise RuntimeError(
-            f"NUM_TPU_HOSTS={hosts} but TPU_WORKER_ID={wid!r} / "
-            f"TPU_COORDINATOR_ADDRESS={coord!r}: multi-host pods must run "
+            f"{ENV_NUM_HOSTS}={hosts} but {ENV_WORKER_ID}={wid!r} / "
+            f"{ENV_COORDINATOR}={coord!r}: multi-host pods must run "
             "under the operator's StatefulSet (operator/compile.py) which "
             "injects both"
         )
@@ -116,13 +129,13 @@ def _statefulset_env_names(n_hosts: int) -> None:
     assert sts, "multi-host compile produced no StatefulSet"
     env = {e["name"]: e
            for e in sts[0]["spec"]["template"]["spec"]["containers"][0]["env"]}
-    assert env["NUM_TPU_HOSTS"]["value"] == str(n_hosts)
+    assert env[ENV_NUM_HOSTS]["value"] == str(n_hosts)
     # worker id comes from the pod-index label (what the parent mirrors
     # with the loop ordinal below)
     assert "pod-index" in (
-        env["TPU_WORKER_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+        env[ENV_WORKER_ID]["valueFrom"]["fieldRef"]["fieldPath"]
     )
-    assert env["TPU_COORDINATOR_ADDRESS"]["value"].endswith(":8476")
+    assert env[ENV_COORDINATOR]["value"].endswith(f":{COORDINATOR_PORT}")
 
 
 def run_multihost_dryrun(n_hosts: int = 2, devices_per_host: int = 4,
@@ -157,9 +170,9 @@ def run_multihost_dryrun(n_hosts: int = 2, devices_per_host: int = 4,
             # what k8s materializes from the StatefulSet manifest: the
             # pod-index label -> TPU_WORKER_ID, the headless-service DNS
             # of pod 0 -> coordinator (loopback stands in for DNS here)
-            "NUM_TPU_HOSTS": str(n_hosts),
-            "TPU_WORKER_ID": str(i),
-            "TPU_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            ENV_NUM_HOSTS: str(n_hosts),
+            ENV_WORKER_ID: str(i),
+            ENV_COORDINATOR: f"127.0.0.1:{port}",
             "JAX_PLATFORMS": "cpu",
             # strip ANY inherited device-count flag (conftest sets 8, the
             # dryrun entry sets n_devices) before pinning the per-worker
@@ -228,6 +241,23 @@ def run_multihost_dryrun(n_hosts: int = 2, devices_per_host: int = 4,
         f"shared prefix never pinned pages: {results}"
     )
     assert all(r["pages_ok"] for r in results), f"pages leaked: {results}"
+    # fleet-aware: every slice worker registers into a ReplicaPool exactly
+    # as gateway membership would see it — one replica per host, all
+    # healthy after a clean dryrun (docs/scale-out.md)
+    from seldon_core_tpu.fleet import ReplicaPool
+
+    pool = ReplicaPool(
+        "mh-dryrun",
+        members=tuple(
+            f"http://127.0.0.1:{port}/worker-{r['process']}"
+            for r in sorted(results, key=lambda r: r["process"])
+        ),
+    )
+    fleet = pool.snapshot()
+    assert len(pool) == n_hosts, (
+        f"fleet membership {len(pool)} != n_hosts {n_hosts}"
+    )
+    assert fleet["healthy"] == n_hosts
     return {
         "n_hosts": n_hosts,
         "global_devices": results[0]["global_devices"],
@@ -235,6 +265,7 @@ def run_multihost_dryrun(n_hosts: int = 2, devices_per_host: int = 4,
         "paged_requests": len(ptoks[0]),
         "spec_rounds": results[0]["spec_rounds"],
         "pinned_pages": results[0]["pinned_pages"],
+        "fleet": fleet,
     }
 
 

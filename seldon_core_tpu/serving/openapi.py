@@ -358,6 +358,33 @@ def _placement_paths() -> dict:
     }
 
 
+def _fleet_paths() -> dict:
+    """The fleet-plane admin surface — identical on gateway and engine
+    (docs/scale-out.md): per-replica health/load, the consistent-hash
+    ring, session bindings."""
+    return {
+        "/admin/fleet": {
+            "get": {
+                "summary": "replica pool membership: per-replica health "
+                           "state, in-flight load, forwards/ejections, "
+                           "hash ring, session affinity bindings",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "deployment", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "narrow the gateway's view to one "
+                                    "deployment's pool"},
+                ],
+                "responses": {
+                    "200": {"description": "fleet snapshot"},
+                    "404": {"description": "fleet plane disabled or "
+                                           "unknown deployment"},
+                },
+            }
+        },
+    }
+
+
 def gateway_spec() -> dict:
     """External API (reference apife.oas3.json)."""
     paths = {
@@ -425,6 +452,7 @@ def gateway_spec() -> dict:
         **_health_paths(),
         **_profile_paths(),
         **_placement_paths(),
+        **_fleet_paths(),
         **_ops_paths(),
     }
     return {
@@ -469,6 +497,7 @@ def engine_spec() -> dict:
         **_health_paths(),
         **_profile_paths(),
         **_placement_paths(),
+        **_fleet_paths(),
         **_ops_paths(),
     }
     return {

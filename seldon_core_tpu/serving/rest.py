@@ -465,6 +465,26 @@ class EngineServer:
             content_type="application/json",
         )
 
+    def _fleet_plane(self):
+        """The engine's fleet harness (duck attr, like ``placement`` —
+        a LocalFleet replica answers with the whole replica set)."""
+        return getattr(self.engine, "fleet", None)
+
+    async def fleet(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.fleet import fleet_body
+
+        try:
+            status, payload = fleet_body(self._fleet_plane(), request.query)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, "numeric query parameter expected"),
+                content_type="application/json",
+            )
+        return web.Response(
+            status=status, text=json.dumps(payload),
+            content_type="application/json",
+        )
+
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
         app.router.add_post("/api/v0.1/stream", self.stream)
@@ -484,6 +504,7 @@ class EngineServer:
         app.router.add_get("/admin/profile/compile", self.profile_compile)
         app.router.add_get("/admin/profile/capacity", self.profile_capacity)
         app.router.add_get("/admin/placement", self.placement)
+        app.router.add_get("/admin/fleet", self.fleet)
         app.router.add_get("/seldon.json", _openapi_handler("engine"))
 
 

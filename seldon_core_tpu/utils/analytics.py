@@ -551,6 +551,29 @@ CATALOG: tuple[MetricInfo, ...] = (
         "without memory_stats)",
         ("device",),
     ),
+    # -- fleet plane (docs/scale-out.md): multi-replica engine pool
+    # behind one gateway — capacity-aware routing, health-gated
+    # membership, autoscale
+    MetricInfo(
+        "seldon_fleet_forwards_total", "counter",
+        "Requests forwarded to a fleet replica and completed without a "
+        "transport failure (per-replica skew shows the routing policy "
+        "at work; snapshot at /admin/fleet)",
+        ("deployment", "replica"),
+    ),
+    MetricInfo(
+        "seldon_fleet_ejections_total", "counter",
+        "Replicas ejected from the healthy pool, by reason "
+        "(connect-error, probe-failed, health-critical, breaker-open); "
+        "ejected replicas are re-probed half-open before readmission",
+        ("deployment", "replica", "reason"),
+    ),
+    MetricInfo(
+        "seldon_fleet_replicas", "gauge",
+        "Fleet membership by state (healthy / probing / ejected) for "
+        "each deployment's replica pool",
+        ("deployment", "state"),
+    ),
 )
 
 
@@ -742,6 +765,22 @@ def alert_rules() -> dict:
                         },
                     },
                     {
+                        "alert": "SeldonFleetReplicaEjected",
+                        "expr": (
+                            'sum(seldon_fleet_replicas{state="ejected"}) '
+                            "by (deployment) > 0"
+                        ),
+                        "for": "2m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "fleet replica(s) ejected for "
+                                "{{ $labels.deployment }} — pool serving "
+                                "below configured width (/admin/fleet has "
+                                "per-replica verdicts and ejection reasons)",
+                        },
+                    },
+                    {
                         "alert": "SeldonGatewayRetrying",
                         "expr": (
                             "sum(rate(seldon_api_gateway_retries_total[5m])) "
@@ -868,6 +907,13 @@ def grafana_dashboard() -> dict:
                ["max(seldon_runtime_device_occupancy_est) by (probe)",
                 "max(seldon_compile_cache_enabled) by (probe)"],
                y=64, x=12, unit="percentunit"),
+        _panel(19, "Fleet forwards by replica (req/s)",
+               "sum(rate(seldon_fleet_forwards_total[5m])) "
+               "by (deployment, replica)", y=72, x=0),
+        _panel(20, "Fleet membership + ejections",
+               ["sum(seldon_fleet_replicas) by (deployment, state)",
+                "sum(rate(seldon_fleet_ejections_total[5m])) "
+                "by (deployment, replica, reason)"], y=72, x=12),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",
