@@ -2025,6 +2025,282 @@ def fleet_smoke() -> int:
     return 1 if failures else 0
 
 
+def fleet_obs_smoke() -> int:
+    """Fast CI gate for the fleet observability plane (CPU-only,
+    docs/observability.md#fleet-observability):
+    (1) straggler naming — 3 replicas, one chaos-slowed 15x: the fleet
+        verdict at ``/admin/fleet/health`` warns with a ``straggler``
+        signal naming exactly that replica, and a uniform control fleet
+        raises no signal at all,
+    (2) trace stitching — a replica killed mid-drill forces a failover;
+        ``/admin/fleet/traces?trace_id=`` returns that request as ONE
+        journey spanning >= 2 replicas: the connect-failed hop (with its
+        eject_reason) plus the server spans of the replica that served,
+    (3) aggregated capacity — the ``fleet`` block of
+        ``/admin/fleet/capacity`` equals the sum over live replicas,
+    (4) the ejection lands in the ``/admin/fleet/decisions`` audit ring,
+    (5) scrape overhead — p50 of an uncached 3-replica health scrape
+        stays under the budget (the admin surface must not hurt).
+    Returns a process exit code."""
+    import time as _time
+
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    failures: list[str] = []
+    report: dict = {}
+    SCRAPE_P50_BUDGET_MS = 500.0
+
+    body = json.dumps(SeldonMessage.from_ndarray(
+        np.full((1, 784), 0.5, np.float32)).to_dict()).encode()
+
+    OBS_ANN = {
+        "seldon.io/fleet-replicas": "3",
+        "seldon.io/fleet-policy": "round-robin",  # even spread: every
+        # replica collects enough flight records to enter the skew pool
+        "seldon.io/tracing": "true",
+        "seldon.io/health": "true",
+        "seldon.io/profile": "true",
+        "seldon.io/graph-plan": "fused",  # attributed device cost, so
+        # the capacity drill sums real traffic rather than zeros
+        "seldon.io/fleet-obs-interval-ms": "0",   # every GET re-scrapes
+    }
+
+    async def run_all() -> dict:
+        import aiohttp
+        from aiohttp import web
+
+        from seldon_core_tpu.gateway.app import Gateway
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+        from seldon_core_tpu.operator.local import LocalFleet
+        from seldon_core_tpu.tools.chaos import ChaosPolicy, ChaosWrapper
+        from seldon_core_tpu.utils.tracing import SpanCollector, Tracer
+
+        store = DeploymentStore()
+        gw = Gateway(store, tracer=Tracer(
+            collector=SpanCollector(service="gateway")))
+        gw_runner = web.AppRunner(gw.build_app(), access_log=None)
+        await gw_runner.setup()
+        await web.TCPSite(gw_runner, "127.0.0.1", 0).start()
+        base = f"http://127.0.0.1:{gw_runner.addresses[0][1]}"
+        out: dict = {}
+        fleets: list = []
+
+        try:
+            async with aiohttp.ClientSession() as sess:
+
+                async def record(name: str, urls) -> str:
+                    store.put(DeploymentRecord(
+                        name=name, oauth_key=name, oauth_secret="s",
+                        engine_urls=tuple(urls),
+                        annotations=dict(OBS_ANN)))
+                    async with sess.post(
+                        f"{base}/oauth/token",
+                        data={"grant_type": "client_credentials"},
+                        auth=aiohttp.BasicAuth(name, "s"),
+                    ) as resp:
+                        return (await resp.json())["access_token"]
+
+                async def drive(token: str, n: int) -> list[int]:
+                    headers = {"Authorization": f"Bearer {token}",
+                               "Content-Type": "application/json"}
+                    statuses = []
+                    for _ in range(n):
+                        async with sess.post(
+                            f"{base}/api/v0.1/predictions",
+                            data=body, headers=headers,
+                        ) as resp:
+                            await resp.read()
+                            statuses.append(resp.status)
+                    return statuses
+
+                async def fleet_get(_kind: str, _dep: str, **params):
+                    async with sess.get(
+                        f"{base}/admin/fleet/{_kind}",
+                        params={"deployment": _dep, **params},
+                    ) as resp:
+                        return resp.status, await resp.json()
+
+                # ---- (1) straggler naming + uniform control ----------
+                def slow_first(idx, handle):
+                    if idx == 0:
+                        return ChaosWrapper(handle,
+                                            ChaosPolicy(latency_ms=150.0))
+                    return handle
+
+                fl = await LocalFleet(
+                    _fleet_bench_spec("obs-slow", OBS_ANN), replicas=3,
+                    component_wrap=slow_first).start()
+                fleets.append(fl)
+                token = await record("obs-slow", fl.urls())
+                out["slow_statuses"] = await drive(token, 24)
+                out["slow_health"] = await fleet_get("health", "obs-slow")
+
+                fl = await LocalFleet(
+                    _fleet_bench_spec("obs-even", OBS_ANN),
+                    replicas=3).start()
+                fleets.append(fl)
+                token = await record("obs-even", fl.urls())
+                out["even_statuses"] = await drive(token, 24)
+                out["even_health"] = await fleet_get("health", "obs-even")
+
+                # ---- (3) capacity aggregation (same fleet) -----------
+                out["capacity"] = await fleet_get("capacity", "obs-even")
+
+                # ---- (5) scrape overhead on the 3-replica fleet ------
+                laps = []
+                for _ in range(9):
+                    t0 = _time.perf_counter()
+                    status, _payload = await fleet_get(
+                        "health", "obs-even", refresh="1")
+                    laps.append((_time.perf_counter() - t0) * 1000.0)
+                    if status != 200:
+                        laps[-1] = float("inf")
+                out["scrape_ms"] = sorted(laps)
+
+                # ---- (2) kill -> failover -> ONE stitched trace ------
+                fl = await LocalFleet(
+                    _fleet_bench_spec("obs-kill", OBS_ANN),
+                    replicas=3).start()
+                fleets.append(fl)
+                token = await record("obs-kill", fl.urls())
+                warm = await drive(token, 6)   # pool sees r0 healthy
+                await fl.kill(0)
+                out["kill_statuses"] = warm + await drive(token, 12)
+                hdr = {"Authorization": f"Bearer {token}"}
+                async with sess.get(f"{base}/admin/traces",
+                                    params={"deployment": "obs-kill",
+                                            "n": "50"},
+                                    headers=hdr) as resp:
+                    recs = (await resp.json()).get("traces", [])
+                retried = [
+                    rec for rec in recs
+                    if len([c for c in rec["root"].get("children", [])
+                            if c.get("kind") == "hop"]) >= 2
+                ]
+                out["retried_count"] = len(retried)
+                if retried:
+                    out["stitched"] = await fleet_get(
+                        "traces", "obs-kill",
+                        trace_id=retried[0]["trace_id"])
+                # ---- (4) the ejection is audited ---------------------
+                out["decisions"] = await fleet_get(
+                    "decisions", "obs-kill", kind="eject")
+                out["gw_metrics"] = gw.registry.render()
+        finally:
+            for fl in fleets:
+                await fl.stop()
+            await gw.close()
+            await gw_runner.cleanup()
+        return out
+
+    r = asyncio.run(run_all())
+
+    # -- (1) straggler gates ----------------------------------------------
+    status, health = r["slow_health"]
+    stragglers = [s for s in health.get("signals", [])
+                  if s.get("signal") == "straggler"]
+    named = sorted({s["replica"] for s in stragglers})
+    report["straggler"] = {
+        "verdict": health.get("verdict"), "named": named,
+        "skew": health.get("skew", {}).get("latency"),
+    }
+    if any(s != 200 for s in r["slow_statuses"]):
+        failures.append("slow-fleet drill had non-200 responses")
+    if status != 200:
+        failures.append(f"/admin/fleet/health answered {status}")
+    elif health.get("verdict") not in ("warn", "critical"):
+        failures.append(
+            f"verdict {health.get('verdict')!r} despite a 15x-slowed "
+            "replica — the skew analysis missed it")
+    if named != ["r0"]:
+        failures.append(
+            f"straggler signal named {named or 'nobody'}, expected "
+            "exactly the chaos-slowed r0")
+    status, even = r["even_health"]
+    report["uniform"] = {"verdict": even.get("verdict"),
+                         "signals": even.get("signals")}
+    if status == 200 and even.get("signals"):
+        failures.append(
+            f"uniform fleet raised {even['signals']} — straggler "
+            "detection is noisy")
+    if "seldon_fleet_obs_straggler" not in r["gw_metrics"]:
+        failures.append("no seldon_fleet_obs_straggler series in the "
+                        "gateway exposition after the skew analysis")
+
+    # -- (2) stitched-trace gates -----------------------------------------
+    if any(s != 200 for s in r["kill_statuses"]):
+        failures.append(f"kill drill lost requests: {r['kill_statuses']}")
+    if not r["retried_count"]:
+        failures.append("no failed-over request produced a multi-hop "
+                        "trace")
+    else:
+        status, stitched = r["stitched"]
+        involved = stitched.get("replicasInvolved", [])
+        hops = stitched.get("hops", [])
+        ejected_hops = [h for h in hops
+                        if h.get("attributes", {}).get("eject_reason")]
+        report["stitched"] = {
+            "involved": involved, "hops": len(hops),
+            "ejected_hops": len(ejected_hops),
+        }
+        if status != 200:
+            failures.append(f"/admin/fleet/traces answered {status}")
+        elif len(involved) < 2:
+            failures.append(
+                f"stitched trace involved {involved} — a failed-over "
+                "request must span the failed AND the serving replica")
+        elif not ejected_hops:
+            failures.append("no hop span carries the eject_reason of the "
+                            "connect-failed attempt")
+
+    # -- (3) capacity-sum gates -------------------------------------------
+    status, cap = r["capacity"]
+    fleet_reqs = cap.get("fleet", {}).get("requests")
+    per_replica = sum(
+        float(p.get("requests", 0)) for p in cap.get("replicas", {}).values()
+        if not p.get("unreachable"))
+    report["capacity"] = {"fleet_requests": fleet_reqs,
+                          "sum_replicas": per_replica}
+    if status != 200:
+        failures.append(f"/admin/fleet/capacity answered {status}")
+    elif fleet_reqs is None or abs(fleet_reqs - per_replica) > 1e-6:
+        failures.append(
+            f"aggregated capacity {fleet_reqs} != per-replica sum "
+            f"{per_replica}")
+    elif fleet_reqs <= 0:
+        failures.append(
+            "capacity window saw no attributed requests — the "
+            "aggregation gate proved nothing")
+
+    # -- (4) decision-audit gates -----------------------------------------
+    status, dec = r["decisions"]
+    ejects = dec.get("decisions", [])
+    report["decisions"] = {"ejects": len(ejects)}
+    if status != 200:
+        failures.append(f"/admin/fleet/decisions answered {status}")
+    elif not any(d.get("replica") == "r0" for d in ejects):
+        failures.append(
+            "the kill's ejection never reached the decision audit ring")
+
+    # -- (5) scrape-overhead gate -----------------------------------------
+    laps = r["scrape_ms"]
+    p50 = laps[len(laps) // 2]
+    report["scrape"] = {"p50_ms": round(p50, 2),
+                        "budget_ms": SCRAPE_P50_BUDGET_MS}
+    if p50 > SCRAPE_P50_BUDGET_MS:
+        failures.append(
+            f"uncached fleet-health scrape p50 {p50:.0f}ms over the "
+            f"{SCRAPE_P50_BUDGET_MS:.0f}ms budget")
+
+    print(json.dumps({"fleet_obs_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 def bench_sharded_throughput(seconds: float = 2.0) -> dict:
     """dp=1 vs dp=4 sharded-dispatch microbench on the Iris fused
     segment (64-row batches).  On forced-host-device CPU the dp=4 path
@@ -3399,6 +3675,20 @@ def main() -> None:
                          "replica, and the autoscaler goes 1 -> 3 under "
                          "a 2x-capacity drill and back down after the "
                          "cooldown; then exit")
+    ap.add_argument("--fleet-obs-smoke", action="store_true",
+                    help="fast CI gate: fleet observability plane — a "
+                         "chaos-slowed replica in a 3-replica fleet is "
+                         "named by a straggler signal in the "
+                         "/admin/fleet/health verdict (and a uniform "
+                         "fleet raises none), a replica killed mid-"
+                         "drill yields ONE stitched trace at "
+                         "/admin/fleet/traces spanning the failed and "
+                         "the serving replica with the eject_reason on "
+                         "the failed hop, aggregated capacity equals "
+                         "the per-replica sum, the ejection lands in "
+                         "/admin/fleet/decisions, and the uncached "
+                         "3-replica scrape p50 stays under budget; "
+                         "then exit")
     ap.add_argument("--shard-smoke", action="store_true",
                     help="fast CI gate (XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8): "
@@ -3425,6 +3715,8 @@ def main() -> None:
         sys.exit(profile_smoke())
     if args.fleet_smoke:
         sys.exit(fleet_smoke())
+    if args.fleet_obs_smoke:
+        sys.exit(fleet_obs_smoke())
     if args.shard_smoke:
         sys.exit(shard_smoke())
     if os.environ.get("JAX_PLATFORMS"):

@@ -99,6 +99,9 @@ FLEET_KNOBS_WITHOUT_FLEET = "GL1302"  # fleet knobs set, fleet-replicas absent
 FLEET_AUTOSCALE_BLIND = "GL1303"    # autoscale on, no health/profile signals
 FLEET_REPLICAS_MISMATCH = "GL1304"  # fleet-replicas != predictor replicas
 FLEET_CONFIG_REPORT = "GL1305"      # fleet report: effective config
+FLEET_OBS_ANNOTATION_INVALID = "GL1401"  # seldon.io/fleet-obs-* value invalid
+FLEET_OBS_WITHOUT_FLEET = "GL1402"  # fleet-obs knobs set, fleet absent
+FLEET_OBS_CONFIG_REPORT = "GL1403"  # fleet-obs report: effective config
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -158,6 +161,9 @@ CODE_SEVERITY = {
     FLEET_AUTOSCALE_BLIND: WARN,
     FLEET_REPLICAS_MISMATCH: WARN,
     FLEET_CONFIG_REPORT: INFO,
+    FLEET_OBS_ANNOTATION_INVALID: ERROR,
+    FLEET_OBS_WITHOUT_FLEET: WARN,
+    FLEET_OBS_CONFIG_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

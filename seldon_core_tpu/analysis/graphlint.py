@@ -42,6 +42,9 @@ from seldon_core_tpu.analysis.findings import (
     FLEET_AUTOSCALE_BLIND,
     FLEET_CONFIG_REPORT,
     FLEET_KNOBS_WITHOUT_FLEET,
+    FLEET_OBS_ANNOTATION_INVALID,
+    FLEET_OBS_CONFIG_REPORT,
+    FLEET_OBS_WITHOUT_FLEET,
     FLEET_REPLICAS_MISMATCH,
     GRAPH_CYCLE,
     HBM_NEAR_BUDGET,
@@ -188,6 +191,7 @@ def lint_graph(
         findings.extend(_profile_pass(unit, ann, path_prefix))
         findings.extend(_placement_pass(unit, ann, path_prefix))
         findings.extend(_fleet_pass(unit, ann, path_prefix))
+        findings.extend(_fleet_obs_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -1235,7 +1239,9 @@ def _fleet_pass(root: PredictiveUnit, ann: dict,
         fleet_config_from_annotations,
     )
 
-    fleet_keys = [k for k in ann if k.startswith("seldon.io/fleet-")]
+    fleet_keys = [k for k in ann
+                  if k.startswith("seldon.io/fleet-")
+                  and not k.startswith("seldon.io/fleet-obs-")]
     if not fleet_keys:
         return []
     path0 = _join(prefix, root.name)
@@ -1277,6 +1283,53 @@ def _fleet_pass(root: PredictiveUnit, ann: dict,
             f"cooldown {cfg.cooldown_s:g}s)"
         )
     findings.append(make_finding(FLEET_CONFIG_REPORT, path0, detail))
+    return findings
+
+
+def _fleet_obs_pass(root: PredictiveUnit, ann: dict,
+                    prefix: str) -> list[Finding]:
+    """Fleet-observability admission (GL14xx, active when any
+    ``seldon.io/fleet-obs-*`` annotation is set): validates the family
+    through the same parser the gateway and operator use (GL1401), warns
+    when obs knobs are set without ``seldon.io/fleet-replicas`` — a
+    one-replica deployment has no fleet to observe, so the scraper and
+    the skew analysis never run (GL1402) — and reports the effective
+    config (GL1403)."""
+    from seldon_core_tpu.fleet import (
+        FLEET_REPLICAS_ANNOTATION,
+        fleet_config_from_annotations,
+        observe_config_from_annotations,
+    )
+
+    obs_keys = [k for k in ann if k.startswith("seldon.io/fleet-obs-")]
+    if not obs_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = observe_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(FLEET_OBS_ANNOTATION_INVALID, path0, str(e))]
+    findings: list[Finding] = []
+    try:
+        fleet_cfg = fleet_config_from_annotations(ann, "lint")
+        fleet_on = fleet_cfg.enabled
+    except ValueError:
+        fleet_on = False  # GL1301 already reports the broken fleet knob
+    if not fleet_on:
+        findings.append(make_finding(
+            FLEET_OBS_WITHOUT_FLEET, path0,
+            f"{', '.join(sorted(obs_keys))} set but "
+            f"{FLEET_REPLICAS_ANNOTATION} is absent — with no replica "
+            "set there is nothing to scrape or compare, the knobs have "
+            "no effect",
+        ))
+    findings.append(make_finding(
+        FLEET_OBS_CONFIG_REPORT, path0,
+        f"fleet observability on: scrape cache {cfg.interval_ms:g}ms, "
+        f"per-replica timeout {cfg.timeout_ms:g}ms, concurrency "
+        f"{cfg.concurrency}, outlier threshold {cfg.mad_k:g} MADs, "
+        f"decision ring {cfg.audit_capacity}",
+    ))
     return findings
 
 

@@ -25,6 +25,24 @@ from seldon_core_tpu.fleet.config import (
     fleet_config_from_annotations,
 )
 from seldon_core_tpu.fleet.http import fleet_body
+from seldon_core_tpu.fleet.observe import (
+    OBS_AUDIT_ANNOTATION,
+    OBS_CONCURRENCY_ANNOTATION,
+    OBS_DISABLED,
+    OBS_INTERVAL_ANNOTATION,
+    OBS_MAD_K_ANNOTATION,
+    OBS_TIMEOUT_ANNOTATION,
+    DecisionAudit,
+    FleetObserver,
+    ObserveConfig,
+    decision_audit,
+    decisions_body,
+    detect_outliers,
+    fleet_obs_body,
+    observe_config_from_annotations,
+    record_decision,
+    skew_scores,
+)
 from seldon_core_tpu.fleet.pool import (
     EJECTED,
     HEALTHY,
@@ -54,6 +72,22 @@ __all__ = [
     "FleetConfig",
     "fleet_config_from_annotations",
     "fleet_body",
+    "OBS_AUDIT_ANNOTATION",
+    "OBS_CONCURRENCY_ANNOTATION",
+    "OBS_DISABLED",
+    "OBS_INTERVAL_ANNOTATION",
+    "OBS_MAD_K_ANNOTATION",
+    "OBS_TIMEOUT_ANNOTATION",
+    "DecisionAudit",
+    "FleetObserver",
+    "ObserveConfig",
+    "decision_audit",
+    "decisions_body",
+    "detect_outliers",
+    "fleet_obs_body",
+    "observe_config_from_annotations",
+    "record_decision",
+    "skew_scores",
     "EJECTED",
     "HEALTHY",
     "PROBING",

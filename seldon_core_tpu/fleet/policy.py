@@ -50,6 +50,11 @@ def _score(rep: Replica) -> float:
         # headroom in [0,1]; 0.1 floor keeps a saturated replica
         # selectable (finite score) when everyone is saturated
         load = load / max(rep.headroom, 0.1)
+    # soft straggler penalty from the fleet observer (fleet/observe.py):
+    # steer away from the outlier without ejecting it — ties at load 0
+    # still need the +1 so an idle straggler scores worse than an idle peer
+    if rep.penalty:
+        load = (load + 1.0) * (1.0 + rep.penalty) - 1.0
     return load
 
 

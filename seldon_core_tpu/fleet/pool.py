@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from seldon_core_tpu.fleet.config import FleetConfig
+from seldon_core_tpu.fleet.observe import record_decision
 from seldon_core_tpu.fleet.ring import HashRing
 
 __all__ = ["Replica", "ReplicaPool", "HEALTHY", "EJECTED", "PROBING"]
@@ -50,6 +51,12 @@ class Replica:
     #: capacity headroom in [0, 1] from /admin/profile/capacity (None =
     #: the engine's profiling plane is off / not yet read)
     headroom: Optional[float] = None
+    #: soft routing penalty from the fleet observer's straggler scoring
+    #: (policy multiplies the load score by 1 + penalty; 0 = no skew)
+    penalty: float = 0.0
+    #: EWMA of observed per-request latency at the gateway (ms) — the
+    #: transport-inclusive skew signal the observer scores replicas on
+    ewma_ms: float = 0.0
 
     def snapshot(self) -> dict:
         out = {
@@ -62,6 +69,10 @@ class Replica:
             "failures": self.failures,
             "ejections": self.ejections,
         }
+        if self.penalty:
+            out["penalty"] = round(self.penalty, 3)
+        if self.ewma_ms:
+            out["ewmaMs"] = round(self.ewma_ms, 3)
         if self.eject_reason:
             out["ejectReason"] = self.eject_reason
         if self.verdict:
@@ -156,21 +167,33 @@ class ReplicaPool:
         with self._lock:
             replica.inflight += 1
 
-    def release(self, replica: Replica, ok: bool) -> None:
+    def release(self, replica: Replica, ok: bool,
+                latency_ms: Optional[float] = None) -> None:
         with self._lock:
             replica.inflight = max(0, replica.inflight - 1)
             a = self.ewma_alpha
             replica.ewma_inflight = (
                 (1 - a) * replica.ewma_inflight + a * replica.inflight
             )
+            if latency_ms is not None:
+                replica.ewma_ms = (
+                    latency_ms if replica.ewma_ms == 0.0
+                    else (1 - a) * replica.ewma_ms + a * latency_ms
+                )
+            readmitted = False
             if ok:
                 replica.forwards += 1
                 if replica.state == PROBING:
                     # half-open trial succeeded → readmit
                     replica.state = HEALTHY
                     replica.eject_reason = ""
+                    readmitted = True
             else:
                 replica.failures += 1
+        if readmitted:
+            record_decision("readmit", deployment=self.deployment,
+                            replica=replica.rid, url=replica.url,
+                            reason="half-open trial succeeded")
         if ok and self.metrics is not None:
             self.metrics.counter_inc(
                 "seldon_fleet_forwards_total",
@@ -198,12 +221,22 @@ class ReplicaPool:
                 {"deployment": self.deployment, "replica": replica.rid,
                  "reason": reason},
             )
+        if first:
+            # every ejection is explainable after the fact
+            # (/admin/fleet/decisions; fleet/observe.py DecisionAudit)
+            record_decision("eject", deployment=self.deployment,
+                            replica=replica.rid, reason=reason,
+                            url=replica.url, ejections=replica.ejections)
         self._emit_state_gauge()
 
     def readmit(self, replica: Replica) -> None:
         with self._lock:
+            was_out = replica.state != HEALTHY
             replica.state = HEALTHY
             replica.eject_reason = ""
+        if was_out:
+            record_decision("readmit", deployment=self.deployment,
+                            replica=replica.rid, url=replica.url)
         self._emit_state_gauge()
 
     def note_verdict(self, url: str, verdict: str,
@@ -228,6 +261,17 @@ class ReplicaPool:
         if rep is not None:
             with self._lock:
                 rep.headroom = headroom
+
+    def note_penalty(self, url: str, penalty: float) -> None:
+        """Soft routing penalty from the fleet observer's straggler
+        scoring (fleet/observe.py): the routing policy multiplies the
+        replica's load score by ``1 + penalty``, steering traffic away
+        without ejecting — the straggler keeps receiving enough traffic
+        to show recovery."""
+        rep = self.by_url(url)
+        if rep is not None:
+            with self._lock:
+                rep.penalty = max(0.0, float(penalty))
 
     def _advance_probes_locked(self) -> None:
         """Ejected → probing after the half-open window (caller holds

@@ -217,6 +217,13 @@ class Gateway:
         # 404 + the enablement hint (and ?meshes still reports the
         # process-wide mesh registry via the engine surface).
         self.placement = placement
+        # Fleet observability (docs/observability.md#fleet-observability):
+        # scatter-gather scraper + differential straggler analysis over
+        # the pooled deployments, served from /admin/fleet/* and feeding
+        # straggler penalties back into each pool's routing policy.
+        from seldon_core_tpu.fleet import FleetObserver
+
+        self.observer = FleetObserver(metrics=self.registry)
         if self.health is not None:
             from seldon_core_tpu.health import (
                 device_memory_probe,
@@ -317,6 +324,10 @@ class Gateway:
                            self._handle_profile_capacity)
         app.router.add_get("/admin/placement", self._handle_placement)
         app.router.add_get("/admin/fleet", self._handle_fleet)
+        for kind in ("traces", "health", "flightrecorder", "profile",
+                     "capacity", "decisions"):
+            app.router.add_get(f"/admin/fleet/{kind}",
+                               self._fleet_obs_route(kind))
         return app
 
     async def _handle_token(self, request: web.Request) -> web.Response:
@@ -375,6 +386,10 @@ class Gateway:
         # slot — they cost no engine work, so refusing (or charging) them
         # under overload would throw away the cheapest capacity there is.
         cache_state: Optional[str] = None
+        # every engine attempt (including connect-failed ones) leaves one
+        # record here: the "hop log" behind the X-Seldon-Replica header
+        # and the hop spans /admin/fleet/traces stitches by
+        hops: list[dict] = []
         with contextlib.ExitStack() as stack:
             root = None
             if tctx is not None:
@@ -397,7 +412,8 @@ class Gateway:
 
                     async def compute():
                         st, bd = await self._admitted_forward(
-                            rec, path, body, content_type, qctx, admission
+                            rec, path, body, content_type, qctx, admission,
+                            hops=hops,
                         )
                         if st == 200:
                             cache.put(key, (st, bd), len(bd) + len(key))
@@ -413,7 +429,8 @@ class Gateway:
                         cache_state = "miss"
             else:
                 out_status, out_body = await self._admitted_forward(
-                    rec, path, body, content_type, qctx, admission
+                    rec, path, body, content_type, qctx, admission,
+                    hops=hops,
                 )
             if path.endswith("/predictions") and not isinstance(
                 self.firehose, NullFirehose
@@ -450,6 +467,10 @@ class Gateway:
                             "shed", reason=_shed_reason(out_body),
                             status=out_status,
                         )
+        # the replica that actually answered (last hop that got a
+        # response); killed/ejected attempts precede it in the hop log
+        served = next((h["replica"] for h in reversed(hops)
+                       if h.get("status") and h.get("replica")), "")
         if self.health is not None:
             # unconditional flight record (unlike sampled traces): raw
             # body kept when small enough so tools/replay.py can re-issue
@@ -463,10 +484,12 @@ class Gateway:
                 status=out_status,
                 reason=_shed_reason(out_body) if out_status >= 400 else "",
                 duration_ms=elapsed_ms,
+                replica=served,
                 flags={
                     "shed": out_status == 429,
                     "cache": cache_state or "off",
                     "path": path,
+                    "attempts": len(hops),
                 },
                 request={
                     "body": body.decode("utf-8", "replace"),
@@ -479,6 +502,8 @@ class Gateway:
         headers: dict[str, str] = {}
         if cache_state:
             headers["X-Seldon-Cache"] = cache_state
+        if served:
+            headers["X-Seldon-Replica"] = served
         if out_status == 429:
             # every 429 leaving the gateway carries a Retry-After —
             # admission sheds (ours) and engine queue-full sheds alike
@@ -499,6 +524,7 @@ class Gateway:
         content_type: str,
         qctx: Optional[QosContext] = None,
         admission: Optional[AdmissionController] = None,
+        hops: Optional[list] = None,
     ) -> tuple[int, bytes]:
         """Admission gate around one engine forward.
 
@@ -508,7 +534,7 @@ class Gateway:
         their slot with the observed latency, feeding the AIMD limit."""
         if admission is None:
             return await self._forward_engine(rec, path, body, content_type,
-                                              qctx)
+                                              qctx, hops=hops)
         priority = qctx.priority if qctx is not None else "normal"
         if not admission.try_acquire(priority):
             return 429, json.dumps(
@@ -524,15 +550,39 @@ class Gateway:
         ok = False
         try:
             st, bd = await self._forward_engine(rec, path, body,
-                                                content_type, qctx)
+                                                content_type, qctx,
+                                                hops=hops)
             ok = st == 200
             return st, bd
         finally:
             admission.release(time.perf_counter() - t0, ok)
 
+    def _note_failed_hop(self, rec, path: str, rid: str, url: str,
+                         attempt: int, reason: str,
+                         elapsed_ms: float) -> None:
+        """A connect-failed / timed-out attempt is observable, not silent:
+        it leaves a flight record (status 503, its own reason) beside the
+        request's final record, so "why did this request take 2 hops" is
+        answerable from /admin/flightrecorder alone."""
+        if self.health is None:
+            return
+        ctx = current_trace()
+        self.health.ensure_started()
+        self.health.recorder.record(
+            trace_id=ctx.trace_id if ctx is not None else "",
+            deployment=rec.name,
+            route=(path,),
+            status=503,
+            reason=reason,
+            duration_ms=elapsed_ms,
+            replica=rid,
+            flags={"attempt": attempt, "retryHop": True, "url": url},
+        )
+
     async def _forward_engine(
         self, rec, path: str, body: bytes, content_type: str,
         qctx: Optional[QosContext] = None,
+        hops: Optional[list] = None,
     ) -> tuple[int, bytes]:
         """One engine forward with connection-failure retries (reference
         apife HttpRetryHandler.java: 3 attempts).  POST predict is safe to
@@ -616,49 +666,105 @@ class Gateway:
                 kwargs["timeout"] = aiohttp.ClientTimeout(total=rem)
             if replica is not None:
                 pool.acquire(replica)
-            try:
-                async with sess.post(
-                    url.rstrip("/") + path,
-                    data=body,
-                    headers=hop_headers,
-                    **kwargs,
-                ) as resp:
-                    out_body = await resp.read()
-                    out_status = resp.status
-                last_err = None
-                if replica is not None:
-                    pool.release(replica, ok=out_status < 500)
-                break
-            except aiohttp.ClientConnectorError as e:
-                # connection never established — the request cannot have
-                # reached the engine, so replaying it is safe; a pooled
-                # replica is excluded for this request AND ejected from
-                # membership (half-open re-probe readmits it)
-                last_err = e
-                if replica is not None:
-                    pool.release(replica, ok=False)
-                    pool.eject(replica, "connect-error")
-                    excluded.append(replica.url)
-            except asyncio.TimeoutError:
-                # the deadline budget expired mid-forward: the engine may
-                # still be computing, but the answer is already worthless
-                if replica is not None:
-                    pool.release(replica, ok=False)
-                return 504, json.dumps(
-                    {"status": {
-                        "code": 504, "status": "FAILURE",
-                        "reason": "DEADLINE_EXCEEDED",
-                        "info": "deadline budget exhausted while "
-                                "forwarding to the engine"}}
-                ).encode()
-            except aiohttp.ClientError as e:
-                # includes ServerDisconnectedError: the engine may have
-                # executed the (non-idempotent) request before dying — a
-                # replay could e.g. apply a MAB feedback reward twice
-                last_err = e
-                if replica is not None:
-                    pool.release(replica, ok=False)
-                break
+            rid = replica.rid if replica is not None else ""
+            t_attempt = time.perf_counter()
+            # hop span: one per ATTEMPT, failed ones included — the unit
+            # /admin/fleet/traces stitches a failover journey from.  The
+            # gateway root span (ambient via trace_scope) is its parent.
+            with contextlib.ExitStack() as hop_stack:
+                hop_sp = None
+                if self.tracer is not None and self.tracer.enabled:
+                    hop_sp = hop_stack.enter_context(self.tracer.span(
+                        "hop", kind="hop", replica=rid, url=url,
+                        attempt=attempt,
+                    ))
+                try:
+                    async with sess.post(
+                        url.rstrip("/") + path,
+                        data=body,
+                        headers=hop_headers,
+                        **kwargs,
+                    ) as resp:
+                        out_body = await resp.read()
+                        out_status = resp.status
+                        if not rid:
+                            # direct (poolless) forward: the engine says
+                            # who it is in its own response header
+                            rid = resp.headers.get("X-Seldon-Replica", "")
+                    last_err = None
+                    if hop_sp is not None:
+                        if rid and not hop_sp.attributes.get("replica"):
+                            hop_sp.attributes["replica"] = rid
+                        if out_status >= 500:
+                            hop_sp.status = f"ERROR: HTTP_{out_status}"
+                    if replica is not None:
+                        pool.release(
+                            replica, ok=out_status < 500,
+                            latency_ms=(time.perf_counter() - t_attempt)
+                            * 1000.0,
+                        )
+                    if hops is not None:
+                        hops.append({"replica": rid, "url": url,
+                                     "attempt": attempt,
+                                     "status": out_status})
+                    break
+                except aiohttp.ClientConnectorError as e:
+                    # connection never established — the request cannot
+                    # have reached the engine, so replaying it is safe; a
+                    # pooled replica is excluded for this request AND
+                    # ejected from membership (half-open re-probe
+                    # readmits it)
+                    last_err = e
+                    if hop_sp is not None:
+                        hop_sp.status = "ERROR: CONNECT_FAILED"
+                        hop_sp.attributes["eject_reason"] = "connect-error"
+                    if replica is not None:
+                        pool.release(replica, ok=False)
+                        pool.eject(replica, "connect-error")
+                        excluded.append(replica.url)
+                    if hops is not None:
+                        hops.append({"replica": rid, "url": url,
+                                     "attempt": attempt, "status": 0,
+                                     "error": "CONNECT_FAILED"})
+                    self._note_failed_hop(
+                        rec, path, rid, url, attempt, "CONNECT_FAILED",
+                        (time.perf_counter() - t_attempt) * 1000.0)
+                except asyncio.TimeoutError:
+                    # the deadline budget expired mid-forward: the engine
+                    # may still be computing, but the answer is already
+                    # worthless
+                    if hop_sp is not None:
+                        hop_sp.status = "ERROR: DEADLINE_EXCEEDED"
+                    if replica is not None:
+                        pool.release(replica, ok=False)
+                    if hops is not None:
+                        hops.append({"replica": rid, "url": url,
+                                     "attempt": attempt, "status": 0,
+                                     "error": "DEADLINE_EXCEEDED"})
+                    self._note_failed_hop(
+                        rec, path, rid, url, attempt, "DEADLINE_EXCEEDED",
+                        (time.perf_counter() - t_attempt) * 1000.0)
+                    return 504, json.dumps(
+                        {"status": {
+                            "code": 504, "status": "FAILURE",
+                            "reason": "DEADLINE_EXCEEDED",
+                            "info": "deadline budget exhausted while "
+                                    "forwarding to the engine"}}
+                    ).encode()
+                except aiohttp.ClientError as e:
+                    # includes ServerDisconnectedError: the engine may have
+                    # executed the (non-idempotent) request before dying —
+                    # a replay could e.g. apply a MAB feedback reward twice
+                    last_err = e
+                    if hop_sp is not None:
+                        hop_sp.status = f"ERROR: {type(e).__name__}"
+                    if replica is not None:
+                        pool.release(replica, ok=False)
+                    if hops is not None:
+                        hops.append({"replica": rid, "url": url,
+                                     "attempt": attempt, "status": 0,
+                                     "error": type(e).__name__})
+                    break
         if last_err is not None:
             return 503, json.dumps(
                 {"status": {"code": 503, "status": "FAILURE",
@@ -841,6 +947,7 @@ class Gateway:
                                         exclude=excluded)
                     if replica is not None:
                         url = replica.url
+                t_attempt = time.perf_counter()
                 try:
                     return await self._relay_stream(
                         request, rec, sess, body, url
@@ -850,6 +957,11 @@ class Gateway:
                     if replica is not None:
                         pool.eject(replica, "connect-error")
                         excluded.append(replica.url)
+                    self._note_failed_hop(
+                        rec, "/api/v0.1/stream",
+                        replica.rid if replica is not None else "", url,
+                        attempt, "CONNECT_FAILED",
+                        (time.perf_counter() - t_attempt) * 1000.0)
             return web.json_response(
                 {"status": {"code": 503, "status": "FAILURE",
                             "info": f"engine unreachable: {last_err}"}},
@@ -935,7 +1047,9 @@ class Gateway:
         """Collected-trace query endpoint: filter exported traces by
         deployment / status / min duration / drill id.
 
-        ``GET /admin/traces?deployment=d&status=error&min_ms=50&drill=x&n=20``
+        ``GET /admin/traces?deployment=d&status=error&min_ms=50&drill=x
+        &trace_id=...&replica=r1&n=20`` — ``replica`` matches either the
+        record's own replica or any hop span that attempted one.
         """
         collector = getattr(self.tracer, "collector", None)
         if collector is None:
@@ -959,6 +1073,8 @@ class Gateway:
             status=q.get("status"),
             min_duration_ms=min_ms,
             drill=q.get("drill"),
+            trace_id=q.get("trace_id"),
+            replica=q.get("replica"),
             n=n,
         )
         return web.json_response(
@@ -1059,6 +1175,70 @@ class Gateway:
             status, payload = fleet_body(
                 {name: entry[2] for name, entry in self._pools.items()},
                 request.query,
+            )
+        except ValueError:
+            return web.json_response(
+                {"error": "numeric query parameter expected"}, status=400
+            )
+        return web.json_response(payload, status=status)
+
+    def _fleet_obs_route(self, kind: str):
+        async def handler(request: web.Request) -> web.Response:
+            return await self._handle_fleet_obs(request, kind)
+
+        return handler
+
+    async def _handle_fleet_obs(self, request: web.Request,
+                                kind: str) -> web.Response:
+        """``/admin/fleet/{traces,health,flightrecorder,profile,capacity,
+        decisions}``: cross-replica aggregation over one pooled
+        deployment (``?deployment=`` — optional when exactly one pool
+        exists).  Scrapes fan out with bounded concurrency and per-
+        replica timeouts; dead replicas come back as ``unreachable``
+        inside a ``partial: true`` envelope, never as a 500 and never
+        touching the data path."""
+        from seldon_core_tpu.fleet.observe import (
+            OBS_DISABLED,
+            decisions_body,
+            fleet_obs_body,
+        )
+
+        try:
+            if kind == "decisions":
+                status, payload = decisions_body(self.observer.audit,
+                                                 request.query)
+                return web.json_response(payload, status=status)
+            # pools materialize lazily on first forward; build them here
+            # too so a scrape works before any traffic has arrived
+            for name in self.store.names():
+                r = self.store.by_name(name)
+                if r is not None:
+                    self._dep_pool(r)
+            pools = {name: entry[2] for name, entry in self._pools.items()
+                     if entry[2] is not None}
+            want = request.query.get("deployment")
+            if want is None and len(pools) == 1:
+                want = next(iter(pools))
+            pool = pools.get(want) if want else None
+            if pool is None:
+                return web.json_response(
+                    {**OBS_DISABLED, "deployments": sorted(pools)},
+                    status=404,
+                )
+            targets = [(rep.rid, rep.url) for rep in pool.replicas()]
+            gateway_records: list = []
+            if kind == "traces":
+                collector = getattr(self.tracer, "collector", None)
+                if collector is not None:
+                    gateway_records = collector.query(
+                        trace_id=request.query.get("trace_id"),
+                        deployment=want,
+                        n=int(request.query.get("n", 20)),
+                    )
+            status, payload = await fleet_obs_body(
+                self.observer, await self.session(), targets, kind,
+                request.query, deployment=want, pool=pool,
+                gateway_records=gateway_records,
             )
         except ValueError:
             return web.json_response(

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -193,6 +194,14 @@ class GraphEngine:
         self.placement = placement
         if placement is not None and self.plan is not None:
             placement.attach_plan(self.plan)
+        # replica identity (fleet observability, docs/observability.md):
+        # stamped on root spans, meta.tags["replica"], and flight records
+        # so fleet-level merges can attribute every record to the engine
+        # replica that produced it.  Env default for real pods (the
+        # operator sets SELDON_REPLICA per workload member); the local
+        # harness overrides per-object after construction — N in-process
+        # replicas cannot share an env var.
+        self.replica = os.environ.get("SELDON_REPLICA", "")
         self._fallback_node: Optional[_Node] = None
         if qos is not None and qos.config.fallback_node:
             node = self._nodes.get(qos.config.fallback_node)
@@ -288,6 +297,10 @@ class GraphEngine:
         if tctx is not None:
             stamp_trace_meta(request.meta, tctx)
             stamp_trace_meta(meta, tctx)
+        if self.replica:
+            # who answered: the serving replica's identity rides the
+            # response meta (replay strips tags, so parity holds)
+            meta.tags["replica"] = self.replica
         # QoS context: the wire channel (meta tags, stamped by the
         # gateway/REST layer) wins; in-process callers inherit the ambient
         # contextvar.  Restamped onto the request so remote hops see the
@@ -318,7 +331,9 @@ class GraphEngine:
                     # span carries the shed reason event, and the error
                     # status makes it survive tail sampling
                     with trace_scope(tctx), self.tracer.trace(
-                        meta.puid, graph=self.name
+                        meta.puid, graph=self.name,
+                        **({"replica": self.replica} if self.replica
+                           else {})
                     ) as root:
                         root.status = "ERROR: ADMISSION_SHED"
                         root.add_event(
@@ -368,7 +383,10 @@ class GraphEngine:
             else None
         )
         try:
-            with self.tracer.trace(meta.puid, graph=self.name) as root_sp:
+            with self.tracer.trace(
+                meta.puid, graph=self.name,
+                **({"replica": self.replica} if self.replica else {})
+            ) as root_sp:
                 if degrade is not None:
                     # degraded-mode serving: the primary subgraph is sick
                     # (breaker open) or shedding past the configured level
@@ -680,6 +698,7 @@ class GraphEngine:
                 reason=reason,
                 duration_ms=elapsed_ms,
                 flags=flags,
+                replica=self.replica,
             )
             health.note_request(elapsed_ms, code)
         except Exception:  # pragma: no cover - defensive

@@ -74,12 +74,16 @@ class FlightRecorder:
     """Bounded ring buffer of per-request records (plain dicts)."""
 
     def __init__(self, capacity: int = 1024, service: str = "",
-                 metrics=None):
+                 metrics=None, replica: str = ""):
         if capacity <= 0:
             raise ValueError("flight recorder capacity must be > 0")
         self.capacity = int(capacity)
         self.service = service
         self.metrics = metrics
+        #: replica identity stamped on every record (fleet observability:
+        #: merged views key records by replica; settable post-construction
+        #: by the harness/engine once the rid is known)
+        self.replica = replica
         self._ring: deque[dict] = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._recorded = 0
@@ -99,12 +103,14 @@ class FlightRecorder:
         flags: Optional[dict] = None,
         request: Optional[dict] = None,
         request_bytes: int = 0,
+        replica: str = "",
     ) -> dict:
         """Append one record; O(1), never raises on the hot path."""
         truncated = request_bytes > REQUEST_CAP_BYTES
         rec = {
             "ts": time.time(),
             "service": self.service,
+            "replica": replica or self.replica,
             "puid": puid,
             "traceId": trace_id,
             "deployment": deployment,
@@ -140,6 +146,7 @@ class FlightRecorder:
         puid: Optional[str] = None,
         min_ms: Optional[float] = None,
         errors_only: bool = False,
+        replica: Optional[str] = None,
         n: int = 50,
     ) -> list[dict]:
         """Newest-first filtered view (same filter surface as
@@ -149,6 +156,8 @@ class FlightRecorder:
         out = []
         for rec in reversed(records):
             if deployment is not None and rec["deployment"] != deployment:
+                continue
+            if replica is not None and rec.get("replica") != replica:
                 continue
             if status is not None and rec["status"] != status:
                 continue

@@ -48,7 +48,7 @@ def flightrecorder_body(plane: Optional[object],
                         query: Mapping[str, str]) -> Tuple[int, dict]:
     """Filtered flight-recorder view — the same filter surface as
     ``/admin/traces`` (``?deployment= ?status= ?puid= ?min_ms=
-    ?errors_only= ?n= ?stats``)."""
+    ?errors_only= ?replica= ?n= ?stats``)."""
     if plane is None:
         return 404, _DISABLED
     recorder = plane.recorder
@@ -61,6 +61,7 @@ def flightrecorder_body(plane: Optional[object],
         min_ms=float(query["min_ms"]) if "min_ms" in query else None,
         errors_only=str(query.get("errors_only", "")).lower()
         in ("1", "true", "yes"),
+        replica=query.get("replica"),
         n=int(query.get("n", 50)),
     )
     return 200, {"records": records, "stats": recorder.stats()}

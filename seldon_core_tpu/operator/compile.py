@@ -404,6 +404,23 @@ def fleet_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def fleet_obs_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/fleet-obs-*`` annotations → a validated
+    :class:`~seldon_core_tpu.fleet.ObserveConfig`.  Invalid values — a
+    non-positive scrape interval/timeout/concurrency, a degenerate
+    mad-k —
+    reject at admission; graphlint's GL14xx pass reports the same
+    defects, this is the hard stop for callers that skip linting."""
+    from seldon_core_tpu.fleet import observe_config_from_annotations
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return observe_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

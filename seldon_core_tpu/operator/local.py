@@ -411,6 +411,9 @@ class LocalDeployment:
         # engine's /admin/fleet answers with the replica-set snapshot;
         # a plain single-replica deployment keeps it None (404 + hint)
         self.fleet = None
+        #: replica identity; pods inherit the operator-injected env,
+        #: the in-process harness overrides via set_replica()
+        self.replica = os.environ.get("SELDON_REPLICA", "")
         self.metrics = EngineMetrics(MetricsRegistry(), deployment=dep.name)
         self.predictors = [
             LocalPredictor(dep, p, self.metrics,
@@ -471,6 +474,19 @@ class LocalDeployment:
                    for p in self.predictors]
         total = sum(weights) or len(weights)
         self._weights = [w / total if total else 1 / len(weights) for w in weights]
+
+    def set_replica(self, rid: str) -> None:
+        """Stamp replica identity on every per-replica surface: engine
+        span attributes + response meta, flight records, OpenMetrics
+        exemplars, and the X-Seldon-Replica response header — the keys
+        the fleet observability plane merges and stitches by
+        (docs/observability.md#fleet-observability)."""
+        self.replica = rid
+        self.metrics.registry.exemplar_labels["replica"] = rid
+        for p in self.predictors:
+            p.engine.replica = rid
+            if p.health is not None:
+                p.health.recorder.replica = rid
 
     def pick(self) -> LocalPredictor:
         if len(self.predictors) == 1:
@@ -563,7 +579,9 @@ class LocalFleet:
         from seldon_core_tpu.fleet import (
             Autoscaler,
             FleetConfig,
+            FleetObserver,
             fleet_config_from_annotations,
+            observe_config_from_annotations,
         )
 
         validate_deployment(dep)
@@ -587,6 +605,17 @@ class LocalFleet:
             )
         self.config = cfg
         self.autoscaler = Autoscaler(cfg)
+        # fleet observability (docs/observability.md#fleet-observability):
+        # the engine-side /admin/fleet/* aggregation endpoints scrape the
+        # replica set through this observer
+        try:
+            obs_cfg = observe_config_from_annotations(merged, dep.name)
+        except ValueError as e:
+            logger.warning("deployment %s: %s — fleet-obs defaults in "
+                           "effect", dep.name, e)
+            obs_cfg = None
+        self.observer = FleetObserver(obs_cfg)
+        self._obs_session = None
         #: manual demand/capacity/burn override for tests and drills —
         #: when None the live profiling/health planes are summed instead
         self.signals_override: Optional[dict] = None
@@ -611,7 +640,22 @@ class LocalFleet:
                 except Exception:
                     pass
         self._replicas.clear()
+        if self._obs_session is not None:
+            try:
+                await self._obs_session.close()
+            except Exception:
+                pass
+            self._obs_session = None
         self._unpublish()
+
+    async def obs_session(self):
+        """Lazy aiohttp session for the observability scrapes (shared
+        across scrapes; closed in stop())."""
+        import aiohttp
+
+        if self._obs_session is None or self._obs_session.closed:
+            self._obs_session = aiohttp.ClientSession()
+        return self._obs_session
 
     async def add_replica(self):
         """Spawn one more in-process replica (autoscale up / initial
@@ -633,6 +677,7 @@ class LocalFleet:
         local = LocalDeployment(self.spec, seed=self._seed,
                                 publish_status=False, component_wrap=wrap)
         local.fleet = self
+        local.set_replica(f"r{idx}")
         runner = web.AppRunner(
             build_app(engine=local, metrics=local.metrics), access_log=None
         )
@@ -752,6 +797,14 @@ class LocalFleet:
             burn_warn=bool(sig.get("burnWarn")),
         )
         self.last_decision = decision
+        if decision.changed:
+            from seldon_core_tpu.fleet.observe import record_decision
+
+            record_decision(
+                "autoscale", deployment=self.spec.name,
+                reason=decision.reason, current=decision.current,
+                desired=decision.desired,
+            )
         while len(self._replicas) < decision.desired:
             await self.add_replica()
         while len(self._replicas) > decision.desired:

@@ -516,6 +516,21 @@ class SeldonDeploymentController:
                     self.api.update(obj)
                 except Exception:
                     logger.exception("autoscale patch failed for %s", key)
+                else:
+                    # decision audit (docs/observability.md#fleet-
+                    # observability): every spec.replicas patch is
+                    # explainable after the fact from
+                    # /admin/fleet/decisions
+                    from seldon_core_tpu.fleet.observe import (
+                        record_decision,
+                    )
+
+                    record_decision(
+                        "autoscale", deployment=owner,
+                        reason=decision.reason, predictor=p.name,
+                        current=decision.current,
+                        desired=decision.desired,
+                    )
         return decisions
 
     # -- internals -------------------------------------------------------

@@ -237,6 +237,10 @@ def _health_paths() -> dict:
                      "schema": {"type": "number"}},
                     {"name": "errors_only", "in": "query",
                      "schema": {"type": "boolean"}},
+                    {"name": "replica", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "only records stamped by this fleet "
+                                    "replica (e.g. r0)"},
                     {"name": "n", "in": "query",
                      "schema": {"type": "integer", "default": 50}},
                     {"name": "stats", "in": "query",
@@ -382,6 +386,102 @@ def _fleet_paths() -> dict:
                 },
             }
         },
+        **_fleet_obs_paths(),
+    }
+
+
+def _fleet_obs_paths() -> dict:
+    """The fleet-observability surface — identical on gateway and engine
+    (docs/observability.md#fleet-observability): scatter-gather
+    aggregation over the replicas' own admin endpoints, plus the
+    decision audit ring."""
+    dep_param = {"name": "deployment", "in": "query",
+                 "schema": {"type": "string"},
+                 "description": "which deployment's fleet to scrape "
+                                "(defaults to the only one)"}
+    replica_param = {"name": "replica", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "narrow to one replica id (e.g. r0)"}
+    n_param = {"name": "n", "in": "query",
+               "schema": {"type": "integer", "default": 20}}
+    disabled = {"404": {"description": "no fleet to observe"}}
+    bad_num = {"400": {"description": "non-numeric query parameter"}}
+
+    def scrape_op(summary: str, extra: list) -> dict:
+        return {
+            "get": {
+                "summary": summary,
+                "tags": ["ops"],
+                "parameters": [dep_param, *extra],
+                "responses": {
+                    "200": {"description":
+                            "per-replica payloads keyed by replica id; "
+                            "partial: true + unreachable entries when a "
+                            "replica is down (a scrape never 500s)"},
+                    **bad_num, **disabled,
+                },
+            }
+        }
+
+    return {
+        "/admin/fleet/health": scrape_op(
+            "fleet health verdict: per-replica health fused with "
+            "MAD-based latency/error/compile skew — stragglers and "
+            "compile-skewed replicas named in signals",
+            [{"name": "refresh", "in": "query",
+              "schema": {"type": "boolean"},
+              "description": "bypass the scrape cache"}],
+        ),
+        "/admin/fleet/traces": scrape_op(
+            "cross-replica trace query; with trace_id, stitches the "
+            "gateway's hop spans together with each replica's server "
+            "spans into one tree",
+            [{"name": "trace_id", "in": "query",
+              "schema": {"type": "string"}}, replica_param, n_param],
+        ),
+        "/admin/fleet/flightrecorder": scrape_op(
+            "flight records aggregated across the fleet, each stamped "
+            "with its replica id",
+            [{"name": "status", "in": "query",
+              "schema": {"type": "integer"}},
+             {"name": "puid", "in": "query",
+              "schema": {"type": "string"}},
+             {"name": "min_ms", "in": "query",
+              "schema": {"type": "number"}},
+             {"name": "errors_only", "in": "query",
+              "schema": {"type": "boolean"}},
+             replica_param, n_param],
+        ),
+        "/admin/fleet/profile": scrape_op(
+            "per-replica folded flamegraph stacks, diffable with "
+            "profview fleet.json#r0 fleet.json#r1",
+            [n_param],
+        ),
+        "/admin/fleet/capacity": scrape_op(
+            "per-replica capacity estimates + the fleet-total sum",
+            [],
+        ),
+        "/admin/fleet/decisions": {
+            "get": {
+                "summary": "bounded audit ring of fleet control "
+                           "decisions: autoscale patches, ejections, "
+                           "readmissions — why the fleet is shaped the "
+                           "way it is",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "kind", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "autoscale | eject | readmit"},
+                    dep_param, replica_param,
+                    {"name": "n", "in": "query",
+                     "schema": {"type": "integer", "default": 50}},
+                ],
+                "responses": {
+                    "200": {"description": "decision records + ring stats"},
+                    **bad_num,
+                },
+            }
+        },
     }
 
 
@@ -436,6 +536,14 @@ def gateway_spec() -> dict:
                      "schema": {"type": "number"}},
                     {"name": "drill", "in": "query",
                      "schema": {"type": "string"}},
+                    {"name": "trace_id", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "exact trace id (stitch one request "
+                                    "across retry hops)"},
+                    {"name": "replica", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "only traces whose hop spans touched "
+                                    "this replica"},
                     {"name": "n", "in": "query",
                      "schema": {"type": "integer", "default": 50}},
                     {"name": "stats", "in": "query",
@@ -493,6 +601,18 @@ def engine_spec() -> dict:
                              "responses": {"200": {"description": "ok"}}}},
         "/trace": {"get": {"summary": "recent request trace spans",
                            "tags": ["ops"],
+                           "parameters": [
+                               {"name": "puid", "in": "query",
+                                "schema": {"type": "string"}},
+                               {"name": "trace_id", "in": "query",
+                                "schema": {"type": "string"}},
+                               {"name": "replica", "in": "query",
+                                "schema": {"type": "string"},
+                                "description": "only spans stamped by "
+                                               "this fleet replica"},
+                               {"name": "n", "in": "query",
+                                "schema": {"type": "integer"}},
+                           ],
                            "responses": {"200": {"description": "traces"}}}},
         **_health_paths(),
         **_profile_paths(),

@@ -249,7 +249,7 @@ class EngineServer:
         code = out.status.code if out.status and out.status.status == "FAILURE" else 200
         if self.metrics is not None:
             self.metrics.observe_request(self.name, time.perf_counter() - t0, code)
-        return _msg_response(out)
+        return self._stamp_replica(_msg_response(out))
 
     async def stream(self, request: web.Request) -> web.StreamResponse:
         """External streaming API: SSE events from a streaming graph
@@ -284,7 +284,15 @@ class EngineServer:
                 content_type="application/json",
             )
         out = await self.engine.send_feedback(fb)
-        return _msg_response(out)
+        return self._stamp_replica(_msg_response(out))
+
+    def _stamp_replica(self, resp: web.Response) -> web.Response:
+        """``X-Seldon-Replica`` on data-path answers: which replica
+        served, without opening the body (docs/observability.md)."""
+        rep = getattr(self.engine, "replica", "")
+        if rep:
+            resp.headers["X-Seldon-Replica"] = str(rep)
+        return resp
 
     async def ready(self, request: web.Request) -> web.Response:
         # drain semantics per reference /ready + preStop pause
@@ -339,7 +347,8 @@ class EngineServer:
             body = json.dumps({"collector": collector.stats()})
         elif collector is not None and (
             request.query.get("status") or request.query.get("min_ms")
-            or request.query.get("drill")
+            or request.query.get("drill") or request.query.get("trace_id")
+            or request.query.get("replica")
         ):
             # collector-backed filtered view (head+tail sampled exports)
             try:
@@ -355,6 +364,8 @@ class EngineServer:
                 status=request.query.get("status"),
                 min_duration_ms=min_ms,
                 drill=request.query.get("drill"),
+                trace_id=request.query.get("trace_id"),
+                replica=request.query.get("replica"),
                 n=n,
             )})
         else:
@@ -485,6 +496,52 @@ class EngineServer:
             content_type="application/json",
         )
 
+    async def fleet_obs(self, request: web.Request, kind: str) -> web.Response:
+        """``/admin/fleet/{traces,health,flightrecorder,profile,capacity,
+        decisions}``: cross-replica aggregation over the local harness's
+        replica set (a LocalFleet on ``engine.fleet``).  The scrape
+        targets include killed replicas — dead members come back inside
+        a ``partial: true`` envelope, never as a 500."""
+        from seldon_core_tpu.fleet.observe import (
+            OBS_DISABLED,
+            decision_audit,
+            decisions_body,
+            fleet_obs_body,
+        )
+
+        fleet = self._fleet_plane()
+        observer = getattr(fleet, "observer", None)
+        try:
+            if kind == "decisions":
+                audit = observer.audit if observer is not None \
+                    else decision_audit()
+                status, payload = decisions_body(audit, request.query)
+            elif fleet is None or observer is None:
+                status, payload = 404, OBS_DISABLED
+            else:
+                targets = [(rep["rid"], rep["url"])
+                           for rep in fleet.replicas()]
+                status, payload = await fleet_obs_body(
+                    observer, await fleet.obs_session(), targets, kind,
+                    request.query,
+                    deployment=getattr(fleet.spec, "name", ""),
+                )
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=_err_json(400, "numeric query parameter expected"),
+                content_type="application/json",
+            )
+        return web.Response(
+            status=status, text=json.dumps(payload),
+            content_type="application/json",
+        )
+
+    def _fleet_obs_route(self, kind: str):
+        async def handler(request: web.Request) -> web.Response:
+            return await self.fleet_obs(request, kind)
+
+        return handler
+
     def register(self, app: web.Application) -> None:
         app.router.add_post("/api/v0.1/predictions", self.predictions)
         app.router.add_post("/api/v0.1/stream", self.stream)
@@ -505,6 +562,10 @@ class EngineServer:
         app.router.add_get("/admin/profile/capacity", self.profile_capacity)
         app.router.add_get("/admin/placement", self.placement)
         app.router.add_get("/admin/fleet", self.fleet)
+        for kind in ("traces", "health", "flightrecorder", "profile",
+                     "capacity", "decisions"):
+            app.router.add_get(f"/admin/fleet/{kind}",
+                               self._fleet_obs_route(kind))
         app.router.add_get("/seldon.json", _openapi_handler("engine"))
 
 

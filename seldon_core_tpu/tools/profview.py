@@ -17,6 +17,13 @@ Usage::
     curl -s engine:8000/admin/profile | python -m seldon_core_tpu.tools.profview -
     python -m seldon_core_tpu.tools.profview profile.json --min-pct 1
     python -m seldon_core_tpu.tools.profview --diff before.txt after.json
+    curl -s gw:8080/admin/fleet/profile > fleet.json
+    python -m seldon_core_tpu.tools.profview fleet.json          # fleet sum
+    python -m seldon_core_tpu.tools.profview --diff fleet.json#r0 fleet.json#r1
+
+A ``#replica`` path suffix selects one replica's stacks out of an
+``/admin/fleet/profile`` envelope, so a straggler's profile diffs
+directly against a healthy peer's from the same scrape.
 
 No external dependencies — same posture as traceview.py.
 """
@@ -191,10 +198,31 @@ def render_diff(before: dict, after: dict, top: int = 25,
 # ---------------------------------------------------------------------------
 
 def _read(path: str) -> dict:
+    """Read one profile.  ``path`` may carry a ``#replica`` suffix
+    (``fleet.json#r0``) selecting one replica's stacks out of an
+    ``/admin/fleet/profile`` envelope — so two replicas of the same
+    fleet dump diff directly: ``--diff fleet.json#r0 fleet.json#r1``."""
+    path, _, rid = path.partition("#")
     if path == "-":
-        return load_profile(sys.stdin)
-    with open(path) as f:
-        return load_profile(f)
+        text = sys.stdin.read()
+    else:
+        with open(path) as f:
+            text = f.read()
+    if not rid:
+        return parse_collapsed(text)
+    body = json.loads(text)
+    replicas = body.get("replicas") if isinstance(body, dict) else None
+    payload = replicas.get(rid) if isinstance(replicas, dict) else None
+    if not isinstance(payload, dict) or not isinstance(
+            payload.get("folded"), str):
+        have = sorted(r for r in (replicas or {})
+                      if isinstance((replicas or {})[r], dict)
+                      and isinstance((replicas or {})[r].get("folded"), str))
+        raise SystemExit(
+            f"profview: no folded profile for replica {rid!r} in "
+            f"{path or 'stdin'}"
+            + (f" (have: {', '.join(have)})" if have else ""))
+    return parse_collapsed(payload["folded"])
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -204,7 +232,9 @@ def main(argv: Optional[list] = None) -> int:
     )
     ap.add_argument("path", nargs="?", default="",
                     help="collapsed 'stack count' file, /admin/profile "
-                         "JSON dump, or '-' for stdin")
+                         "JSON dump, or '-' for stdin; append #rN to "
+                         "select one replica from an /admin/fleet/profile "
+                         "dump")
     ap.add_argument("--diff", nargs=2, metavar=("BEFORE", "AFTER"),
                     help="diff two profiles frame-by-frame instead of "
                          "rendering one")

@@ -21,6 +21,13 @@ Usage::
     curl -s engine:8000/trace | python -m seldon_core_tpu.tools.traceview -
     python -m seldon_core_tpu.tools.traceview traces.jsonl \
         --introspect introspect.json --lanes memory,queue
+    curl -s 'gw:8080/admin/fleet/traces?trace_id=0af7...' | \\
+        python -m seldon_core_tpu.tools.traceview -
+
+The last form renders a stitched fleet journey: the gateway root with
+one indented ``-> hop rN`` lane per forward attempt (connect-failed
+hops show the ``eject_reason`` that pulled the replica from rotation),
+followed by each replica's own server-side trace.
 
 No external dependencies: the OTLP envelope is parsed right back into the
 plain span dicts the renderer consumes.
@@ -137,6 +144,23 @@ def load_traces(stream: Iterable[str]) -> list[tuple[dict, str]]:
             for rec in doc["traces"]:
                 if isinstance(rec, dict) and isinstance(rec.get("root"), dict):
                     out.append((rec["root"], str(rec.get("service", ""))))
+        elif "replicasInvolved" in doc and isinstance(doc.get("replicas"),
+                                                      dict):
+            # /admin/fleet/traces stitched envelope: the gateway journey
+            # (hop lanes) first, then each replica's server-side view
+            for rec in doc.get("gateway", []):
+                if isinstance(rec, dict) and isinstance(rec.get("root"), dict):
+                    out.append((rec["root"],
+                                str(rec.get("service", "") or "gateway")))
+            for rid, recs in doc["replicas"].items():
+                for rec in recs if isinstance(recs, list) else []:
+                    if not isinstance(rec, dict):
+                        continue
+                    root = rec.get("root")
+                    if root is None and "name" in rec:
+                        root = rec     # tracer.recent() items ARE the tree
+                    if isinstance(root, dict):
+                        out.append((root, str(rid)))
         elif "trace" in doc and isinstance(doc["trace"], dict):
             out.append((doc["trace"], ""))   # /trace?puid= shape
         elif "root" in doc and isinstance(doc["root"], dict):
@@ -236,12 +260,25 @@ def render_waterfall(root: dict, service: str = "", width: int = 100) -> str:
         ln = max(1, round(dur_ms / total_ms * bar_w))
         ln = min(ln, bar_w - lo)
         bar = " " * lo + "#" * ln + " " * (bar_w - lo - ln)
-        label = "  " * depth + sp.get("name", "?")
         kind = sp.get("kind", "")
-        if kind and kind != "request":
-            label += f" [{kind}]"
+        attrs = sp.get("attributes", {})
+        if kind == "hop":
+            # retry lane: one indented row per gateway attempt, labeled
+            # with the replica it targeted (connect-failed hops carry
+            # the eject_reason that pulled the replica from rotation)
+            rid = attrs.get("replica") or "?"
+            label = "  " * depth + f"-> hop {rid}"
+            attempt = attrs.get("attempt")
+            if attempt not in (None, ""):
+                label += f" #{attempt}"
+        else:
+            label = "  " * depth + sp.get("name", "?")
+            if kind and kind != "request":
+                label += f" [{kind}]"
         status = str(sp.get("status", "OK"))
         flag = "" if status == "OK" else f"  !! {status}"
+        if attrs.get("eject_reason"):
+            flag += f" ejected: {attrs['eject_reason']}"
         marks = "".join(
             " *" + ev.get("name", "?") for ev in sp.get("events", []))
         links = sp.get("links", [])

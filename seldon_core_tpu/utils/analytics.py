@@ -574,6 +574,43 @@ CATALOG: tuple[MetricInfo, ...] = (
         "each deployment's replica pool",
         ("deployment", "state"),
     ),
+    # -- fleet observability plane (docs/observability.md#fleet-
+    # observability): cross-replica aggregation, straggler detection,
+    # decision audit
+    MetricInfo(
+        "seldon_fleet_obs_verdict", "gauge",
+        "Fused fleet health verdict level (0 ok / 1 warn / 2 critical) "
+        "from the /admin/fleet/health differential analysis",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_fleet_obs_skew", "gauge",
+        "Per-replica robust z-score (MAD multiples from the fleet "
+        "median) on each compared dimension — latency, errors, compile "
+        "count; the straggler threshold is seldon.io/fleet-obs-mad-k",
+        ("deployment", "replica", "dimension"),
+    ),
+    MetricInfo(
+        "seldon_fleet_obs_straggler", "gauge",
+        "1 when the replica is currently flagged as a latency/error "
+        "straggler (named in the fleet verdict and penalized in "
+        "routing), else 0",
+        ("deployment", "replica"),
+    ),
+    MetricInfo(
+        "seldon_fleet_obs_unreachable", "gauge",
+        "Replicas that failed the last fleet-health scrape (timeout or "
+        "refused connect) — reported inside the partial envelope, "
+        "never a 500",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_fleet_obs_scrape_seconds", "histogram",
+        "Wall time of one bounded scatter-gather scrape across the "
+        "fleet, by aggregation endpoint (the admin surface's own "
+        "overhead, gated in CI)",
+        ("endpoint",),
+    ),
 )
 
 
@@ -781,6 +818,27 @@ def alert_rules() -> dict:
                         },
                     },
                     {
+                        "alert": "SeldonFleetStraggler",
+                        "expr": (
+                            "max(seldon_fleet_obs_straggler) "
+                            "by (deployment, replica) > 0"
+                        ),
+                        "for": "5m",
+                        "labels": {"severity": "warning"},
+                        "annotations": {
+                            "summary":
+                                "replica {{ $labels.replica }} of "
+                                "{{ $labels.deployment }} is a sustained "
+                                "straggler — its latency/error profile "
+                                "sits past the fleet's MAD threshold and "
+                                "routing is penalizing it "
+                                "(/admin/fleet/health names the "
+                                "dimension; profview --diff its "
+                                "/admin/fleet/profile stacks against a "
+                                "healthy peer)",
+                        },
+                    },
+                    {
                         "alert": "SeldonGatewayRetrying",
                         "expr": (
                             "sum(rate(seldon_api_gateway_retries_total[5m])) "
@@ -914,6 +972,15 @@ def grafana_dashboard() -> dict:
                ["sum(seldon_fleet_replicas) by (deployment, state)",
                 "sum(rate(seldon_fleet_ejections_total[5m])) "
                 "by (deployment, replica, reason)"], y=72, x=12),
+        _panel(21, "Fleet skew (MADs from fleet median, by replica)",
+               ["max(seldon_fleet_obs_skew) "
+                "by (deployment, replica, dimension)",
+                "max(seldon_fleet_obs_straggler) by (deployment, replica)"],
+               y=80, x=0),
+        _panel(22, "Fleet verdict + unreachable replicas",
+               ["max(seldon_fleet_obs_verdict) by (deployment)",
+                "max(seldon_fleet_obs_unreachable) by (deployment)"],
+               y=80, x=12),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",

@@ -64,6 +64,10 @@ class MetricsRegistry:
         self._help: dict[str, str] = {}
         # metric name -> count of distinct label sets across all kinds
         self._series_count: dict[str, int] = defaultdict(int)
+        # extra exemplar labels rendered alongside trace_id (fleet
+        # observability stamps {"replica": rid} here so a heatmap cell
+        # deep-links to both the trace AND the replica that served it)
+        self.exemplar_labels: dict[str, str] = {}
 
     def _key(self, name: str, labels: Optional[dict]) -> tuple:
         return (name, tuple(sorted((labels or {}).items())))
@@ -142,13 +146,19 @@ class MetricsRegistry:
 
     def _exemplar_suffix(self, key: tuple, bucket: int) -> str:
         """OpenMetrics exemplar for one bucket line:
-        `` # {trace_id="<128-bit hex>"} <value> <unix ts>`` — the deep-link
-        from a Grafana heatmap cell to the trace behind it."""
+        `` # {trace_id="<128-bit hex>",...} <value> <unix ts>`` — the
+        deep-link from a Grafana heatmap cell to the trace behind it
+        (plus any ``exemplar_labels``, e.g. the serving replica)."""
         ex = self._hist_exemplars.get((key, bucket))
         if ex is None:
             return ""
         trace_id, value, ts = ex
-        return f' # {{trace_id="{trace_id}"}} {value} {ts}'
+        inner = ",".join(
+            f'{k}="{_escape(v)}"'
+            for k, v in [("trace_id", trace_id),
+                         *sorted(self.exemplar_labels.items())]
+        )
+        return f" # {{{inner}}} {value} {ts}"
 
     # ---- exposition ----------------------------------------------------
     def render(self) -> str:
@@ -162,13 +172,18 @@ class MetricsRegistry:
             hist_sum = dict(self._hist_sum)
             hist_total = dict(self._hist_total)
             exemplars = dict(self._hist_exemplars)
+            ex_labels = sorted(self.exemplar_labels.items())
 
         def exemplar_suffix(key: tuple, bucket: int) -> str:
             ex = exemplars.get((key, bucket))
             if ex is None:
                 return ""
             trace_id, value, ts = ex
-            return f' # {{trace_id="{trace_id}"}} {value} {ts}'
+            inner = ",".join(
+                f'{k}="{_escape(v)}"'
+                for k, v in [("trace_id", trace_id), *ex_labels]
+            )
+            return f" # {{{inner}}} {value} {ts}"
 
         lines: list[str] = []
         seen_types: set[str] = set()

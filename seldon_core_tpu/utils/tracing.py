@@ -533,6 +533,11 @@ class SpanCollector:
             dep = root.attributes.get("deployment")
             if dep:
                 rec["deployment"] = str(dep)
+            rep = root.attributes.get("replica")
+            if rep:
+                # stable stitching key for fleet-level trace merges
+                # (/admin/fleet/traces; fleet/observe.py)
+                rec["replica"] = str(rep)
             if extra:
                 rec.update(extra)
             self._kept.append(rec)
@@ -551,6 +556,8 @@ class SpanCollector:
               status: Optional[str] = None,
               min_duration_ms: Optional[float] = None,
               drill: Optional[str] = None,
+              trace_id: Optional[str] = None,
+              replica: Optional[str] = None,
               n: int = 50) -> list[dict]:
         with self._lock:
             recs = list(self._kept)
@@ -563,6 +570,15 @@ class SpanCollector:
             if (min_duration_ms is not None
                     and rec.get("duration_ms", 0.0) < min_duration_ms):
                 continue
+            if trace_id and rec.get("trace_id") != trace_id:
+                continue
+            if replica:
+                # matches the serving replica (root attribute) OR any
+                # hop span that touched it (gateway retry journeys)
+                if (rec.get("replica") != replica
+                        and not self._span_has_attr(
+                            rec.get("root", {}), "replica", replica)):
+                    continue
             if drill:
                 state = rec.get("tracestate", {})
                 if (state.get("drill-id") != drill

@@ -1,0 +1,547 @@
+"""Fleet-observability tests: annotation parsing, MAD skew/outlier
+properties, the decision audit ring, the scatter-gather mergers, and the
+/admin/fleet/* aggregation surface on both the engine harness and the
+gateway (docs/observability.md#fleet-observability).
+
+The ISSUE acceptance properties live here: a replica killed mid-scrape
+yields a ``partial: true`` envelope (never a 500), a failed-over request
+is ONE stitched trace whose hop lanes and server spans span two
+replicas, and a slowed replica is named by a ``straggler`` signal while
+a uniform fleet never is.
+"""
+
+import asyncio
+import random
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu import fleet as fleet_registry
+from seldon_core_tpu.fleet.observe import (
+    DecisionAudit,
+    FleetObserver,
+    ObserveConfig,
+    decision_audit,
+    detect_outliers,
+    flatten_spans,
+    observe_config_from_annotations,
+    record_decision,
+    skew_scores,
+)
+from seldon_core_tpu.gateway.app import Gateway
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.operator.local import LocalFleet
+from seldon_core_tpu.operator.spec import SeldonDeployment
+from seldon_core_tpu.utils.tracing import SpanCollector, Tracer
+
+from tests.test_fleet import basic_auth, fleet_spec
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fleet_registry.clear()
+    decision_audit().clear()
+    yield
+    fleet_registry.clear()
+    decision_audit().clear()
+
+
+# ---------------------------------------------------------------------------
+# annotation parsing
+# ---------------------------------------------------------------------------
+
+class TestObserveConfig:
+    def test_defaults(self):
+        cfg = observe_config_from_annotations({})
+        assert cfg == ObserveConfig()
+        assert not cfg.knobs_set
+
+    def test_all_knobs(self):
+        cfg = observe_config_from_annotations({
+            "seldon.io/fleet-obs-interval-ms": "0",
+            "seldon.io/fleet-obs-timeout-ms": "900",
+            "seldon.io/fleet-obs-concurrency": "2",
+            "seldon.io/fleet-obs-mad-k": "5",
+            "seldon.io/fleet-obs-audit": "32",
+        })
+        assert cfg.interval_ms == 0.0 and cfg.timeout_ms == 900.0
+        assert cfg.concurrency == 2 and cfg.mad_k == 5.0
+        assert cfg.audit_capacity == 32
+        assert cfg.knobs_set
+
+    @pytest.mark.parametrize("ann,needle", [
+        ({"seldon.io/fleet-obs-interval-ms": "soon"},
+         "fleet-obs-interval-ms"),
+        ({"seldon.io/fleet-obs-timeout-ms": "0"}, "fleet-obs-timeout-ms"),
+        ({"seldon.io/fleet-obs-concurrency": "0"},
+         "fleet-obs-concurrency"),
+        ({"seldon.io/fleet-obs-mad-k": "-1"}, "fleet-obs-mad-k"),
+        ({"seldon.io/fleet-obs-audit": "many"}, "fleet-obs-audit"),
+    ])
+    def test_invalid_names_the_annotation(self, ann, needle):
+        with pytest.raises(ValueError, match=needle):
+            observe_config_from_annotations(ann, "dep/p")
+        # the where-prefix lands in the message too
+        with pytest.raises(ValueError, match="dep/p"):
+            observe_config_from_annotations(ann, "dep/p")
+
+
+# ---------------------------------------------------------------------------
+# MAD skew: property-style over random fleets
+# ---------------------------------------------------------------------------
+
+class TestSkew:
+    def test_uniform_fleet_never_flags(self):
+        # near-identical replicas (±1% jitter) must never raise a
+        # straggler, whatever the fleet size or seed
+        for seed in range(25):
+            rng = random.Random(seed)
+            n = rng.randint(3, 12)
+            values = {f"r{i}": rng.uniform(99.0, 101.0) for i in range(n)}
+            assert detect_outliers(values) == [], values
+
+    def test_single_slow_replica_is_named(self):
+        for seed in range(25):
+            rng = random.Random(1000 + seed)
+            n = rng.randint(3, 12)
+            values = {f"r{i}": rng.uniform(9.0, 11.0) for i in range(n)}
+            values["r1"] = 100.0  # 10x the fleet
+            signals = detect_outliers(values)
+            assert [s["replica"] for s in signals] == ["r1"], values
+            assert signals[0]["signal"] == "straggler"
+            assert signals[0]["dimension"] == "latency"
+            assert signals[0]["score"] > 3.5
+
+    def test_fast_replica_is_not_a_defect(self):
+        values = {"r0": 10.0, "r1": 10.5, "r2": 9.5, "r3": 0.1}
+        assert detect_outliers(values) == []  # only the HIGH side flags
+
+    def test_two_replicas_cannot_name_an_outlier(self):
+        # with two members the median sits between them: neither can be
+        # called the straggler (which one is "slow"?)
+        assert detect_outliers({"r0": 10.0, "r1": 100.0}) == []
+
+    def test_scores_degenerate_inputs(self):
+        assert skew_scores({}) == {}
+        assert skew_scores({"r0": 5.0}) == {"r0": 0.0}
+        # identical values: MAD degenerates, fallback scale keeps 0s
+        assert set(skew_scores({"r0": 7.0, "r1": 7.0, "r2": 7.0})
+                   .values()) == {0.0}
+
+
+# ---------------------------------------------------------------------------
+# decision audit ring
+# ---------------------------------------------------------------------------
+
+class TestDecisionAudit:
+    def test_ring_is_bounded(self):
+        audit = DecisionAudit(capacity=8)
+        for i in range(20):
+            audit.record("eject", deployment="d", replica=f"r{i % 3}",
+                         reason="connect-error")
+        stats = audit.stats()
+        assert stats["size"] == 8 and stats["capacity"] == 8
+        assert stats["recorded"] == 20 and stats["dropped"] == 12
+        assert len(audit.query(n=100)) == 8
+
+    def test_query_filters(self):
+        audit = DecisionAudit(capacity=32)
+        audit.record("eject", deployment="a", replica="r0", reason="x")
+        audit.record("readmit", deployment="a", replica="r0")
+        audit.record("autoscale", deployment="b", current=1, desired=3)
+        assert [d["kind"] for d in audit.query(kind="eject")] == ["eject"]
+        assert all(d["deployment"] == "a"
+                   for d in audit.query(deployment="a"))
+        assert len(audit.query(replica="r0")) == 2
+        assert len(audit.query(n=1)) == 1
+
+    def test_process_default_never_raises(self):
+        rec = record_decision("autoscale", deployment="d", desired=2)
+        assert rec.get("kind") == "autoscale"
+        assert decision_audit().query(kind="autoscale")
+        # unserializable junk must not blow up the recording path
+        record_decision("eject", weird=object())
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            DecisionAudit(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# mergers (pure)
+# ---------------------------------------------------------------------------
+
+def _scrape(replicas, unreachable=()):
+    return {
+        "replicas": replicas,
+        "statuses": {r: (0 if r in unreachable else 200) for r in replicas},
+        "unreachable": sorted(unreachable),
+        "partial": bool(unreachable),
+        "scrapeMs": 1.0,
+    }
+
+
+class TestMergers:
+    def test_capacity_sums_numeric_keys(self):
+        merged = FleetObserver.merge_capacity(_scrape({
+            "r0": {"service": "a", "observedRps": 10.0,
+                   "achievableRps": 40.0},
+            "r1": {"service": "a", "observedRps": 6.0,
+                   "achievableRps": 35.0},
+            "r2": {"unreachable": True, "error": "boom"},
+        }, unreachable=("r2",)))
+        assert merged["fleet"]["observedRps"] == 16.0
+        assert merged["fleet"]["achievableRps"] == 75.0
+        assert merged["partial"] and merged["unreachable"] == ["r2"]
+
+    def test_flightrecorder_stamps_replica(self):
+        merged = FleetObserver.merge_flightrecorder(_scrape({
+            "r0": {"records": [{"puid": "a", "ts": 2.0}]},
+            "r1": {"records": [{"puid": "b", "ts": 5.0,
+                                "replica": "r1"}]},
+        }))
+        assert [r["replica"] for r in merged["records"]] == ["r1", "r0"]
+
+    def test_traces_stitch_hops_with_server_spans(self):
+        gw_rec = {"trace_id": "t1", "service": "gateway", "root": {
+            "name": "gateway", "kind": "request", "trace_id": "t1",
+            "children": [
+                {"name": "hop", "kind": "hop",
+                 "attributes": {"replica": "r0", "attempt": 1,
+                                "eject_reason": "connect-error"},
+                 "status": "ERROR: CONNECT_FAILED", "children": []},
+                {"name": "hop", "kind": "hop",
+                 "attributes": {"replica": "r1", "attempt": 2},
+                 "status": "OK", "children": []},
+            ]}}
+        scrape = _scrape({
+            "r0": {"unreachable": True, "error": "refused"},
+            "r1": {"traces": [
+                {"trace_id": "t1",
+                 "root": {"name": "llm", "kind": "request",
+                          "trace_id": "t1", "children": []}},
+                {"trace_id": "other",
+                 "root": {"name": "llm", "trace_id": "other",
+                          "children": []}},
+            ]},
+        }, unreachable=("r0",))
+        out = FleetObserver.merge_traces(scrape, gateway_records=[gw_rec],
+                                         trace_id="t1")
+        # ONE journey: both hops + r1's server span, other traces gone
+        assert out["traceId"] == "t1"
+        assert len(out["hops"]) == 2
+        assert out["replicasInvolved"] == ["r0", "r1"]
+        assert len(out["replicas"]["r1"]) == 1
+        failed = [h for h in out["hops"]
+                  if h["attributes"].get("eject_reason")]
+        assert failed and failed[0]["attributes"]["replica"] == "r0"
+
+    def test_flatten_spans_stamps_every_span(self):
+        tree = {"name": "a", "children": [
+            {"name": "b", "children": [{"name": "c", "children": []}]}]}
+        flat = flatten_spans(tree, "r7")
+        assert len(flat) == 3
+        assert all(s["replica"] == "r7" for s in flat)
+        assert all("children" not in s for s in flat)
+
+
+# ---------------------------------------------------------------------------
+# engine-side /admin/fleet/* over a real LocalFleet (chaos mid-scrape)
+# ---------------------------------------------------------------------------
+
+OBS_ANN = {
+    "seldon.io/fleet-replicas": "3",
+    "seldon.io/tracing": "true",
+    "seldon.io/health": "true",
+    "seldon.io/profile": "true",
+    "seldon.io/fleet-obs-interval-ms": "0",   # no cache: every GET scrapes
+    "seldon.io/fleet-obs-timeout-ms": "800",
+}
+
+
+class TestEngineFleetObs:
+    async def test_chaos_kill_mid_scrape_partial_never_500(self):
+        fl = await LocalFleet(fleet_spec("fleet-obs", ann=OBS_ANN)).start()
+        url = fl.replicas()[1]["url"]
+        session = await fl.obs_session()
+        body = {"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}}
+        try:
+            for rep in fl.replicas():
+                async with session.post(
+                        rep["url"] + "/api/v0.1/predictions",
+                        json=body) as r:
+                    assert r.status == 200
+                    # satellite: the engine names who answered
+                    assert r.headers["X-Seldon-Replica"] == rep["rid"]
+                    assert (await r.json())["meta"]["tags"]["replica"] \
+                        == rep["rid"]
+
+            async with session.get(url + "/admin/fleet/health") as r:
+                assert r.status == 200
+                payload = await r.json()
+            assert set(payload["replicas"]) == {"r0", "r1", "r2"}
+            assert not payload["partial"]
+
+            await fl.kill(0)  # crashed pod, mid-scrape from now on
+
+            async with session.get(url + "/admin/fleet/health") as r:
+                assert r.status == 200  # a scrape must never 500
+                payload = await r.json()
+            assert payload["partial"] is True
+            assert "r0" in payload["unreachable"]
+            assert payload["replicas"]["r0"]["unreachable"] is True
+            assert payload["verdict"] in ("warn", "critical")
+
+            async with session.get(url + "/admin/fleet/capacity") as r:
+                assert r.status == 200
+                cap = await r.json()
+            assert cap["partial"] is True
+            live = [p for p in cap["replicas"].values()
+                    if not p.get("unreachable")]
+            assert len(live) == 2
+            # fleet totals are the sum over live members (a dead replica
+            # contributes nothing, not a stale number)
+            key = "requests"
+            assert cap["fleet"][key] == pytest.approx(
+                sum(float(p[key]) for p in live))
+
+            async with session.get(url + "/admin/fleet/flightrecorder",
+                                   params={"replica": "r1"}) as r:
+                assert r.status == 200
+                fr = await r.json()
+            assert fr["records"]
+            assert all(rec["replica"] == "r1" for rec in fr["records"])
+        finally:
+            await fl.stop()
+
+    async def test_replica_filter_on_single_replica_surfaces(self):
+        fl = await LocalFleet(fleet_spec("fleet-flt", ann=OBS_ANN)).start()
+        session = await fl.obs_session()
+        body = {"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}}
+        try:
+            rep = fl.replicas()[2]
+            async with session.post(rep["url"] + "/api/v0.1/predictions",
+                                    json=body) as r:
+                assert r.status == 200
+            # /trace?replica= and /admin/flightrecorder?replica= filter
+            # on the stamped identity (satellite 1)
+            async with session.get(rep["url"] + "/trace",
+                                   params={"replica": "r2"}) as r:
+                assert (await r.json())["traces"]
+            async with session.get(rep["url"] + "/trace",
+                                   params={"replica": "r0"}) as r:
+                assert (await r.json())["traces"] == []
+            async with session.get(
+                    rep["url"] + "/admin/flightrecorder",
+                    params={"replica": "r0"}) as r:
+                assert (await r.json())["records"] == []
+        finally:
+            await fl.stop()
+
+    async def test_fleetless_engine_404s_with_hint_but_serves_decisions(
+            self):
+        from seldon_core_tpu.graph.engine import GraphEngine
+        from seldon_core_tpu.serving.rest import EngineServer
+
+        eng = GraphEngine({"name": "m", "implementation": "SIMPLE_MODEL"})
+        app = web.Application()
+        EngineServer(eng).register(app)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/admin/fleet/health")
+            assert r.status == 404
+            assert "hint" in await r.json()
+            record_decision("eject", deployment="x", replica="r9",
+                            reason="probe-failed")
+            r = await client.get("/admin/fleet/decisions")
+            assert r.status == 200
+            body = await r.json()
+            assert body["decisions"][0]["replica"] == "r9"
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# gateway: hop spans, header, stitching, decisions
+# ---------------------------------------------------------------------------
+
+class TestGatewayFleetObs:
+    async def _boot(self, name="fleet-gw"):
+        fl = await LocalFleet(fleet_spec(name, ann=OBS_ANN)).start()
+        store = DeploymentStore()
+        store.put(DeploymentRecord(
+            name=name, oauth_key="k", oauth_secret="s",
+            engine_urls=fl.urls(), annotations=OBS_ANN))
+        gw = Gateway(store, tracer=Tracer(
+            collector=SpanCollector(service="gateway")))
+        client = TestClient(TestServer(gw.build_app()))
+        await client.start_server()
+        resp = await client.post(
+            "/oauth/token", data={"grant_type": "client_credentials"},
+            headers={"Authorization": basic_auth("k", "s")})
+        token = (await resp.json())["access_token"]
+        return fl, gw, client, {"Authorization": f"Bearer {token}"}
+
+    async def test_failed_over_request_is_one_stitched_trace(self):
+        fl, gw, client, hdr = await self._boot()
+        body = {"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}}
+        try:
+            # warm the pool with r0 healthy, THEN crash it: the next
+            # request routed its way must fail over (and be traced)
+            for _ in range(6):
+                resp = await client.post("/api/v0.1/predictions",
+                                         json=body, headers=hdr)
+                assert resp.status == 200
+            await fl.kill(0)
+            served = set()
+            for _ in range(12):
+                resp = await client.post("/api/v0.1/predictions",
+                                         json=body, headers=hdr)
+                assert resp.status == 200
+                # satellite: the gateway reports who actually served
+                served.add(resp.headers.get("X-Seldon-Replica"))
+            assert served <= {"r1", "r2"} and served
+
+            # find the failed-over request: a root with >= 2 hop lanes
+            resp = await client.get("/admin/traces", headers=hdr)
+            records = (await resp.json())["traces"]
+            retried = [
+                rec for rec in records
+                if len([c for c in rec["root"].get("children", [])
+                        if c.get("kind") == "hop"]) >= 2
+            ]
+            assert retried, "no retried request was traced"
+            trace_id = retried[0]["trace_id"]
+
+            # hop cardinality: every attempt is exactly one hop span
+            hops = [c for c in retried[0]["root"]["children"]
+                    if c.get("kind") == "hop"]
+            assert [h["attributes"]["attempt"] for h in hops] \
+                == list(range(len(hops)))
+            failed = [h for h in hops if h["status"] != "OK"]
+            assert failed
+            assert failed[0]["attributes"]["replica"] == "r0"
+            assert failed[0]["attributes"]["eject_reason"] \
+                == "connect-error"
+
+            # ONE stitched journey across the fleet (tentpole assertion)
+            resp = await client.get("/admin/fleet/traces",
+                                    params={"trace_id": trace_id})
+            assert resp.status == 200
+            stitched = await resp.json()
+            assert stitched["traceId"] == trace_id
+            assert len(stitched["replicasInvolved"]) >= 2
+            assert "r0" in stitched["replicasInvolved"]
+            server_spans = [s for s in stitched["spans"]
+                            if s.get("replica") not in ("gateway", None)
+                            and s.get("kind") != "hop"]
+            assert any(s["replica"] in ("r1", "r2") for s in server_spans)
+
+            # the /admin/traces?replica= filter sees the hop identity
+            resp = await client.get("/admin/traces",
+                                    params={"replica": "r0"}, headers=hdr)
+            assert all(
+                any(c.get("attributes", {}).get("replica") == "r0"
+                    for c in rec["root"].get("children", []))
+                for rec in (await resp.json())["traces"])
+
+            # ejection decision is audited and queryable at the gateway
+            resp = await client.get("/admin/fleet/decisions",
+                                    params={"kind": "eject"})
+            assert resp.status == 200
+            ejects = (await resp.json())["decisions"]
+            assert any(d.get("reason") in ("connect-error", "probe-failed")
+                       for d in ejects)
+        finally:
+            await client.close()
+            await gw.close()
+            await fl.stop()
+
+    async def test_gateway_fleet_health_and_404_without_pool(self):
+        fl, gw, client, hdr = await self._boot(name="fleet-hv")
+        body = {"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}}
+        try:
+            for _ in range(3):
+                resp = await client.post("/api/v0.1/predictions",
+                                         json=body, headers=hdr)
+                assert resp.status == 200
+            resp = await client.get("/admin/fleet/health",
+                                    params={"deployment": "fleet-hv"})
+            assert resp.status == 200
+            payload = await resp.json()
+            assert set(payload["replicas"]) == {"r0", "r1", "r2"}
+            assert payload["verdict"] in ("ok", "warn", "critical")
+
+            resp = await client.get("/admin/fleet/health",
+                                    params={"deployment": "nope"})
+            assert resp.status == 404
+            assert "hint" in await resp.json()
+
+            resp = await client.get("/admin/fleet/flightrecorder",
+                                    params={"n": "many"})
+            assert resp.status == 400
+        finally:
+            await client.close()
+            await gw.close()
+            await fl.stop()
+
+
+# ---------------------------------------------------------------------------
+# straggler end-to-end: analysis over live flight records
+# ---------------------------------------------------------------------------
+
+class TestStragglerAnalysis:
+    def test_analyze_names_the_slowed_replica(self):
+        obs = FleetObserver(ObserveConfig(interval_ms=0))
+        lat = {"r0": 10.0, "r1": 11.0, "r2": 95.0, "r3": 10.5}
+
+        def flights(rid):
+            return {"records": [
+                {"puid": f"{rid}-{i}", "status": 200,
+                 "durationMs": lat[rid], "ts": float(i)}
+                for i in range(6)
+            ]}
+
+        health = _scrape({r: {"verdict": "ok", "level": 0, "signals": []}
+                          for r in lat})
+        payload = obs._analyze(
+            health, _scrape({r: flights(r) for r in lat}),
+            _scrape({r: {"segments": {}} for r in lat}), "dep")
+        names = [s["replica"] for s in payload["signals"]
+                 if s["signal"] == "straggler"]
+        assert names == ["r2"]
+        assert payload["verdict"] == "warn"
+        assert payload["skew"]["latency"]["r2"] > payload["madK"]
+
+    def test_analyze_uniform_fleet_stays_ok(self):
+        obs = FleetObserver(ObserveConfig(interval_ms=0))
+
+        def flights(ms):
+            return {"records": [{"status": 200, "durationMs": ms,
+                                 "ts": float(i)} for i in range(6)]}
+
+        health = _scrape({f"r{i}": {"verdict": "ok", "level": 0,
+                                    "signals": []} for i in range(4)})
+        payload = obs._analyze(
+            health,
+            _scrape({f"r{i}": flights(10.0 + 0.1 * i) for i in range(4)}),
+            _scrape({f"r{i}": {"segments": {}} for i in range(4)}), "dep")
+        assert payload["signals"] == []
+        assert payload["verdict"] == "ok"
+
+    def test_straggler_penalty_feeds_the_pool(self):
+        calls = {}
+
+        class PoolStub:
+            def note_penalty(self, url, penalty):
+                calls[url] = penalty
+
+        obs = FleetObserver(ObserveConfig(interval_ms=0))
+        obs._feed_pool(
+            PoolStub(), {"r0": "u0", "r1": "u1", "r2": "u2"},
+            {"signals": [{"signal": "straggler", "replica": "r2",
+                          "score": 7.0}]})
+        assert calls["u2"] == pytest.approx(2.0)  # 7.0 / mad_k 3.5
+        assert calls["u0"] == 0.0 and calls["u1"] == 0.0
