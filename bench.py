@@ -1691,6 +1691,124 @@ def shard_smoke() -> int:
     return 1 if failures else 0
 
 
+def artifact_smoke() -> int:
+    """Fast CI gate for the artifact plane (CPU-only, docs/artifacts.md):
+    boot the same 3-bucket fused MLP deployment twice against one
+    artifact store — the cold boot live-compiles and publishes every
+    bucket; the warm boot must hydrate everything (ZERO compiles on its
+    ledger, coverage 1.0, meta stamped artifact-source=aot-cache), reach
+    first inference >= 5x faster than cold, and answer byte-identically
+    on every bucket.  Returns a process exit code."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.operator.local import LocalDeployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    failures: list[str] = []
+    report: dict = {}
+    store_dir = tempfile.mkdtemp(prefix="seldon-artifact-smoke-")
+
+    def spec():
+        return SeldonDeployment.from_dict({
+            "apiVersion": "machinelearning.seldon.io/v1",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "artifact-smoke", "annotations": {
+                "seldon.io/batching": "false",
+                "seldon.io/graph-plan": "fused",
+                "seldon.io/artifact-store": store_dir,
+                "seldon.io/profile": "true",
+            }},
+            "spec": {"predictors": [{
+                "name": "p", "replicas": 1,
+                "graph": {
+                    "name": "clf", "type": "MODEL",
+                    "parameters": [{
+                        "name": "model_class",
+                        "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+                        "type": "STRING",
+                    }],
+                    "children": [],
+                },
+                "componentSpecs": [],
+            }]},
+        })
+
+    # 3 distinct shape buckets: batching off, so each row count is its
+    # own AOT-compiled bucket
+    xs = [np.linspace(0.0, 1.0, n * 784, dtype=np.float32).reshape(n, 784)
+          for n in (1, 4, 8)]
+
+    async def boot_and_drive() -> dict:
+        t0 = time.perf_counter()
+        local = LocalDeployment(spec(), seed=0)
+        p = local.predictors[0]
+        first = await p.engine.predict(SeldonMessage.from_ndarray(xs[0]))
+        ttfi_ms = (time.perf_counter() - t0) * 1e3
+        outs = [first.to_dict()["data"]]
+        tags = [dict(first.to_dict().get("meta", {}).get("tags", {}))]
+        for x in xs[1:]:
+            resp = await p.engine.predict(SeldonMessage.from_ndarray(x))
+            outs.append(resp.to_dict()["data"])
+            tags.append(dict(resp.to_dict().get("meta", {}).get("tags", {})))
+        return {
+            "ttfi_ms": round(ttfi_ms, 1),
+            "outputs": outs,
+            "artifact_source": [t.get("artifact-source") for t in tags],
+            "ledger": p.profiler.compile.stats(),
+            "plane": p.artifacts.snapshot(),
+            "coverage": p.artifacts.coverage(),
+        }
+
+    try:
+        cold = asyncio.run(boot_and_drive())
+        warm = asyncio.run(boot_and_drive())
+        report["cold"] = {k: cold[k] for k in
+                          ("ttfi_ms", "artifact_source", "ledger", "plane")}
+        report["warm"] = {k: warm[k] for k in
+                          ("ttfi_ms", "artifact_source", "ledger", "plane",
+                           "coverage")}
+
+        if cold["ledger"].get("compiles", 0) < 3:
+            failures.append(
+                f"cold boot should live-compile all 3 buckets, ledger "
+                f"shows {cold['ledger']}")
+        if cold["plane"].get("published", 0) < 3:
+            failures.append(
+                f"cold boot should publish 3 artifacts, plane shows "
+                f"{cold['plane']}")
+        if warm["ledger"].get("compiles", 0) != 0:
+            failures.append(
+                f"warm boot must be compile-free, ledger shows "
+                f"{warm['ledger']}")
+        if warm["plane"].get("liveCompiles", 0) != 0:
+            failures.append(
+                f"warm boot hit live compiles: {warm['plane']}")
+        if warm["coverage"]["coverage"] != 1.0:
+            failures.append(
+                f"warm coverage {warm['coverage']} != 1.0")
+        if warm["artifact_source"] != ["aot-cache"] * 3:
+            failures.append(
+                f"warm responses not stamped aot-cache: "
+                f"{warm['artifact_source']}")
+        if warm["outputs"] != cold["outputs"]:
+            failures.append("warm outputs differ from cold outputs")
+        ratio = cold["ttfi_ms"] / max(warm["ttfi_ms"], 1e-6)
+        report["ttfi_speedup"] = round(ratio, 1)
+        if ratio < 5.0:
+            failures.append(
+                f"warm TTFI speedup {ratio:.1f}x < 5x "
+                f"(cold {cold['ttfi_ms']}ms, warm {warm['ttfi_ms']}ms)")
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    print(json.dumps({"artifact_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 def _fleet_bench_spec(name: str, extra_ann: dict = None):
     """A single-node MNISTMLP SeldonDeployment spec for LocalFleet —
     batching off so every HTTP request is one engine invocation (the
@@ -3689,6 +3807,15 @@ def main() -> None:
                          "/admin/fleet/decisions, and the uncached "
                          "3-replica scrape p50 stays under budget; "
                          "then exit")
+    ap.add_argument("--artifact-smoke", action="store_true",
+                    help="fast CI gate: the same 3-bucket fused MLP "
+                         "deployment boots twice against one artifact "
+                         "store — cold boot live-compiles and publishes "
+                         "every bucket, warm boot hydrates everything "
+                         "(zero ledger compiles, coverage 1.0, responses "
+                         "stamped artifact-source=aot-cache), reaches "
+                         "first inference >= 5x faster, and answers "
+                         "byte-identically on every bucket; then exit")
     ap.add_argument("--shard-smoke", action="store_true",
                     help="fast CI gate (XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8): "
@@ -3717,6 +3844,8 @@ def main() -> None:
         sys.exit(fleet_smoke())
     if args.fleet_obs_smoke:
         sys.exit(fleet_obs_smoke())
+    if args.artifact_smoke:
+        sys.exit(artifact_smoke())
     if args.shard_smoke:
         sys.exit(shard_smoke())
     if os.environ.get("JAX_PLATFORMS"):

@@ -32,6 +32,9 @@ greps, and operator status all key on it), a severity, the unit path or
   vs the visible device count, overrides naming unknown segments,
   per-device HBM feasibility against the GL3xx budget, effective
   mesh/placement report)
+- ``GL15xx`` — artifact-plane admission (``seldon.io/artifact-*``
+  annotation validation, artifacts requested without a fused graph
+  plan, effective store/precompile/parity report)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 
@@ -102,6 +105,9 @@ FLEET_CONFIG_REPORT = "GL1305"      # fleet report: effective config
 FLEET_OBS_ANNOTATION_INVALID = "GL1401"  # seldon.io/fleet-obs-* value invalid
 FLEET_OBS_WITHOUT_FLEET = "GL1402"  # fleet-obs knobs set, fleet absent
 FLEET_OBS_CONFIG_REPORT = "GL1403"  # fleet-obs report: effective config
+ARTIFACT_ANNOTATION_INVALID = "GL1501"  # seldon.io/artifact-* value invalid
+ARTIFACTS_WITHOUT_PLAN = "GL1502"   # artifact knobs set, graph-plan not fused
+ARTIFACT_CONFIG_REPORT = "GL1503"   # artifact report: effective config
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -164,6 +170,9 @@ CODE_SEVERITY = {
     FLEET_OBS_ANNOTATION_INVALID: ERROR,
     FLEET_OBS_WITHOUT_FLEET: WARN,
     FLEET_OBS_CONFIG_REPORT: INFO,
+    ARTIFACT_ANNOTATION_INVALID: ERROR,
+    ARTIFACTS_WITHOUT_PLAN: WARN,
+    ARTIFACT_CONFIG_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,

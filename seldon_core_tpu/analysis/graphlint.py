@@ -28,6 +28,9 @@ import json
 from typing import Any, Optional
 
 from seldon_core_tpu.analysis.findings import (
+    ARTIFACT_ANNOTATION_INVALID,
+    ARTIFACT_CONFIG_REPORT,
+    ARTIFACTS_WITHOUT_PLAN,
     CACHE_ANNOTATION_INVALID,
     CACHE_FORCED_UNCACHEABLE,
     CACHE_NODE_UNCACHEABLE,
@@ -192,6 +195,7 @@ def lint_graph(
         findings.extend(_placement_pass(unit, ann, path_prefix))
         findings.extend(_fleet_pass(unit, ann, path_prefix))
         findings.extend(_fleet_obs_pass(unit, ann, path_prefix))
+        findings.extend(_artifact_pass(unit, ann, path_prefix))
     return findings
 
 
@@ -1329,6 +1333,53 @@ def _fleet_obs_pass(root: PredictiveUnit, ann: dict,
         f"per-replica timeout {cfg.timeout_ms:g}ms, concurrency "
         f"{cfg.concurrency}, outlier threshold {cfg.mad_k:g} MADs, "
         f"decision ring {cfg.audit_capacity}",
+    ))
+    return findings
+
+
+def _artifact_pass(root: PredictiveUnit, ann: dict,
+                   prefix: str) -> list[Finding]:
+    """Artifact-plane admission (GL15xx, active when any
+    ``seldon.io/artifact-*`` annotation is set): validates the family
+    through the same parser the operator uses (GL1501), warns when the
+    artifact store is configured without ``seldon.io/graph-plan=fused``
+    — only fused segments are AOT-compiled, so a walk-mode graph never
+    produces or hydrates an executable and every boot stays cold
+    (GL1502) — and reports the effective store/precompile/parity config
+    (GL1503)."""
+    from seldon_core_tpu.artifacts import (
+        ARTIFACTS_ANNOTATION,
+        ARTIFACT_PREFIX,
+        artifact_config_from_annotations,
+    )
+
+    art_keys = [k for k in ann
+                if k == ARTIFACTS_ANNOTATION or k.startswith(ARTIFACT_PREFIX)]
+    if not art_keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = artifact_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(ARTIFACT_ANNOTATION_INVALID, path0, str(e))]
+    if cfg is None or not cfg.enabled:
+        return []
+    findings: list[Finding] = []
+    mode = str(ann.get(PLAN_ANNOTATION, "walk")).strip().lower()
+    if mode != "fused":
+        findings.append(make_finding(
+            ARTIFACTS_WITHOUT_PLAN, path0,
+            f"{', '.join(sorted(art_keys))} set but "
+            f"{PLAN_ANNOTATION} is not 'fused' — only fused segments "
+            "are AOT-serialized, so no executable is ever published or "
+            "hydrated and every boot compiles cold",
+        ))
+    findings.append(make_finding(
+        ARTIFACT_CONFIG_REPORT, path0,
+        f"artifact plane on: store {cfg.store!r}, precompile "
+        f"{'on' if cfg.precompile else 'off'}, parity gate "
+        f"{'on' if cfg.parity else 'off'}, publish "
+        f"{'on' if cfg.publish else 'off'}",
     ))
     return findings
 

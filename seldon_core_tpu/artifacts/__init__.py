@@ -1,0 +1,72 @@
+"""Artifact plane (docs/artifacts.md): AOT-exported executables + a
+shared compile cache for millisecond warm starts.
+
+Every compiled fused segment (``graph/plan.py`` AOT
+``lower().compile()``) is serialized into an operator-managed,
+content-addressed artifact store living next to the safetensors
+checkpoints (``runtime/checkpoint.py``), keyed by segment hash × bucket
+× dtype × mesh/placement spec × jaxlib version.  On engine boot or
+fleet scale-up the plan hydrates executables from the store instead of
+compiling, falling back to a live compile on any key miss or
+deserialization failure — with byte-parity gating at publish time so
+only artifacts proven bitwise-equivalent to the freshly compiled
+program ever enter the store.
+
+Enabled by pointing ``seldon.io/artifact-store`` (or
+``SELDON_ARTIFACT_STORE``) at a directory; validated at admission
+(graphlint GL15xx, ``operator/compile.py artifact_config``); observable
+at ``/admin/artifacts``, ``status.artifacts`` and the
+``seldon_artifact_*`` metrics.
+"""
+
+from seldon_core_tpu.artifacts.config import (
+    ARTIFACT_PARITY_ANNOTATION,
+    ARTIFACT_PREFIX,
+    ARTIFACT_PRECOMPILE_ANNOTATION,
+    ARTIFACT_PUBLISH_ANNOTATION,
+    ARTIFACT_STORE_ANNOTATION,
+    ARTIFACTS_ANNOTATION,
+    ArtifactConfig,
+    artifact_config_from_annotations,
+)
+from seldon_core_tpu.artifacts.key import (
+    FORMAT_VERSION,
+    artifact_key,
+    jaxlib_version,
+    segment_fingerprint,
+)
+from seldon_core_tpu.artifacts.plane import ArtifactPlane
+from seldon_core_tpu.artifacts.registry import (
+    clear,
+    publish,
+    snapshot,
+    unpublish,
+)
+from seldon_core_tpu.artifacts.store import (
+    ArtifactBackend,
+    InMemoryArtifactStore,
+    LocalArtifactStore,
+)
+
+__all__ = [
+    "ARTIFACTS_ANNOTATION",
+    "ARTIFACT_PREFIX",
+    "ARTIFACT_STORE_ANNOTATION",
+    "ARTIFACT_PRECOMPILE_ANNOTATION",
+    "ARTIFACT_PARITY_ANNOTATION",
+    "ARTIFACT_PUBLISH_ANNOTATION",
+    "ArtifactConfig",
+    "artifact_config_from_annotations",
+    "FORMAT_VERSION",
+    "artifact_key",
+    "jaxlib_version",
+    "segment_fingerprint",
+    "ArtifactPlane",
+    "ArtifactBackend",
+    "LocalArtifactStore",
+    "InMemoryArtifactStore",
+    "publish",
+    "unpublish",
+    "snapshot",
+    "clear",
+]

@@ -1,0 +1,121 @@
+"""Content-addressed artifact identity (docs/artifacts.md#key-schema).
+
+One serialized executable is valid for exactly one (segment program,
+shape bucket, dtype, device topology, compiler) tuple — the key folds
+all five in, so any drift produces a MISS and a live compile rather
+than a wrong or crashing executable:
+
+- **segment fingerprint**: the fused program's identity — member
+  order/kinds/names plus a content digest of every parameter leaf
+  (a weight rollout re-fingerprints the segment, mirroring how
+  ``cache_version`` invalidates the prediction cache).
+- **bucket shape × dtype**: the jit-cache dispatch identity
+  (``FusedSegment.bucket_key``) — executables are shape-specialized.
+- **mesh/placement spec**: SNIPPETS.md [2]'s portability contract — an
+  executable AOT-lowered against one device topology must never load
+  into another, so the placement plane's canonical mesh spec string
+  (``PlacementConfig.spec()``, "" for single-device) is part of the key.
+- **jaxlib version**: serialized XLA executables are not stable across
+  compiler releases; a rolled jaxlib invalidates the whole store.
+- **format version**: the store's own layout escape hatch.
+
+Keys are blake2b hex digests (the ``caching/key.py`` idiom): equal keys
+⇒ byte-equal identity material, and nothing about the inputs can be
+recovered from the key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable
+
+__all__ = [
+    "FORMAT_VERSION",
+    "jaxlib_version",
+    "segment_fingerprint",
+    "artifact_key",
+]
+
+#: bump when the on-disk payload layout changes (pickle envelope fields)
+FORMAT_VERSION = 1
+
+
+def jaxlib_version() -> str:
+    """The compiler identity serialized executables are pinned to."""
+    try:
+        import jaxlib.version
+
+        return str(jaxlib.version.__version__)
+    except Exception:
+        try:
+            import jax
+
+            return str(jax.__version__)
+        except Exception:
+            return "unknown"
+
+
+def _digest_leaf(h, leaf) -> None:
+    """Fold one params-pytree leaf into the fingerprint: shape, dtype and
+    raw bytes for array-likes; repr for scalars/None (a traced fn only
+    closes over tensors and static config)."""
+    import numpy as np
+
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        arr = np.asarray(leaf)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    else:
+        h.update(repr(leaf).encode())
+
+
+def _digest_tree(h, tree) -> None:
+    """Canonical pre-order walk over the params container (sorted dict
+    keys, so insertion order cannot perturb the fingerprint)."""
+    if isinstance(tree, dict):
+        for k in sorted(tree, key=str):
+            h.update(str(k).encode())
+            _digest_tree(h, tree[k])
+    elif isinstance(tree, (list, tuple)):
+        h.update(f"[{len(tree)}]".encode())
+        for item in tree:
+            _digest_tree(h, item)
+    else:
+        _digest_leaf(h, tree)
+
+
+def segment_fingerprint(segment) -> str:
+    """Identity of one fused segment's PROGRAM: member structure + the
+    content of every parameter leaf.  Two segments with equal
+    fingerprints trace to the same jaxpr given the same input aval, so
+    an executable serialized by one replica loads into another."""
+    h = hashlib.blake2b(digest_size=16)
+    for st in segment.members:
+        h.update(st.name.encode())
+        h.update(b"\x00")
+        h.update(st.kind.encode())
+        h.update(b"\x00")
+        _digest_tree(h, st.params)
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
+def artifact_key(segment_fp: str, bucket_shape: Iterable[int], dtype: str,
+                 mesh_spec: str = "", jaxlib: str | None = None,
+                 format_version: int = FORMAT_VERSION) -> str:
+    """The store key: segment hash × bucket × dtype × mesh spec ×
+    jaxlib version × format version, blake2b-hexed."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(segment_fp).encode())
+    h.update(b"|")
+    h.update("x".join(str(int(d)) for d in bucket_shape).encode())
+    h.update(b"|")
+    h.update(str(dtype).encode())
+    h.update(b"|")
+    h.update(str(mesh_spec or "").encode())
+    h.update(b"|")
+    h.update((jaxlib if jaxlib is not None else jaxlib_version()).encode())
+    h.update(b"|")
+    h.update(str(int(format_version)).encode())
+    return h.hexdigest()

@@ -1,0 +1,462 @@
+"""Artifact plane: AOT-exported executables for millisecond warm starts.
+
+The fleet autoscaler adds a replica in one reconcile tick, but a cold
+engine still JIT-compiles every fused segment × bucket × dtype before
+its first request — seconds of dead device time the compile ledger
+(profiling/compilewatch.py) measures and nothing removes.  This plane
+removes it:
+
+- **publish**: every live ``lower().compile()`` in
+  ``FusedSegment._compile_bucket`` is serialized
+  (``jax.experimental.serialize_executable``) and written to the
+  content-addressed store — gated by a byte-parity check: the artifact
+  is deserialized back and must reproduce the freshly compiled
+  executable's output BITWISE on the live input before it is admitted.
+- **hydrate**: on engine boot / fleet scale-up, every stored bucket
+  whose key matches (segment fingerprint × mesh spec × jaxlib version)
+  is deserialized straight into the segment's compiled-bucket map —
+  milliseconds instead of seconds, zero compiles on the ledger.
+- **fallback**: a key miss, deserialization failure, or load-time
+  rejection falls back to a live compile; corrupt artifacts are
+  quarantined (deleted) so they cannot poison the next boot.
+
+The plane is wired by the engine AFTER the CompileWatch so hydrations
+land on the ledger as ``source=aot-cache`` rows, distinct from live
+compiles — the warm-boot CI gate asserts ZERO live compiles.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Optional
+
+from seldon_core_tpu.artifacts.config import ArtifactConfig
+from seldon_core_tpu.artifacts.key import (
+    FORMAT_VERSION,
+    artifact_key,
+    jaxlib_version,
+    segment_fingerprint,
+)
+from seldon_core_tpu.artifacts.store import (
+    ArtifactBackend,
+    LocalArtifactStore,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ArtifactPlane"]
+
+_HYDRATIONS_COUNTER = "seldon_artifact_hydrations_total"
+_PUBLISHES_COUNTER = "seldon_artifact_publishes_total"
+_MISSES_COUNTER = "seldon_artifact_misses_total"
+_PARITY_FAIL_COUNTER = "seldon_artifact_parity_failures_total"
+_DESERIALIZE_FAIL_COUNTER = "seldon_artifact_deserialize_failures_total"
+_STORE_ENTRIES_GAUGE = "seldon_artifact_store_entries"
+_STORE_BYTES_GAUGE = "seldon_artifact_store_bytes"
+_COVERAGE_GAUGE = "seldon_artifact_coverage"
+
+
+def _serialize_executable(compiled) -> bytes:
+    """Compiled → portable envelope.  Raises when the backend does not
+    support executable serialization (caller degrades to live-only)."""
+    from jax.experimental.serialize_executable import serialize
+
+    payload, in_tree, out_tree = serialize(compiled)
+    return pickle.dumps(
+        {"format": FORMAT_VERSION, "payload": payload,
+         "in_tree": in_tree, "out_tree": out_tree},
+        protocol=4,
+    )
+
+
+def _deserialize_executable(blob: bytes):
+    """Envelope → loaded ``jax.stages.Compiled`` (raises on any drift —
+    the caller quarantines and live-compiles)."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    env = pickle.loads(blob)
+    if env.get("format") != FORMAT_VERSION:
+        raise ValueError(
+            f"artifact format {env.get('format')!r} != {FORMAT_VERSION}")
+    return deserialize_and_load(
+        env["payload"], env["in_tree"], env["out_tree"])
+
+
+def _bitwise_equal(a, b) -> bool:
+    import numpy as np
+
+    a = np.asarray(a)
+    b = np.asarray(b)
+    return a.dtype == b.dtype and a.shape == b.shape \
+        and np.array_equal(a, b, equal_nan=True)
+
+
+class ArtifactPlane:
+    """One deployment's artifact posture: store + hydration/publish
+    counters + the admin/status surfaces (docs/artifacts.md)."""
+
+    def __init__(self, config: ArtifactConfig, metrics=None,
+                 deployment: str = "",
+                 backend: Optional[ArtifactBackend] = None):
+        self.config = config
+        self.metrics = metrics
+        self.deployment = deployment
+        self.store: ArtifactBackend = (
+            backend if backend is not None
+            else LocalArtifactStore(config.store)
+        )
+        self.jaxlib = jaxlib_version()
+        self.mesh_spec = ""  # set by attach_plan (placement in the key)
+        self._plan = None
+        self._lock = threading.Lock()
+        self.hydrated = 0
+        self.published = 0
+        self.misses = 0
+        self.live_compiles = 0
+        self.parity_failures = 0
+        self.deserialize_failures = 0
+        self.quarantined = 0
+
+    # -- wiring ----------------------------------------------------------
+    def attach_plan(self, plan, mesh_spec: str = "") -> None:
+        """Bind the compiled plan: every fused segment gets a back-ref
+        so ``_compile_bucket`` consults the store before compiling and
+        publishes after.  ``mesh_spec`` (``PlacementConfig.spec()``, ""
+        for single-device) becomes part of every key — an executable
+        lowered against one topology never loads into another."""
+        self._plan = plan
+        self.mesh_spec = mesh_spec or ""
+        for seg in plan.segments:
+            seg.artifacts = self
+
+    def _fingerprint(self, seg) -> str:
+        fp = getattr(seg, "_artifact_fp", None)
+        if fp is None:
+            fp = segment_fingerprint(seg)
+            seg._artifact_fp = fp
+        return fp
+
+    # -- hydrate (boot / scale-up path) ----------------------------------
+    def hydrate_plan(self, plan=None) -> int:
+        """Load every stored bucket matching this process's (segment,
+        mesh, jaxlib) identity straight into the segments' compiled
+        maps.  Returns buckets hydrated; never raises — a store problem
+        costs warm starts, not the deployment."""
+        plan = plan if plan is not None else self._plan
+        if plan is None:
+            return 0
+        total = 0
+        for seg in plan.segments:
+            try:
+                total += self._hydrate_segment(seg)
+            except Exception:
+                logger.warning("artifact hydration failed for segment %s",
+                               seg.label, exc_info=True)
+        self._export_store_gauges()
+        return total
+
+    def _hydrate_segment(self, seg) -> int:
+        fp = self._fingerprint(seg)
+        n = 0
+        for sc in self.store.sidecars(fp):
+            shape = tuple(int(d) for d in sc.get("bucketShape", ()))
+            dtype = str(sc.get("dtype", ""))
+            key = sc.get("key", "")
+            expect = artifact_key(fp, shape, dtype, self.mesh_spec,
+                                  self.jaxlib)
+            if key != expect:
+                # different mesh/jaxlib/format vintage: not ours to load
+                continue
+            bucket = (shape, dtype)
+            with seg._compile_lock:
+                if seg._compiled.get(bucket) is not None:
+                    continue
+            blob = self.store.get(fp, key)
+            if blob is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                loaded = _deserialize_executable(blob)
+            except Exception:
+                self._quarantine(seg, fp, key, "deserialize")
+                continue
+            wall_ms = (time.perf_counter() - t0) * 1000.0
+            cost = dict(sc.get("cost") or {})
+            cost["source"] = "aot-cache"
+            cost["hydrate_ms"] = round(wall_ms, 3)
+            with seg._compile_lock:
+                seg._compiled[bucket] = loaded
+                seg.hydrated.add(bucket)
+                seg.cost_by_bucket[bucket] = cost
+            n += 1
+            self.note_hydrated(seg, bucket, wall_ms, cost)
+        return n
+
+    def note_hydrated(self, seg, bucket: tuple, wall_ms: float,
+                      cost: dict) -> None:
+        """Ledger + counters for one bucket served from the store —
+        recorded as ``source=aot-cache``, never as a compile (the
+        warm-boot zero-compiles gate depends on the distinction)."""
+        with self._lock:
+            self.hydrated += 1
+        watch = seg.compile_watch
+        if watch is not None:
+            try:
+                shape, dtype = bucket
+                watch.note_compile(
+                    seg.label,
+                    bucket="x".join(str(d) for d in shape) + f":{dtype}",
+                    wall_ms=wall_ms,
+                    flops=cost.get("flops", 0.0),
+                    bytes_accessed=cost.get("bytes_accessed", 0.0),
+                    peak_hbm_bytes=cost.get("peak_hbm_bytes", 0.0),
+                    source="aot-cache",
+                )
+            except Exception:
+                pass
+        if self.metrics is not None:
+            try:
+                self.metrics.counter_inc(
+                    _HYDRATIONS_COUNTER, {"segment": seg.label})
+            except Exception:
+                pass
+
+    # -- request-path hooks (FusedSegment._compile_bucket) ----------------
+    def load_bucket(self, seg, bucket: tuple, x):
+        """Store lookup on a compiled-map miss (called under the
+        segment's compile lock, before a live compile).  Returns
+        ``(loaded, cost)`` or ``(None, None)`` on miss/corruption —
+        never raises."""
+        try:
+            fp = self._fingerprint(seg)
+            shape, dtype = bucket
+            key = artifact_key(fp, shape, dtype, self.mesh_spec,
+                               self.jaxlib)
+            blob = self.store.get(fp, key)
+            if blob is None:
+                with self._lock:
+                    self.misses += 1
+                if self.metrics is not None:
+                    self.metrics.counter_inc(
+                        _MISSES_COUNTER, {"segment": seg.label})
+                return None, None
+            t0 = time.perf_counter()
+            try:
+                loaded = _deserialize_executable(blob)
+            except Exception:
+                self._quarantine(seg, fp, key, "deserialize")
+                return None, None
+            cost = {"source": "aot-cache",
+                    "hydrate_ms":
+                        round((time.perf_counter() - t0) * 1000.0, 3)}
+            return loaded, cost
+        except Exception:
+            logger.debug("artifact load failed for segment %s bucket %s",
+                         seg.label, bucket, exc_info=True)
+            return None, None
+
+    def note_live_compile(self, seg, bucket: tuple) -> None:
+        """A bucket compiled live in this process (the warm-coverage
+        denominator's 'cold' side)."""
+        with self._lock:
+            self.live_compiles += 1
+
+    def publish_bucket(self, seg, bucket: tuple, compiled, x) -> bool:
+        """Serialize a freshly live-compiled executable into the store,
+        byte-parity-gated: the artifact's deserialized copy must
+        reproduce ``compiled``'s output bitwise on the live input, or
+        nothing is stored.  Called OUTSIDE the segment's compile lock
+        (it runs executables); never raises."""
+        if not self.config.publish:
+            return False
+        try:
+            fp = self._fingerprint(seg)
+            shape, dtype = bucket
+            key = artifact_key(fp, shape, dtype, self.mesh_spec,
+                               self.jaxlib)
+            blob = _serialize_executable(compiled)
+            parity = "unverified"
+            if self.config.parity:
+                loaded = _deserialize_executable(blob)
+                ref = compiled(seg._params, x)
+                got = loaded(seg._params, x)
+                if not _bitwise_equal(ref, got):
+                    with self._lock:
+                        self.parity_failures += 1
+                    if self.metrics is not None:
+                        self.metrics.counter_inc(
+                            _PARITY_FAIL_COUNTER, {"segment": seg.label})
+                    logger.warning(
+                        "segment %s bucket %s: artifact parity gate "
+                        "FAILED — not storing", seg.label, bucket)
+                    return False
+                parity = "verified"
+            cost = dict(seg.cost_by_bucket.get(bucket) or {})
+            cost.pop("source", None)
+            self.store.put(fp, key, blob, {
+                "key": key,
+                "segment": seg.label,
+                "segmentFingerprint": fp,
+                "bucketShape": list(shape),
+                "dtype": dtype,
+                "meshSpec": self.mesh_spec,
+                "jaxlibVersion": self.jaxlib,
+                "formatVersion": FORMAT_VERSION,
+                "parity": parity,
+                "payloadBytes": len(blob),
+                "cost": cost,
+                "createdAt": time.time(),
+            })
+            with self._lock:
+                self.published += 1
+            if self.metrics is not None:
+                self.metrics.counter_inc(
+                    _PUBLISHES_COUNTER, {"segment": seg.label})
+            self._export_store_gauges()
+            return True
+        except Exception:
+            # serialization unsupported on this backend, store readonly,
+            # disk full — all degrade to live-only serving
+            logger.debug("artifact publish failed for segment %s bucket %s",
+                         seg.label, bucket, exc_info=True)
+            return False
+
+    def _quarantine(self, seg, fp: str, key: str, why: str) -> None:
+        with self._lock:
+            self.deserialize_failures += 1
+            self.quarantined += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.counter_inc(
+                    _DESERIALIZE_FAIL_COUNTER, {"segment": seg.label})
+            except Exception:
+                pass
+        logger.warning(
+            "segment %s: quarantining artifact %s (%s failure) — live "
+            "compile takes over", seg.label, key, why)
+        try:
+            self.store.delete(fp, key)
+        except Exception:
+            pass
+
+    # -- read surfaces ----------------------------------------------------
+    def coverage(self) -> dict:
+        """Warm-start coverage of the attached plan: how many of the
+        buckets this process has needed so far came from the store.
+        ``coverage == 1.0`` with ``liveCompiles == 0`` is the warm-boot
+        contract the fleet admission gate and the CI drill assert."""
+        with self._lock:
+            hydrated = self.hydrated
+            live = self.live_compiles
+        total = hydrated + live
+        return {
+            "buckets": total,
+            "hydrated": hydrated,
+            "liveCompiles": live,
+            "coverage": round(hydrated / total, 4) if total else 1.0,
+        }
+
+    def source_tag(self) -> str:
+        """The per-replica compiler-path verdict stamped on response
+        meta (``meta.tags["artifact-source"]``): ``aot-cache`` when every
+        executable this replica serves came from the store, ``live``
+        otherwise."""
+        with self._lock:
+            return ("aot-cache"
+                    if self.live_compiles == 0 and self.hydrated > 0
+                    else "live")
+
+    def _export_store_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        try:
+            st = self.store.stats()
+            self.metrics.gauge_set(_STORE_ENTRIES_GAUGE,
+                                   float(st.get("entries", 0)))
+            self.metrics.gauge_set(_STORE_BYTES_GAUGE,
+                                   float(st.get("bytes", 0)))
+            self.metrics.gauge_set(_COVERAGE_GAUGE,
+                                   self.coverage()["coverage"])
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        """Compact posture for ``status.artifacts`` (reconcile tick)."""
+        cov = self.coverage()
+        with self._lock:
+            out = {
+                "enabled": self.config.enabled,
+                "store": getattr(self.store, "root",
+                                 type(self.store).__name__),
+                "meshSpec": self.mesh_spec,
+                "jaxlibVersion": self.jaxlib,
+                "hydrated": self.hydrated,
+                "published": self.published,
+                "misses": self.misses,
+                "liveCompiles": self.live_compiles,
+                "parityFailures": self.parity_failures,
+                "deserializeFailures": self.deserialize_failures,
+                "quarantined": self.quarantined,
+                "source": ("aot-cache"
+                           if self.live_compiles == 0 and self.hydrated > 0
+                           else "live"),
+            }
+        out["coverage"] = cov["coverage"]
+        try:
+            out["storeStats"] = self.store.stats()
+        except Exception:
+            pass
+        return out
+
+    def describe(self) -> dict:
+        """Full ``/admin/artifacts`` payload: the snapshot plus
+        per-segment bucket provenance (which executable came from
+        where) and the store's sidecar inventory for this plan."""
+        out = self.snapshot()
+        segments = []
+        plan = self._plan
+        if plan is not None:
+            for seg in plan.segments:
+                buckets = {}
+                for (shape, dtype), cost in seg.cost_by_bucket.items():
+                    label = "x".join(str(d) for d in shape) + f":{dtype}"
+                    buckets[label] = {
+                        "source": cost.get("source", "live"),
+                        **{k: cost[k] for k in
+                           ("compile_ms", "hydrate_ms", "flops")
+                           if k in cost},
+                    }
+                entry = {
+                    "segment": seg.label,
+                    "fingerprint": self._fingerprint(seg),
+                    "buckets": buckets,
+                }
+                stored = self.store.sidecars(entry["fingerprint"])
+                entry["stored"] = len(stored)
+                segments.append(entry)
+        out["segments"] = segments
+        return out
+
+    # -- health probe -----------------------------------------------------
+    def probe(self):
+        """Introspection-sampler probe (health/introspect.py): store
+        occupancy + warm coverage as ``seldon_artifact_*`` gauges."""
+        def _probe() -> dict:
+            try:
+                st = self.store.stats()
+            except Exception:
+                st = {}
+            cov = self.coverage()
+            with self._lock:
+                return {
+                    "artifact_store_entries":
+                        float(st.get("entries", 0)),
+                    "artifact_store_bytes": float(st.get("bytes", 0)),
+                    "artifact_hydrated": float(self.hydrated),
+                    "artifact_live_compiles": float(self.live_compiles),
+                    "artifact_coverage": float(cov["coverage"]),
+                }
+        return _probe

@@ -1,0 +1,57 @@
+"""Process-local artifact-plane registry: live warm-start facts → control
+plane.
+
+The reconcile loop surfaces each deployment's artifact posture (store
+occupancy, hydration coverage, parity failures) on the CR's
+``status.artifacts`` block — beside ``status.health``/``status.placement``
+and refreshed on the same tick.  Same seam as ``health/registry.py``:
+each :class:`~seldon_core_tpu.artifacts.plane.ArtifactPlane` owner
+publishes a snapshot provider keyed by deployment name and
+``operator/reconcile.py`` reads :func:`snapshot` when computing status.
+In a real cluster each engine pod serves the same facts from
+``/admin/artifacts`` and its ``seldon_artifact_*`` gauges, and the
+operator-side registry stays empty — ``status.artifacts`` is then
+omitted rather than invented.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+__all__ = ["publish", "unpublish", "snapshot", "clear"]
+
+_lock = threading.Lock()
+#: deployment name → snapshot provider () -> dict
+_providers: dict[str, Callable[[], dict]] = {}
+
+
+def publish(deployment: str, provider: Callable[[], dict]) -> None:
+    """Register (or replace) the snapshot provider for a deployment."""
+    with _lock:
+        _providers[deployment] = provider
+
+
+def unpublish(deployment: str) -> None:
+    with _lock:
+        _providers.pop(deployment, None)
+
+
+def snapshot(deployment: str) -> Optional[dict]:
+    """The deployment's current artifact posture, or None when no
+    runtime in this process serves it.  Provider errors surface as None
+    — status must never fail because a snapshot did."""
+    with _lock:
+        provider = _providers.get(deployment)
+    if provider is None:
+        return None
+    try:
+        return provider()
+    except Exception:
+        return None
+
+
+def clear() -> None:
+    """Test helper: forget every provider."""
+    with _lock:
+        _providers.clear()

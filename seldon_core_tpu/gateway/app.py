@@ -105,6 +105,7 @@ class Gateway:
         health=None,
         profiler=None,
         placement=None,
+        artifacts=None,
     ):
         self.store = store
         # SELDON_TOKEN_SIGNING_KEY (chart Secret) selects stateless signed
@@ -217,6 +218,12 @@ class Gateway:
         # 404 + the enablement hint (and ?meshes still reports the
         # process-wide mesh registry via the engine surface).
         self.placement = placement
+        # Artifact plane (docs/artifacts.md): AOT executables hydrate in
+        # the ENGINE runtimes — same posture as placement: no plane is
+        # built here, a colocated dev harness may hand one in so
+        # /admin/artifacts answers from the gateway too.  Without one the
+        # endpoint returns 404 + the enablement hint.
+        self.artifacts = artifacts
         # Fleet observability (docs/observability.md#fleet-observability):
         # scatter-gather scraper + differential straggler analysis over
         # the pooled deployments, served from /admin/fleet/* and feeding
@@ -323,6 +330,7 @@ class Gateway:
         app.router.add_get("/admin/profile/capacity",
                            self._handle_profile_capacity)
         app.router.add_get("/admin/placement", self._handle_placement)
+        app.router.add_get("/admin/artifacts", self._handle_artifacts)
         app.router.add_get("/admin/fleet", self._handle_fleet)
         for kind in ("traces", "health", "flightrecorder", "profile",
                      "capacity", "decisions"):
@@ -1149,6 +1157,12 @@ class Gateway:
         from seldon_core_tpu.profiling.http import capacity_body
 
         return await self._handle_profile_endpoint(request, capacity_body)
+
+    async def _handle_artifacts(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.artifacts.http import artifacts_body
+
+        status, payload = artifacts_body(self.artifacts, request.query)
+        return web.json_response(payload, status=status)
 
     async def _handle_placement(self, request: web.Request) -> web.Response:
         from seldon_core_tpu.placement.http import placement_body

@@ -89,6 +89,7 @@ class GraphEngine:
         health: Optional[Any] = None,
         profiler: Optional[Any] = None,
         placement: Optional[Any] = None,
+        artifacts: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -194,6 +195,23 @@ class GraphEngine:
         self.placement = placement
         if placement is not None and self.plan is not None:
             placement.attach_plan(self.plan)
+        # artifact plane (artifacts/, docs/artifacts.md): serialized AOT
+        # executables hydrate the plan's shape buckets from the
+        # content-addressed store instead of compiling — wired AFTER the
+        # CompileWatch (hydrations must land on the ledger as
+        # source=aot-cache rows) and AFTER placement (the mesh spec is
+        # part of every artifact key, and the sharding probe's live
+        # compiles must not race hydration).
+        self.artifacts = artifacts if self.plan is not None else None
+        if self.artifacts is not None:
+            spec = ""
+            if placement is not None:
+                try:
+                    spec = placement.config.spec()
+                except Exception:
+                    spec = ""
+            self.artifacts.attach_plan(self.plan, mesh_spec=spec)
+            self.artifacts.hydrate_plan(self.plan)
         # replica identity (fleet observability, docs/observability.md):
         # stamped on root spans, meta.tags["replica"], and flight records
         # so fleet-level merges can attribute every record to the engine
@@ -301,6 +319,12 @@ class GraphEngine:
             # who answered: the serving replica's identity rides the
             # response meta (replay strips tags, so parity holds)
             meta.tags["replica"] = self.replica
+        if self.artifacts is not None:
+            # which compiler path serves this replica: "aot-cache" when
+            # every executable hydrated from the artifact store, "live"
+            # otherwise — tools/replay.py parity runs assert it (replay
+            # strips tags from the canonical body, so parity holds)
+            meta.tags["artifact-source"] = self.artifacts.source_tag()
         # QoS context: the wire channel (meta tags, stamped by the
         # gateway/REST layer) wins; in-process callers inherit the ambient
         # contextvar.  Restamped onto the request so remote hops see the
@@ -678,6 +702,11 @@ class GraphEngine:
                 # so an operator reading one record knows the topology
                 # that served it
                 flags["mesh"] = self.placement.mesh_shape()
+            if self.artifacts is not None:
+                # compiler provenance: did a hydrated (aot-cache) or a
+                # live-compiled program answer — replayable evidence for
+                # the warm-start drill
+                flags["artifactSource"] = self.artifacts.source_tag()
             if meta.routing:
                 flags["routing"] = dict(meta.routing)
             if cost is not None and cost["flops"] > 0:

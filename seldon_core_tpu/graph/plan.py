@@ -304,6 +304,15 @@ class FusedSegment:
         self._compiled: dict = {}
         self._compile_lock = threading.Lock()
         self.cost_by_bucket: dict = {}
+        # artifact plane (artifacts/plane.py): when attached, a bucket
+        # miss consults the content-addressed store BEFORE compiling
+        # (warm start) and a live compile is serialized back into it;
+        # ``hydrated`` buckets came from the store, ``live_compiled``
+        # ones were compiled in this process — warmup skips the former
+        # and the coverage/ledger surfaces tell them apart
+        self.artifacts = None
+        self.hydrated: set = set()
+        self.live_compiled: set = set()
         self._names_cache: dict = {}
         # sharded executor (placement plane, enable_sharding): a second
         # jitted callable whose in/out shardings split the batch dim over
@@ -587,15 +596,30 @@ class FusedSegment:
                 str(getattr(x, "dtype", "")))
 
     def _compile_bucket(self, key: tuple, x):
-        """First dispatch of a shape bucket: AOT-compile it
+        """First dispatch of a shape bucket: consult the artifact store
+        (warm start — a hit deserializes the executable in milliseconds,
+        recorded as ``source=aot-cache``), else AOT-compile it
         (``lower().compile()``), record wall time + cost_analysis into
         the ledger and the CompileWatch, and keep the executable — the
         serving path then calls it directly so the compile is paid ONCE
-        (the jit cache stays the fallback, not a second compile)."""
+        (the jit cache stays the fallback, not a second compile).  A
+        live compile is published back into the store, byte-parity
+        gated, so the NEXT replica boots warm."""
+        art = self.artifacts
         with self._compile_lock:
             hit = self._compiled.get(key, _UNCOMPILED)
             if hit is not _UNCOMPILED:
                 return hit
+            if art is not None:
+                t0 = time.perf_counter()
+                loaded, acost = art.load_bucket(self, key, x)
+                if loaded is not None:
+                    wall_ms = (time.perf_counter() - t0) * 1000.0
+                    self._compiled[key] = loaded
+                    self.hydrated.add(key)
+                    self.cost_by_bucket[key] = acost
+                    art.note_hydrated(self, key, wall_ms, acost)
+                    return loaded
             t0 = time.perf_counter()
             compiled = None
             cost: dict = {}
@@ -608,8 +632,10 @@ class FusedSegment:
                              exc_info=True)
             wall_ms = (time.perf_counter() - t0) * 1000.0
             cost["compile_ms"] = round(wall_ms, 3)
+            cost["source"] = "live"
             self._compiled[key] = compiled
             self.cost_by_bucket[key] = cost
+            self.live_compiled.add(key)
         watch = self.compile_watch
         if watch is not None:
             try:
@@ -624,6 +650,12 @@ class FusedSegment:
                 )
             except Exception:
                 pass
+        if art is not None:
+            art.note_live_compile(self, key)
+            if compiled is not None:
+                # publish OUTSIDE the compile lock — the parity gate
+                # runs both executables
+                art.publish_bucket(self, key, compiled, x)
         return compiled
 
     def cost_for_rows(self, rows: int) -> Optional[dict]:
@@ -765,7 +797,10 @@ class GraphPlan:
         """Pre-compile every batcher bucket of every segment (first TPU
         compile is seconds — pay it before traffic).  ``example_row`` may
         be supplied; otherwise it is derived from the entry node's static
-        signature (``models/__init__.py``).  Returns buckets warmed."""
+        signature (``models/__init__.py``).  A bucket whose executable
+        was already hydrated from the artifact store needs no dispatch —
+        it is skipped, so a warm boot's warmup is a no-op instead of N
+        redundant device round-trips.  Returns buckets warmed."""
         import numpy as np
 
         warmed = 0
@@ -778,15 +813,33 @@ class GraphPlan:
                     continue
                 dt = np.dtype(sig.input_dtype or "float32")
                 row = np.zeros(tuple(sig.input_shape[1:]), dt)
+            row = np.asarray(row)
+            if self._warm_buckets_ready(seg, row):
+                continue
             if seg.batcher is not None:
-                seg.batcher.warmup(np.asarray(row))
+                seg.batcher.warmup(row)
                 warmed += len(seg.batcher.buckets)
             else:
-                y = seg(np.asarray(row)[None])
+                y = seg(row[None])
                 if hasattr(y, "block_until_ready"):
                     y.block_until_ready()
                 warmed += 1
         return warmed
+
+    @staticmethod
+    def _warm_buckets_ready(seg: FusedSegment, row) -> bool:
+        """True when every bucket a warmup dispatch of ``row`` would
+        exercise already holds a ready executable (hydrated from the
+        artifact store or compiled earlier in this process)."""
+        dtype = str(row.dtype)
+        if seg.batcher is not None:
+            sizes = {seg.batcher.bucket_for(b) for b in seg.batcher.buckets}
+        else:
+            sizes = {1}
+        return all(
+            seg._compiled.get(((b,) + tuple(row.shape), dtype)) is not None
+            for b in sizes
+        )
 
 
 def _entry_signature(node: Any):

@@ -73,6 +73,13 @@ GAUGES = {
     "placement_sharded_dispatches":
         "seldon_runtime_placement_sharded_dispatches",
     "placement_device_bytes_max": "seldon_runtime_placement_device_bytes_max",
+    "artifact_store_entries": "seldon_artifact_store_entries",
+    "artifact_store_bytes": "seldon_artifact_store_bytes",
+    "artifact_hydrated": "seldon_artifact_hydrated",
+    "artifact_live_compiles": "seldon_artifact_live_compiles",
+    "artifact_coverage": "seldon_artifact_coverage",
+    "compile_cache_hits": "seldon_compile_cache_hits",
+    "compile_cache_misses": "seldon_compile_cache_misses",
 }
 
 
@@ -182,16 +189,18 @@ def profile_probe(profiler) -> Callable[[], dict]:
     ``device`` lane."""
 
     def probe() -> dict:
-        from seldon_core_tpu.utils import compile_cache_enabled
+        from seldon_core_tpu.utils import compile_cache_stats
 
         compile_stats = profiler.compile.stats()
+        cache = compile_cache_stats()
         return {
             "device_occupancy_est":
                 profiler.attribution.occupancy_estimate(),
             "compiles_total": float(compile_stats.get("compiles", 0)),
             "recompile_storm": 1.0 if profiler.storm_segments() else 0.0,
-            "compile_cache_enabled":
-                1.0 if compile_cache_enabled() else 0.0,
+            "compile_cache_enabled": 1.0 if cache["enabled"] else 0.0,
+            "compile_cache_hits": float(cache["hits"]),
+            "compile_cache_misses": float(cache["misses"]),
         }
 
     return probe

@@ -421,6 +421,24 @@ def fleet_obs_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def artifact_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/artifact-*`` annotations → a validated
+    :class:`~seldon_core_tpu.artifacts.ArtifactConfig`.  Invalid values
+    — a non-boolean knob, ``seldon.io/artifacts: "true"`` without a
+    store root — reject at admission; graphlint's GL15xx pass reports
+    the same defects, this is the hard stop for callers that skip
+    linting.  The operator pre-compiles (warm-publishes) at admission
+    time when ``precompile`` is on, off the serving hot path."""
+    from seldon_core_tpu.artifacts import artifact_config_from_annotations
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return artifact_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

@@ -180,6 +180,7 @@ class LocalPredictor:
         self.metrics = metrics or EngineMetrics(deployment=dep.name)
         ann = {**dep.annotations, **pred.annotations}
         from seldon_core_tpu.operator.compile import (
+            artifact_config,
             graph_plan_mode,
             health_config,
             placement_config,
@@ -272,6 +273,21 @@ class LocalPredictor:
             except (MeshPlanError, ValueError) as e:
                 logger.warning(
                     "placement plane disabled (mesh unavailable): %s", e)
+        # Artifact plane (docs/artifacts.md): AOT-serialized executables
+        # in a content-addressed store beside the checkpoints — a replica
+        # pointed at a populated store hydrates its fused segments in
+        # milliseconds instead of compiling them.  seldon.io/artifact-store
+        # (or SELDON_ARTIFACT_STORE) turns it on; fused plan only — walk
+        # mode has no AOT executables to serialize.
+        art_cfg = artifact_config(dep, pred)
+        self.artifacts = None
+        if art_cfg is not None and art_cfg.enabled and plan_mode == "fused":
+            from seldon_core_tpu.artifacts import ArtifactPlane
+
+            self.artifacts = ArtifactPlane(
+                art_cfg, metrics=self.metrics.registry,
+                deployment=dep.name,
+            )
         # persistent XLA compile cache: seldon.io/compile-cache is either a
         # boolean (default dir) or a cache-dir path; idempotent across
         # predictors (utils.enable_compile_cache)
@@ -308,10 +324,20 @@ class LocalPredictor:
             health=self.health,
             profiler=self.profiler,
             placement=self.placement,
+            artifacts=self.artifacts,
         )
-        if (self.engine.plan is not None
-                and ann.get("seldon.io/graph-plan-warmup", "").lower()
-                in ("1", "true", "yes")):
+        if self.engine.plan is None:
+            self.artifacts = None  # nothing fused: nothing to serialize
+        # warmup: the annotation opts in explicitly; an artifact plane
+        # with precompile on warms REGARDLESS — that is the operator's
+        # admission-time pre-compile, off the serving hot path.  Buckets
+        # already hydrated from the store are skipped inside warmup, so
+        # a warm boot's "precompile" is a no-op that only publishes
+        # buckets the store does not hold yet.
+        if self.engine.plan is not None and (
+                ann.get("seldon.io/graph-plan-warmup", "").lower()
+                in ("1", "true", "yes")
+                or (self.artifacts is not None and art_cfg.precompile)):
             self.engine.plan.warmup()
         if self.health is not None:
             self._wire_health_probes()
@@ -350,6 +376,8 @@ class LocalPredictor:
                 "placement",
                 placement_probe(self.placement,
                                 metrics=self.metrics.registry))
+        if self.artifacts is not None:
+            sampler.add_probe("artifacts", self.artifacts.probe())
         plan = self.engine.plan
         if plan is not None:
             for seg in plan.segments:
@@ -469,6 +497,23 @@ class LocalDeployment:
                 }
 
             placement_publish(dep.name, _placement_snapshot)
+        # same pattern for the artifact plane: store occupancy + warm
+        # coverage land in status.artifacts (reconcile compute_status)
+        if publish_status and any(p.artifacts is not None
+                                  for p in self.predictors):
+            from seldon_core_tpu.artifacts import (
+                publish as artifacts_publish,
+            )
+
+            def _artifacts_snapshot(preds=self.predictors):
+                return {
+                    "predictors": [
+                        {"name": p.spec.name, **p.artifacts.snapshot()}
+                        for p in preds if p.artifacts is not None
+                    ]
+                }
+
+            artifacts_publish(dep.name, _artifacts_snapshot)
         self._rng = random.Random(seed)
         weights = [max(p.spec.replicas, 0) * max(p.spec.traffic, 0)
                    for p in self.predictors]
@@ -539,6 +584,16 @@ class LocalDeployment:
         for p in self.predictors:
             if p.placement is not None:
                 return p.placement
+        return None
+
+    @property
+    def artifacts(self):
+        """First artifact-enabled predictor's plane (the
+        ``/admin/artifacts`` endpoint reads ``engine.artifacts`` — same
+        delegation rationale as ``tracer``/``health``)."""
+        for p in self.predictors:
+            if p.artifacts is not None:
+                return p.artifacts
         return None
 
     async def predict(self, msg):
@@ -678,6 +733,18 @@ class LocalFleet:
                                 publish_status=False, component_wrap=wrap)
         local.fleet = self
         local.set_replica(f"r{idx}")
+        # warm-artifact admission gate (docs/artifacts.md): the replica's
+        # hydration + precompile ran synchronously inside the
+        # LocalDeployment build above, so by the time it enters the pool
+        # its first predict cannot hit a cold compile for any stored
+        # bucket.  The coverage verdict is recorded on the membership
+        # entry — the autoscaler's decision audit and status.fleet both
+        # show whether a scale-up was served warm (coverage 1.0, zero
+        # live compiles) or had to compile.
+        coverage = None
+        art = local.artifacts
+        if art is not None:
+            coverage = art.coverage()
         runner = web.AppRunner(
             build_app(engine=local, metrics=local.metrics), access_log=None
         )
@@ -692,6 +759,8 @@ class LocalFleet:
             "url": f"http://{self._host}:{port}",
             "killed": False,
         }
+        if coverage is not None:
+            rep["artifact_coverage"] = coverage
         self._replicas.append(rep)
         self._publish()
         return rep
@@ -744,7 +813,9 @@ class LocalFleet:
             "desired": len(self._replicas),
             "replicas": [
                 {"replica": rep["rid"], "url": rep["url"],
-                 "state": "killed" if rep["killed"] else "healthy"}
+                 "state": "killed" if rep["killed"] else "healthy",
+                 **({"artifactCoverage": rep["artifact_coverage"]}
+                    if "artifact_coverage" in rep else {})}
                 for rep in self._replicas
             ],
             "signals": self._signals(),
@@ -836,6 +907,7 @@ class LocalFleet:
         }
 
     def _publish(self) -> None:
+        from seldon_core_tpu.artifacts import publish as artifacts_publish
         from seldon_core_tpu.fleet import publish as fleet_publish
         from seldon_core_tpu.health import publish as health_publish
         from seldon_core_tpu.placement import publish as placement_publish
@@ -853,8 +925,13 @@ class LocalFleet:
             health_publish(dep, lambda: self._plane_status("health"))
         if any(p.placement is not None for p in sample):
             placement_publish(dep, lambda: self._plane_status("placement"))
+        if any(p.artifacts is not None for p in sample):
+            artifacts_publish(dep, lambda: self._plane_status("artifacts"))
 
     def _unpublish(self) -> None:
+        from seldon_core_tpu.artifacts import (
+            unpublish as artifacts_unpublish,
+        )
         from seldon_core_tpu.fleet import unpublish as fleet_unpublish
         from seldon_core_tpu.health import unpublish as health_unpublish
         from seldon_core_tpu.placement import (
@@ -867,6 +944,7 @@ class LocalFleet:
         qos_unpublish(dep)
         health_unpublish(dep)
         placement_unpublish(dep)
+        artifacts_unpublish(dep)
 
 
 def load_deployment_file(path: str) -> SeldonDeployment:

@@ -441,6 +441,18 @@ class SeldonDeploymentController:
         placement = placement_snapshot(owner)
         if placement is not None:
             status["placement"] = placement
+        # Artifact posture (docs/artifacts.md): warm-start coverage per
+        # segment — hydrated vs live-compiled buckets, store size, parity
+        # failures — published by the same process-local pattern
+        # (artifacts/registry.py).  Operators read this to confirm a
+        # scale-up came up warm (coverage 1.0, zero live compiles).
+        from seldon_core_tpu.artifacts import (
+            snapshot as artifacts_snapshot,
+        )
+
+        artifacts = artifacts_snapshot(owner)
+        if artifacts is not None:
+            status["artifacts"] = artifacts
         # Fleet posture (docs/scale-out.md): replica membership/health,
         # routing policy, and autoscale signals, published by the same
         # process-local pattern (fleet/registry.py).  When the CR opts in

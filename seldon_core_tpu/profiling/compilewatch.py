@@ -25,6 +25,7 @@ STORM_WINDOW_S = 60.0
 
 _COMPILE_COUNTER = "seldon_compile_total"
 _COMPILE_WALL_COUNTER = "seldon_compile_wall_ms_total"
+_HYDRATED_COUNTER = "seldon_compile_hydrated_total"
 _FLOPS_GAUGE = "seldon_compile_flops"
 _BYTES_GAUGE = "seldon_compile_bytes_accessed"
 _PEAK_HBM_GAUGE = "seldon_compile_peak_hbm_bytes"
@@ -53,23 +54,37 @@ class CompileWatch:
     def note_compile(self, segment: str, bucket: str = "",
                      wall_ms: float = 0.0, flops: float = 0.0,
                      bytes_accessed: float = 0.0,
-                     peak_hbm_bytes: float = 0.0) -> None:
-        """Record one shape-bucket compile; O(1), never raises (the
-        caller is the serving path's first dispatch per bucket)."""
+                     peak_hbm_bytes: float = 0.0,
+                     source: str = "live") -> None:
+        """Record one shape-bucket ledger row; O(1), never raises (the
+        caller is the serving path's first dispatch per bucket).
+
+        ``source`` records the compiler path: ``"live"`` is a real XLA
+        compile — it counts toward ``compiles``/``seldon_compile_total``
+        and the storm window; ``"aot-cache"`` is an executable hydrated
+        from the artifact store (artifacts/plane.py) — it lands on the
+        ledger as a ``hydrations`` row so the bucket is visible, but a
+        warm boot keeps a ZERO compile count (the CI warm-start gate)
+        and cannot trip the recompile-storm signal."""
         now = self.clock()
+        live = source == "live"
         try:
             with self._lock:
                 seg = self._segments.setdefault(segment, {
                     "compiles": 0,
+                    "hydrations": 0,
                     "wall_ms_total": 0.0,
                     "last_wall_ms": 0.0,
                     "buckets": {},
                     "recent": deque(maxlen=64),
                 })
-                seg["compiles"] += 1
-                seg["wall_ms_total"] += float(wall_ms)
-                seg["last_wall_ms"] = float(wall_ms)
-                seg["recent"].append(now)
+                if live:
+                    seg["compiles"] += 1
+                    seg["wall_ms_total"] += float(wall_ms)
+                    seg["last_wall_ms"] = float(wall_ms)
+                    seg["recent"].append(now)
+                else:
+                    seg["hydrations"] = seg.get("hydrations", 0) + 1
                 if len(seg["buckets"]) >= _MAX_BUCKETS and bucket not in \
                         seg["buckets"]:
                     seg["buckets"].pop(next(iter(seg["buckets"])))
@@ -78,6 +93,7 @@ class CompileWatch:
                     "flops": float(flops),
                     "bytes_accessed": float(bytes_accessed),
                     "peak_hbm_bytes": float(peak_hbm_bytes),
+                    "source": source,
                     "ts": now,
                 }
                 storm = self._storm_locked(seg, now)
@@ -88,9 +104,13 @@ class CompileWatch:
         if self.metrics is not None:
             try:
                 labels = {"segment": segment, "bucket": bucket}
-                self.metrics.counter_inc(_COMPILE_COUNTER, labels)
-                self.metrics.counter_inc(
-                    _COMPILE_WALL_COUNTER, {"segment": segment}, wall_ms)
+                if live:
+                    self.metrics.counter_inc(_COMPILE_COUNTER, labels)
+                    self.metrics.counter_inc(
+                        _COMPILE_WALL_COUNTER, {"segment": segment},
+                        wall_ms)
+                else:
+                    self.metrics.counter_inc(_HYDRATED_COUNTER, labels)
                 if flops:
                     self.metrics.gauge_set(_FLOPS_GAUGE, flops, labels)
                 if bytes_accessed:
@@ -129,6 +149,7 @@ class CompileWatch:
             for label, seg in self._segments.items():
                 segments[label] = {
                     "compiles": seg["compiles"],
+                    "hydrations": seg.get("hydrations", 0),
                     "wallMsTotal": round(seg["wall_ms_total"], 3),
                     "lastWallMs": round(seg["last_wall_ms"], 3),
                     "storm": self._storm_locked(seg, now),
@@ -149,6 +170,9 @@ class CompileWatch:
                 "segments": len(self._segments),
                 "compiles": sum(
                     s["compiles"] for s in self._segments.values()),
+                "hydrations": sum(
+                    s.get("hydrations", 0)
+                    for s in self._segments.values()),
                 "wallMsTotal": round(sum(
                     s["wall_ms_total"] for s in self._segments.values()), 3),
             }

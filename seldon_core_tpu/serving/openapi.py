@@ -362,6 +362,32 @@ def _placement_paths() -> dict:
     }
 
 
+def _artifact_paths() -> dict:
+    """The artifact-plane admin surface — identical on gateway and
+    engine (docs/artifacts.md)."""
+    return {
+        "/admin/artifacts": {
+            "get": {
+                "summary": "AOT artifact store posture: per-segment "
+                           "hydrated vs live-compiled buckets, store "
+                           "entries/bytes, parity failures, warm-start "
+                           "coverage",
+                "tags": ["ops"],
+                "parameters": [
+                    {"name": "coverage", "in": "query",
+                     "schema": {"type": "boolean"},
+                     "description": "return only the warm-start coverage "
+                                    "summary"},
+                ],
+                "responses": {
+                    "200": {"description": "artifact plane snapshot"},
+                    "404": {"description": "artifact plane disabled"},
+                },
+            }
+        },
+    }
+
+
 def _fleet_paths() -> dict:
     """The fleet-plane admin surface — identical on gateway and engine
     (docs/scale-out.md): per-replica health/load, the consistent-hash
@@ -560,6 +586,7 @@ def gateway_spec() -> dict:
         **_health_paths(),
         **_profile_paths(),
         **_placement_paths(),
+        **_artifact_paths(),
         **_fleet_paths(),
         **_ops_paths(),
     }
@@ -617,6 +644,7 @@ def engine_spec() -> dict:
         **_health_paths(),
         **_profile_paths(),
         **_placement_paths(),
+        **_artifact_paths(),
         **_fleet_paths(),
         **_ops_paths(),
     }

@@ -476,6 +476,20 @@ class EngineServer:
             content_type="application/json",
         )
 
+    def _artifacts_plane(self):
+        """The engine's artifact plane (duck attr, like ``placement``)."""
+        return getattr(self.engine, "artifacts", None)
+
+    async def artifacts(self, request: web.Request) -> web.Response:
+        from seldon_core_tpu.artifacts.http import artifacts_body
+
+        status, payload = artifacts_body(
+            self._artifacts_plane(), request.query)
+        return web.Response(
+            status=status, text=json.dumps(payload),
+            content_type="application/json",
+        )
+
     def _fleet_plane(self):
         """The engine's fleet harness (duck attr, like ``placement`` —
         a LocalFleet replica answers with the whole replica set)."""
@@ -561,6 +575,7 @@ class EngineServer:
         app.router.add_get("/admin/profile/compile", self.profile_compile)
         app.router.add_get("/admin/profile/capacity", self.profile_capacity)
         app.router.add_get("/admin/placement", self.placement)
+        app.router.add_get("/admin/artifacts", self.artifacts)
         app.router.add_get("/admin/fleet", self.fleet)
         for kind in ("traces", "health", "flightrecorder", "profile",
                      "capacity", "decisions"):

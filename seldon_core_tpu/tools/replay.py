@@ -40,6 +40,7 @@ __all__ = [
     "load_record",
     "replay_record",
     "canonical_body",
+    "artifact_source",
     "compare_responses",
     "main",
 ]
@@ -153,6 +154,24 @@ def canonical_body(body: bytes) -> bytes:
                       separators=(",", ":")).encode("utf-8")
 
 
+def artifact_source(body: bytes) -> str:
+    """The engine's compiler-path stamp from a response body:
+    ``meta.tags["artifact-source"]`` is ``"aot-cache"`` when every
+    fused-segment bucket the replica has dispatched was hydrated from
+    the artifact store, ``"live"`` otherwise, and ``""`` when the
+    artifact plane is off or the body is not a SeldonMessage.  Read
+    BEFORE canonicalization — tags are volatile meta and are dropped
+    from the parity comparison."""
+    try:
+        doc = json.loads(body)
+    except ValueError:
+        return ""
+    meta = doc.get("meta") if isinstance(doc, dict) else None
+    if isinstance(meta, dict) and isinstance(meta.get("tags"), dict):
+        return str(meta["tags"].get("artifact-source", ""))
+    return ""
+
+
 def compare_responses(a: bytes, b: bytes, strict: bool = False
                       ) -> Tuple[bool, str]:
     """Parity verdict for two response bodies: ``(equal, detail)``."""
@@ -199,6 +218,13 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="demand raw byte equality (volatile meta "
                          "fields included)")
+    ap.add_argument("--expect-artifact-source",
+                    choices=["aot-cache", "live"], default="",
+                    help="assert the replay target answered through "
+                         "this compiler path (meta.tags artifact-source "
+                         "stamp): 'aot-cache' proves a warm start — "
+                         "every dispatched bucket hydrated from the "
+                         "artifact store — 'live' proves a cold one")
     ap.add_argument("--timeout", type=float, default=30.0)
     args = ap.parse_args(argv)
 
@@ -222,6 +248,14 @@ def main(argv: Optional[list] = None) -> int:
         print(f"replay: {e}", file=sys.stderr)
         return 2
     print(f"replay -> {args.to}: HTTP {status}, {len(body)} bytes")
+    if args.expect_artifact_source:
+        got = artifact_source(body)
+        if got != args.expect_artifact_source:
+            print(f"artifact-source: MISMATCH — expected "
+                  f"{args.expect_artifact_source!r}, response stamped "
+                  f"{got!r}", file=sys.stderr)
+            return 1
+        print(f"artifact-source: {got} (as expected)")
     if not args.compare:
         print(body.decode("utf-8", "replace")[:2000])
         return 0 if status < 400 else 1

@@ -20,6 +20,11 @@ async def maybe_await(x: Any) -> Any:
 #: ``seldon_compile_cache_enabled`` gauge read by the profile probe)
 _COMPILE_CACHE_DIR: str | None = None
 
+#: persistent-cache hit/miss counts observed via ``jax.monitoring``
+#: since :func:`enable_compile_cache` registered the listener; plain
+#: ints mutated from jax's (synchronous) event callback
+_COMPILE_CACHE_COUNTS = {"hits": 0, "misses": 0}
+
 _FALSY = ("0", "false", "no", "off")
 
 
@@ -28,6 +33,45 @@ def compile_cache_enabled() -> bool:
     process (exported as the ``seldon_compile_cache_enabled`` gauge —
     dashboards tell cold fleets apart from warm ones)."""
     return _COMPILE_CACHE_DIR is not None
+
+
+def _on_cache_event(event: str, **kw) -> None:
+    """``jax.monitoring`` listener: jax fires
+    ``/jax/compilation_cache/cache_hits`` / ``cache_misses`` (and
+    task-level variants) around every persistent-cache lookup; counting
+    them here is the only hit/miss signal jax exposes — the cache dir
+    itself records entries, not lookups."""
+    if "compilation_cache" not in event:
+        return
+    if "cache_hits" in event:
+        _COMPILE_CACHE_COUNTS["hits"] += 1
+    elif "cache_misses" in event:
+        _COMPILE_CACHE_COUNTS["misses"] += 1
+
+
+def compile_cache_stats() -> dict:
+    """Posture of the persistent XLA compile cache: the active dir, its
+    on-disk size, and the hit/miss counts seen since enablement (the
+    ``seldon_compile_cache_hits``/``_misses`` sampler gauges and the
+    ``/admin/introspect`` profile probe read this)."""
+    out = {
+        "enabled": compile_cache_enabled(),
+        "dir": _COMPILE_CACHE_DIR,
+        "hits": _COMPILE_CACHE_COUNTS["hits"],
+        "misses": _COMPILE_CACHE_COUNTS["misses"],
+        "entries": 0,
+        "bytes": 0,
+    }
+    if _COMPILE_CACHE_DIR and os.path.isdir(_COMPILE_CACHE_DIR):
+        try:
+            for name in os.listdir(_COMPILE_CACHE_DIR):
+                p = os.path.join(_COMPILE_CACHE_DIR, name)
+                if os.path.isfile(p):
+                    out["entries"] += 1
+                    out["bytes"] += os.path.getsize(p)
+        except OSError:
+            pass
+    return out
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> str | None:
@@ -73,4 +117,11 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
         _COMPILE_CACHE_DIR = cache_dir
     except Exception:
         pass
+    if _COMPILE_CACHE_DIR is not None:
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_on_cache_event)
+        except Exception:
+            pass
     return _COMPILE_CACHE_DIR

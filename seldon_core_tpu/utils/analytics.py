@@ -611,6 +611,91 @@ CATALOG: tuple[MetricInfo, ...] = (
         "overhead, gated in CI)",
         ("endpoint",),
     ),
+    # -- artifact plane (docs/artifacts.md): AOT-exported executables +
+    # shared compile cache for millisecond warm starts
+    MetricInfo(
+        "seldon_artifact_hydrations_total", "counter",
+        "Shape buckets served from a deserialized AOT artifact instead "
+        "of a live XLA compile (warm starts; the CI warm-boot gate "
+        "asserts these fully replace seldon_compile_total)",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_artifact_publishes_total", "counter",
+        "Compiled executables serialized into the artifact store after "
+        "passing the byte-parity gate (one cold replica warms the "
+        "store for the whole fleet)",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_artifact_misses_total", "counter",
+        "Artifact-store lookups that found no executable for the "
+        "segment x bucket x dtype x mesh x jaxlib key — each miss is a "
+        "live compile on the serving path",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_artifact_parity_failures_total", "counter",
+        "Publishes rejected because the deserialized executable did "
+        "not reproduce the freshly compiled program's output bitwise "
+        "(the artifact never enters the store)",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_artifact_deserialize_failures_total", "counter",
+        "Stored artifacts that failed to deserialize or load "
+        "(corruption, jaxlib drift) — quarantined from the store and "
+        "served by a live compile instead",
+        ("segment",),
+    ),
+    MetricInfo(
+        "seldon_artifact_store_entries", "gauge",
+        "Executables currently in the artifact store visible to this "
+        "replica",
+    ),
+    MetricInfo(
+        "seldon_artifact_store_bytes", "gauge",
+        "Total serialized-executable bytes in the artifact store",
+    ),
+    MetricInfo(
+        "seldon_artifact_coverage", "gauge",
+        "Warm-start coverage: hydrated / (hydrated + live-compiled) "
+        "buckets since boot (1.0 = fully warm boot, the autoscaler's "
+        "warm-before-admit signal)",
+    ),
+    MetricInfo(
+        "seldon_artifact_hydrated", "gauge",
+        "Hydrated bucket count at sample time (introspection sampler "
+        "artifact probe)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_artifact_live_compiles", "gauge",
+        "Live-compiled bucket count at sample time (introspection "
+        "sampler artifact probe; nonzero on a replica booted against a "
+        "populated store means key drift or new traffic shapes)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_compile_hydrated_total", "counter",
+        "Compile-ledger rows whose executable came from the artifact "
+        "store (hydrations land on the ledger for bucket visibility "
+        "but never count as compiles or storm events)",
+        ("segment", "bucket"),
+    ),
+    MetricInfo(
+        "seldon_compile_cache_hits", "gauge",
+        "Persistent XLA compile-cache hits observed via jax.monitoring "
+        "since enable_compile_cache() (sampler twin; complements the "
+        "AOT artifact store for not-yet-exported programs)",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_compile_cache_misses", "gauge",
+        "Persistent XLA compile-cache misses observed via "
+        "jax.monitoring since enable_compile_cache()",
+        ("probe",),
+    ),
 )
 
 

@@ -1,0 +1,462 @@
+"""Artifact plane tests (docs/artifacts.md): AOT-exported executables +
+shared compile cache for millisecond warm starts.
+
+The contract under test: a compiled fused-segment executable published
+into the content-addressed store hydrates byte-identically on the next
+boot with ZERO live compiles; any key-component drift (segment params,
+bucket shape, dtype, mesh spec, jaxlib version) yields a distinct key
+and falls back to a live compile; a corrupted artifact is quarantined
+and served live instead of crashing or lying; and the fleet respawn
+drill — kill a replica, respawn against the populated store — comes up
+at full warm coverage.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.artifacts import (
+    ArtifactConfig,
+    ArtifactPlane,
+    artifact_config_from_annotations,
+    artifact_key,
+    segment_fingerprint,
+)
+from seldon_core_tpu.artifacts import snapshot as artifacts_registry_snapshot
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.operator.local import resolve_component
+
+NO_BATCH = {"seldon.io/batching": "false"}
+
+
+def resolver_for(ann=NO_BATCH):
+    return lambda u: resolve_component(u, ann)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mlp_node(name, seed=0, hidden=32):
+    return {
+        "name": name, "type": "MODEL",
+        "parameters": [
+            {"name": "model_class",
+             "value": "seldon_core_tpu.models.mlp:MNISTMLP",
+             "type": "STRING"},
+            {"name": "seed", "value": str(seed), "type": "INT"},
+            {"name": "hidden", "value": str(hidden), "type": "INT"},
+        ],
+    }
+
+
+def plane_for(tmp_path, **kw) -> ArtifactPlane:
+    cfg = ArtifactConfig(enabled=True, store=str(tmp_path), **kw)
+    return ArtifactPlane(cfg)
+
+
+def engine_for(tmp_path, seed=0, plane=None):
+    plane = plane if plane is not None else plane_for(tmp_path)
+    eng = GraphEngine(mlp_node("clf", seed=seed), resolver=resolver_for(),
+                      name="p", plan_mode="fused", artifacts=plane)
+    assert eng.plan is not None
+    return eng, plane
+
+
+XS = [np.linspace(0.0, 1.0, n * 784, dtype=np.float32).reshape(n, 784)
+      for n in (1, 4)]
+
+
+def predict_all(eng):
+    outs = []
+    for x in XS:
+        resp = run(eng.predict(SeldonMessage.from_ndarray(x)))
+        assert resp.status is None or resp.status.status == "SUCCESS"
+        outs.append(resp.to_dict())
+    return outs
+
+
+# ---- key schema --------------------------------------------------------
+
+
+def test_artifact_key_invalidation_matrix():
+    """Flipping ANY key component — segment fingerprint, bucket shape,
+    dtype, mesh spec, jaxlib version, format version — yields a
+    distinct key: an executable can never load into a runtime it was
+    not lowered for."""
+    base = dict(segment_fp="fp0", bucket_shape=(4, 784), dtype="float32",
+                mesh_spec="", jaxlib="0.4.36")
+
+    def key(**over):
+        kw = {**base, **over}
+        return artifact_key(kw["segment_fp"], kw["bucket_shape"],
+                            kw["dtype"], kw["mesh_spec"], kw["jaxlib"],
+                            format_version=kw.get("format_version", 1))
+
+    keys = [
+        key(),
+        key(segment_fp="fp1"),
+        key(bucket_shape=(8, 784)),
+        key(bucket_shape=(4, 785)),
+        key(dtype="bfloat16"),
+        key(mesh_spec="dp=2"),
+        key(mesh_spec="dp=2,tp=2"),
+        key(jaxlib="0.4.37"),
+        key(format_version=2),
+    ]
+    assert len(set(keys)) == len(keys)
+    # deterministic: same inputs, same key
+    assert key() == key()
+
+
+def test_segment_fingerprint_tracks_params(tmp_path):
+    eng0, _ = engine_for(tmp_path / "a", seed=0)
+    eng0b, _ = engine_for(tmp_path / "b", seed=0)
+    eng1, _ = engine_for(tmp_path / "c", seed=1)
+    fp0 = segment_fingerprint(eng0.plan.segments[0])
+    fp0b = segment_fingerprint(eng0b.plan.segments[0])
+    fp1 = segment_fingerprint(eng1.plan.segments[0])
+    assert fp0 == fp0b  # same weights -> same identity
+    assert fp0 != fp1   # different weights -> different identity
+
+
+# ---- config / admission ------------------------------------------------
+
+
+def test_artifact_config_parsing(tmp_path, monkeypatch):
+    monkeypatch.delenv("SELDON_ARTIFACT_STORE", raising=False)
+    assert artifact_config_from_annotations({}, "t") is None
+
+    cfg = artifact_config_from_annotations(
+        {"seldon.io/artifact-store": str(tmp_path)}, "t")
+    assert cfg.enabled and cfg.store == str(tmp_path)
+    assert cfg.precompile and cfg.parity and cfg.publish
+
+    off = artifact_config_from_annotations(
+        {"seldon.io/artifacts": "false",
+         "seldon.io/artifact-store": str(tmp_path)}, "t")
+    assert not off.enabled  # force-off wins over a configured store
+
+    with pytest.raises(ValueError):
+        artifact_config_from_annotations(
+            {"seldon.io/artifacts": "true"}, "t")  # on but nowhere to write
+    with pytest.raises(ValueError):
+        artifact_config_from_annotations(
+            {"seldon.io/artifacts": "maybe",
+             "seldon.io/artifact-store": str(tmp_path)}, "t")
+
+    monkeypatch.setenv("SELDON_ARTIFACT_STORE", str(tmp_path))
+    env_cfg = artifact_config_from_annotations({}, "t")
+    assert env_cfg is not None and env_cfg.enabled
+
+
+def test_graphlint_gl15xx(tmp_path, monkeypatch):
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+
+    monkeypatch.delenv("SELDON_ARTIFACT_STORE", raising=False)
+    store_ann = {"seldon.io/artifact-store": str(tmp_path)}
+
+    codes = {f.code for f in lint_graph(mlp_node("m"), dict(store_ann))}
+    assert "GL1502" in codes  # store configured but graph-plan not fused
+    assert "GL1503" in codes
+
+    fs = lint_graph(mlp_node("m"), {**store_ann,
+                                    "seldon.io/graph-plan": "fused"})
+    codes = {f.code for f in fs}
+    assert "GL1502" not in codes
+    report = [f for f in fs if f.code == "GL1503"]
+    assert report and str(tmp_path) in report[0].message
+
+    fs = lint_graph(mlp_node("m"), {**store_ann,
+                                    "seldon.io/artifact-parity": "maybe"})
+    assert [f.code for f in fs if f.code.startswith("GL15")] == ["GL1501"]
+
+    # the family absent -> no GL15xx noise
+    assert not [f for f in lint_graph(mlp_node("m"), {})
+                if f.code.startswith("GL15")]
+
+
+def test_operator_rejects_invalid_artifact_annotation(tmp_path):
+    from seldon_core_tpu.operator.compile import artifact_config
+    from seldon_core_tpu.operator.spec import (
+        DeploymentValidationError,
+        SeldonDeployment,
+    )
+
+    dep = SeldonDeployment.from_dict({
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "bad", "annotations": {
+            "seldon.io/artifact-store": str(tmp_path),
+            "seldon.io/artifact-precompile": "sometimes"}},
+        "spec": {"predictors": [{
+            "name": "p", "graph": mlp_node("clf"), "componentSpecs": [],
+        }]},
+    })
+    with pytest.raises(DeploymentValidationError):
+        artifact_config(dep, dep.predictors[0])
+
+
+# ---- warm start --------------------------------------------------------
+
+
+def test_cold_publish_then_warm_hydrate_byte_parity(tmp_path):
+    cold, cold_plane = engine_for(tmp_path)
+    cold_out = predict_all(cold)
+    snap = cold_plane.snapshot()
+    # warmup precompiled (1,784); predicts added (4,784): all published
+    assert snap["published"] >= 2 and snap["parityFailures"] == 0
+    assert cold_plane.source_tag() == "live"
+    assert all(o["meta"]["tags"]["artifact-source"] == "live"
+               for o in cold_out)
+
+    warm, warm_plane = engine_for(tmp_path)
+    warm_out = predict_all(warm)
+    snap = warm_plane.snapshot()
+    assert snap["liveCompiles"] == 0, snap
+    assert snap["hydrated"] >= 2
+    assert warm_plane.coverage()["coverage"] == 1.0
+    assert warm_plane.source_tag() == "aot-cache"
+    assert all(o["meta"]["tags"]["artifact-source"] == "aot-cache"
+               for o in warm_out)
+    # byte parity, judged like tools/replay.py: volatile per-request
+    # meta (puid, tags with the compiler-path stamp, ...) dropped
+    from seldon_core_tpu.tools.replay import _VOLATILE_META
+
+    for a, b in zip(cold_out, warm_out):
+        assert a["data"] == b["data"]
+        a_meta = {k: v for k, v in a["meta"].items()
+                  if k not in _VOLATILE_META}
+        b_meta = {k: v for k, v in b["meta"].items()
+                  if k not in _VOLATILE_META}
+        assert a_meta == b_meta
+
+
+def test_warm_ledger_records_hydrations_not_compiles(tmp_path):
+    from seldon_core_tpu.profiling.compilewatch import CompileWatch
+
+    cold, _ = engine_for(tmp_path)
+    predict_all(cold)
+
+    warm, _ = engine_for(tmp_path)
+    watch = CompileWatch()
+    for seg in warm.plan.segments:
+        seg.compile_watch = watch
+    # hydration happened at engine build (before the watch was wired);
+    # re-hydrating a fresh plane against already-compiled buckets is a
+    # no-op, so drive the ledger through predicts instead
+    predict_all(warm)
+    stats = watch.stats()
+    assert stats["compiles"] == 0, stats
+    assert not warm.plan.segments[0].live_compiled
+
+
+def test_warmup_skips_hydrated_buckets(tmp_path):
+    cold, _ = engine_for(tmp_path)
+    cold.plan.warmup()  # precompiles + publishes the warmup bucket
+
+    warm, warm_plane = engine_for(tmp_path)
+    before = warm_plane.snapshot()
+    assert before["hydrated"] >= 1
+    warm.plan.warmup()  # every warmup bucket already hydrated: no-op
+    after = warm_plane.snapshot()
+    assert after["liveCompiles"] == 0
+    assert after["hydrated"] == before["hydrated"]
+    seg = warm.plan.segments[0]
+    assert ((1, 784), "float32") in seg.hydrated
+
+
+def test_corrupted_artifact_quarantined_and_served_live(tmp_path):
+    cold, _ = engine_for(tmp_path)
+    cold_out = predict_all(cold)
+
+    bins = sorted(str(p) for p in tmp_path.rglob("*.bin"))
+    assert bins
+    with open(bins[0], "wb") as f:
+        f.write(b"not a pickled executable")
+
+    warm, warm_plane = engine_for(tmp_path)
+    warm_out = predict_all(warm)
+    snap = warm_plane.snapshot()
+    assert snap["deserializeFailures"] >= 1, snap
+    assert snap["quarantined"] >= 1
+    # the store answered what it could; the poisoned bucket compiled live
+    assert snap["hydrated"] >= 1
+    assert warm_plane.source_tag() == "live"
+    for a, b in zip(cold_out, warm_out):
+        assert a["data"] == b["data"]
+    # self-healing: the fallback live compile re-published a fresh,
+    # loadable artifact under the same key
+    assert snap["published"] >= 1
+    with open(bins[0], "rb") as f:
+        assert f.read() != b"not a pickled executable"
+
+
+def test_jaxlib_or_mesh_drift_forces_live_compile(tmp_path):
+    cold, _ = engine_for(tmp_path)
+    predict_all(cold)
+
+    # same store, "newer jaxlib": every stored key is a foreign vintage
+    drifted = plane_for(tmp_path)
+    drifted.jaxlib = "99.99.99"
+    eng, _ = engine_for(tmp_path, plane=drifted)
+    predict_all(eng)
+    snap = drifted.snapshot()
+    assert snap["hydrated"] == 0
+    assert snap["liveCompiles"] >= 2
+    assert snap["misses"] >= 2
+
+    # same store, different mesh spec: ditto
+    meshy = plane_for(tmp_path)
+    eng2 = GraphEngine(mlp_node("clf"), resolver=resolver_for(), name="p",
+                       plan_mode="fused")
+    assert eng2.plan is not None
+    meshy.attach_plan(eng2.plan, mesh_spec="dp=2")
+    assert meshy.hydrate_plan() == 0  # nothing stored for this topology
+
+
+# ---- surfaces ----------------------------------------------------------
+
+
+def test_artifacts_http_body(tmp_path):
+    from seldon_core_tpu.artifacts.http import artifacts_body
+
+    status, payload = artifacts_body(None, {})
+    assert status == 404 and "hint" in payload
+
+    cold, plane = engine_for(tmp_path)
+    predict_all(cold)
+    status, payload = artifacts_body(plane, {})
+    assert status == 200
+    assert payload["store"] == str(tmp_path)
+    assert payload["segments"]
+    status, payload = artifacts_body(plane, {"coverage": "1"})
+    assert status == 200 and set(payload) == {
+        "buckets", "hydrated", "liveCompiles", "coverage"}
+
+
+def test_probe_and_metrics(tmp_path):
+    from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    cfg = ArtifactConfig(enabled=True, store=str(tmp_path))
+    plane = ArtifactPlane(cfg, metrics=reg)
+    eng = GraphEngine(mlp_node("clf"), resolver=resolver_for(), name="p",
+                      plan_mode="fused", artifacts=plane)
+    predict_all(eng)
+    sample = plane.probe()()
+    assert sample["artifact_store_entries"] >= 2
+    assert sample["artifact_live_compiles"] >= 2
+    assert sample["artifact_coverage"] == 0.0
+    text = reg.render()
+    assert "seldon_artifact_publishes_total" in text
+    assert "seldon_artifact_store_entries" in text
+
+    warm_plane = ArtifactPlane(cfg, metrics=reg)
+    GraphEngine(mlp_node("clf"), resolver=resolver_for(), name="p",
+                plan_mode="fused", artifacts=warm_plane)
+    sample = warm_plane.probe()()
+    assert sample["artifact_hydrated"] >= 2
+    assert sample["artifact_coverage"] == 1.0
+
+
+def test_replay_artifact_source_helper():
+    from seldon_core_tpu.tools.replay import artifact_source
+
+    body = json.dumps({"meta": {"tags": {"artifact-source": "aot-cache"}},
+                       "data": {"ndarray": [[1.0]]}}).encode()
+    assert artifact_source(body) == "aot-cache"
+    assert artifact_source(b"not json") == ""
+    assert artifact_source(json.dumps({"meta": {}}).encode()) == ""
+
+
+def test_compile_cache_stats_counts_monitoring_events():
+    from seldon_core_tpu.utils import (
+        _COMPILE_CACHE_COUNTS,
+        _on_cache_event,
+        compile_cache_stats,
+    )
+
+    before = compile_cache_stats()
+    _on_cache_event("/jax/compilation_cache/cache_hits")
+    _on_cache_event("/jax/compilation_cache/cache_misses", duration_secs=1.0)
+    _on_cache_event("/jax/unrelated/event")
+    after = compile_cache_stats()
+    assert after["hits"] == before["hits"] + 1
+    assert after["misses"] == before["misses"] + 1
+    assert set(after) == {"enabled", "dir", "hits", "misses", "entries",
+                          "bytes"}
+    # restore (module-global counters)
+    _COMPILE_CACHE_COUNTS["hits"] -= 1
+    _COMPILE_CACHE_COUNTS["misses"] -= 1
+
+
+def test_openapi_documents_admin_artifacts():
+    from seldon_core_tpu.serving import openapi
+
+    for spec in (openapi.engine_spec(), openapi.gateway_spec()):
+        assert "/admin/artifacts" in spec["paths"]
+
+
+# ---- fleet respawn drill (the acceptance scenario) ----------------------
+
+
+def fleet_spec(name, store_dir):
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    return SeldonDeployment.from_dict({
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "annotations": {
+            "seldon.io/batching": "false",
+            "seldon.io/graph-plan": "fused",
+            "seldon.io/artifact-store": store_dir,
+        }},
+        "spec": {"predictors": [{
+            "name": "p", "replicas": 2,
+            "graph": mlp_node("clf"),
+            "componentSpecs": [],
+        }]},
+    })
+
+
+class TestFleetWarmRespawn:
+    async def test_kill_and_respawn_comes_up_warm(self, tmp_path):
+        from seldon_core_tpu.operator.local import LocalFleet
+
+        fl = await LocalFleet(fleet_spec("art-fleet", str(tmp_path)),
+                              replicas=2).start()
+        try:
+            reps = fl.replicas()
+            # r0 booted against an empty store: its precompile published;
+            # r1 found the store populated and hydrated everything
+            r1_plane = reps[1]["local"].predictors[0].artifacts
+            assert r1_plane.snapshot()["liveCompiles"] == 0
+            assert reps[1]["artifact_coverage"]["coverage"] == 1.0
+
+            await fl.kill(1)
+            rep = await fl.add_replica()
+            # THE drill: the respawned replica hydrates from the store —
+            # zero live compiles before it enters the pool
+            new_plane = rep["local"].predictors[0].artifacts
+            snap = new_plane.snapshot()
+            assert snap["liveCompiles"] == 0, snap
+            assert snap["hydrated"] >= 1
+            assert rep["artifact_coverage"]["coverage"] == 1.0
+            assert new_plane.source_tag() == "aot-cache"
+
+            # membership + status surfaces carry the warm verdict
+            fleet_snap = fl.snapshot()
+            warm_entries = [r for r in fleet_snap["replicas"]
+                            if r.get("artifactCoverage")]
+            assert any(r["artifactCoverage"]["coverage"] == 1.0
+                       for r in warm_entries)
+            reg = artifacts_registry_snapshot("art-fleet")
+            assert reg is not None
+            assert reg["predictors"][0]["replicas"]
+        finally:
+            await fl.stop()
+        assert artifacts_registry_snapshot("art-fleet") is None
