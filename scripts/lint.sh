@@ -4,8 +4,12 @@
 #   1. ruff        style/correctness lint (config: [tool.ruff] in
 #                  pyproject.toml).  Skipped with a warning when ruff is
 #                  not installed (the hermetic CI image does not ship it).
-#   2. graphlint --self   AST pass: blocking calls on async hot paths,
-#                  host-sync JAX ops inside jit'd functions (RL4xx/RL5xx).
+#   2. graphlint --self   AST passes: blocking calls on async hot paths,
+#                  host-sync JAX ops inside jit'd functions, asyncio
+#                  races (RL4xx/RL5xx/RL6xx) — plus the GL16xx
+#                  signature-registry trace verification when jax is
+#                  importable.  The analysis/ package itself is held to
+#                  --fail-on warn: the linter ships zero-warning.
 #   3. graphlint over every shipped example graph, so examples/ never
 #                  drifts dirty (GL1xx/GL2xx/GL3xx).
 set -euo pipefail
@@ -22,6 +26,9 @@ fi
 
 echo "== graphlint --self (seldon_core_tpu/) =="
 python -m seldon_core_tpu.analysis --self seldon_core_tpu
+
+echo "== graphlint --self --fail-on warn (seldon_core_tpu/analysis/) =="
+python -m seldon_core_tpu.analysis --self seldon_core_tpu/analysis --fail-on warn
 
 echo "== graphlint (examples/graphs/) =="
 python -m seldon_core_tpu.analysis examples/graphs/*.json

@@ -3,9 +3,15 @@
 Public surface:
 
 - :func:`lint_graph` / :func:`lint_deployment` — the graph checker
-  (structure, shape/dtype signatures, deadline + HBM feasibility).
-- :func:`lint_paths` — the AST repo-lint pass (blocking calls in async
-  functions, host-sync ops inside jit'd functions).
+  (structure, shape/dtype signatures, deadline + HBM feasibility,
+  per-plane annotation admission, GL16xx trace-lint when jax is loaded).
+- :func:`lint_paths` / :func:`lint_source` / :func:`lint_file` — the
+  combined AST repo-lint pass: blocking calls in async functions and
+  host-sync ops inside jit'd functions (RL4xx/RL5xx,
+  ``analysis/repolint.py``) plus the asyncio concurrency lint
+  (RL6xx, ``analysis/asynclint.py``).
+- :func:`lint_registry` — GL16xx signature-registry verification by
+  abstract tracing (``analysis/tracelint.py``; imports jax).
 - :class:`Finding` — one diagnosed defect with a stable code.
 - :class:`GraphAnalysisError` — raised by operator admission when a spec
   carries ERROR-severity findings.
@@ -14,6 +20,10 @@ CLI: ``python -m seldon_core_tpu.analysis <spec.json | --self>``.
 Finding codes and severities are documented in docs/static-analysis.md.
 """
 
+from typing import Iterable, Optional
+
+from seldon_core_tpu.analysis import asynclint as _asynclint
+from seldon_core_tpu.analysis import repolint as _repolint
 from seldon_core_tpu.analysis.findings import (
     ERROR,
     INFO,
@@ -28,7 +38,33 @@ from seldon_core_tpu.analysis.graphlint import (
     lint_deployment,
     lint_graph,
 )
-from seldon_core_tpu.analysis.repolint import lint_file, lint_paths, lint_source
+
+
+def lint_source(source: str, rel_path: str) -> list[Finding]:
+    """All repo-lint families (RL4xx/RL5xx + RL6xx) for one source."""
+    return (_repolint.lint_source(source, rel_path)
+            + _asynclint.lint_source(source, rel_path))
+
+
+def lint_file(path: str, root: Optional[str] = None) -> list[Finding]:
+    return (_repolint.lint_file(path, root)
+            + _asynclint.lint_file(path, root))
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> list[Finding]:
+    """Repo-lint files/directories with every RL family."""
+    paths = list(paths)
+    return (_repolint.lint_paths(paths, root)
+            + _asynclint.lint_paths(paths, root))
+
+
+def lint_registry(model_classes=None) -> list[Finding]:
+    """GL16xx: trace-verify the signature registry (imports jax)."""
+    from seldon_core_tpu.analysis.tracelint import lint_registry as _impl
+
+    return _impl(model_classes)
+
 
 __all__ = [
     "ERROR",
@@ -41,6 +77,7 @@ __all__ = [
     "lint_file",
     "lint_graph",
     "lint_paths",
+    "lint_registry",
     "lint_source",
     "make_finding",
     "worst_severity",

@@ -7,12 +7,18 @@ Two modes:
   (``kind``/``spec.predictors``) lints every predictor graph with the
   deployment's annotations; a bare graph dict lints standalone
   (``--deadline-ms`` / ``--hbm-gb`` / ``--chips`` supply the budgets a
-  bare graph has no annotations for).
+  bare graph has no annotations for).  Add ``--trace`` to import jax
+  first, activating the jax-gated passes (GL1202, GL16xx trace-lint).
 
 - ``python -m seldon_core_tpu.analysis --self [PATH ...]`` runs the
-  repo-lint pass (async blocking calls, host-sync-in-jit) over the given
-  files/directories, defaulting to the installed ``seldon_core_tpu``
-  package.
+  repo-lint passes (RL4xx blocking calls, RL5xx host-sync-in-jit, RL6xx
+  asyncio races) over the given files/directories, defaulting to the
+  installed ``seldon_core_tpu`` package — plus the GL16xx
+  signature-registry trace verification when jax is importable.
+
+Output: human lines (default), ``--json``, and/or ``--sarif PATH``
+(SARIF 2.1.0 with stable rule ids = finding codes, for the GitHub
+code-scanning upload in ``.github/workflows/lint.yml``).
 
 Exit status: 1 if any finding at or above ``--fail-on`` (default:
 ``error``) was emitted, else 0 — wired into ``scripts/lint.sh`` and CI.
@@ -23,10 +29,16 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Optional
 
-from seldon_core_tpu.analysis.findings import ERROR, WARN, Finding
+from seldon_core_tpu.analysis.findings import (
+    CODE_SEVERITY,
+    ERROR,
+    WARN,
+    Finding,
+)
 from seldon_core_tpu.analysis.graphlint import (
     CHIPS_ANNOTATION,
     HBM_BUDGET_ANNOTATION,
@@ -34,7 +46,9 @@ from seldon_core_tpu.analysis.graphlint import (
     lint_deployment,
     lint_graph,
 )
-from seldon_core_tpu.analysis.repolint import lint_paths
+
+_SARIF_LEVEL = {"ERROR": "error", "WARN": "warning", "INFO": "note"}
+_FILE_LINE = re.compile(r"^(?P<file>[^:]+\.py):(?P<line>\d+)$")
 
 
 def _lint_spec_file(path: str, extra_ann: dict) -> list[Finding]:
@@ -58,6 +72,56 @@ def _lint_spec_file(path: str, extra_ann: dict) -> list[Finding]:
     return lint_graph(spec, annotations=extra_ann)
 
 
+def to_sarif(findings: list[Finding]) -> dict:
+    """SARIF 2.1.0 log: one run, rule ids = stable finding codes."""
+    results = []
+    rule_ids = []
+    for f in findings:
+        if f.code not in rule_ids:
+            rule_ids.append(f.code)
+        m = _FILE_LINE.match(f.path)
+        if m:
+            location = {"physicalLocation": {
+                "artifactLocation": {"uri": m.group("file").replace(
+                    os.sep, "/")},
+                "region": {"startLine": int(m.group("line"))},
+            }}
+        else:
+            # graph findings anchor to a unit path, not a file
+            location = {"logicalLocations": [
+                {"fullyQualifiedName": f.path, "kind": "member"},
+            ]}
+        results.append({
+            "ruleId": f.code,
+            "level": _SARIF_LEVEL.get(f.severity, "note"),
+            "message": {"text": f"{f.path}: {f.message}"},
+            "locations": [location],
+        })
+    rules = [{
+        "id": code,
+        "defaultConfiguration": {
+            "level": _SARIF_LEVEL.get(CODE_SEVERITY.get(code, "INFO"),
+                                      "note"),
+        },
+        "helpUri": "https://github.com/seldon-core-tpu/seldon-core-tpu/"
+                   "blob/main/docs/static-analysis.md",
+    } for code in rule_ids]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "seldon-core-tpu-graphlint",
+                "informationUri": "https://github.com/seldon-core-tpu/"
+                                  "seldon-core-tpu/blob/main/docs/"
+                                  "static-analysis.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m seldon_core_tpu.analysis",
@@ -68,8 +132,12 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="inference-graph or SeldonDeployment JSON files")
     ap.add_argument("--self", dest="self_paths", nargs="*", default=None,
                     metavar="PATH",
-                    help="run the repo-lint pass over PATHs (default: the "
-                         "seldon_core_tpu package)")
+                    help="run the repo-lint passes over PATHs (default: the "
+                         "seldon_core_tpu package) plus the GL16xx "
+                         "signature-registry trace verification")
+    ap.add_argument("--trace", action="store_true",
+                    help="import jax before linting specs so the "
+                         "jax-gated passes (GL1202, GL16xx) run")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help=f"walk deadline for bare graphs "
                          f"({WALK_DEADLINE_ANNOTATION})")
@@ -81,6 +149,9 @@ def main(argv: Optional[list[str]] = None) -> int:
                          f"({HBM_BUDGET_ANNOTATION})")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as a JSON array")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write findings as SARIF 2.1.0 to PATH "
+                         "(for GitHub code scanning)")
     ap.add_argument("--fail-on", choices=["error", "warn"], default="error",
                     help="lowest severity that fails the run")
     args = ap.parse_args(argv)
@@ -96,14 +167,27 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.hbm_gb is not None:
         extra_ann[HBM_BUDGET_ANNOTATION] = str(args.hbm_gb)
 
+    if args.trace:
+        import jax  # noqa: F401  (activates the jax-gated passes)
+
     findings: list[Finding] = []
     for spec in args.specs:
         findings.extend(_lint_spec_file(spec, extra_ann))
     if args.self_paths is not None:
+        from seldon_core_tpu.analysis import lint_paths, lint_registry
+
         paths = args.self_paths or [os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))]
         findings.extend(lint_paths(paths))
+        try:
+            findings.extend(lint_registry())
+        except ImportError:
+            print("graphlint: jax not importable — GL16xx registry "
+                  "trace verification skipped", file=sys.stderr)
 
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(to_sarif(findings), f, indent=2)
     if args.json:
         print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:

@@ -35,8 +35,19 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL15xx`` — artifact-plane admission (``seldon.io/artifact-*``
   annotation validation, artifacts requested without a fused graph
   plan, effective store/precompile/parity report)
+- ``GL16xx`` — jaxpr trace-lint (``analysis/tracelint.py``): the
+  signature registry verified against reality by abstractly tracing
+  each registered callable with ``jax.eval_shape`` / ``jax.make_jaxpr``
+  (no execution, no weights) — declared-vs-traced drift, implicit
+  float64/weak-type promotion, host callbacks inside ``pure_fn`` nodes,
+  and mesh-axis divisibility against ``seldon.io/mesh``
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
+- ``RL6xx`` — asyncio concurrency lint (``analysis/asynclint.py``):
+  event-loop races on shared mutable state (check-then-act split by an
+  ``await``, unlocked cross-await mutation), fire-and-forget
+  ``create_task``, locks held across remote awaits, and unguarded
+  ``asyncio.gather``
 
 Codes are append-only: never renumber or reuse a retired code.
 """
@@ -108,12 +119,21 @@ FLEET_OBS_CONFIG_REPORT = "GL1403"  # fleet-obs report: effective config
 ARTIFACT_ANNOTATION_INVALID = "GL1501"  # seldon.io/artifact-* value invalid
 ARTIFACTS_WITHOUT_PLAN = "GL1502"   # artifact knobs set, graph-plan not fused
 ARTIFACT_CONFIG_REPORT = "GL1503"   # artifact report: effective config
+TRACE_SIGNATURE_DRIFT = "GL1601"    # declared output shape/dtype != traced
+TRACE_IMPLICIT_PROMOTION = "GL1602"  # float64/weak-type escapes the segment
+TRACE_CALLBACK_IN_PURE_FN = "GL1603"  # host callback inside a pure_fn node
+TRACE_MESH_INDIVISIBLE = "GL1604"   # dp/tp axis does not divide its dim
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
 SYNC_OPEN_IN_ASYNC = "RL402"      # file I/O in an async def
 HOST_SYNC_IN_JIT = "RL501"        # block_until_ready/device_get under jit
 HOST_MATERIALIZE_IN_JIT = "RL502"  # np.asarray/.item() on tracers under jit
+UNLOCKED_CHECK_THEN_ACT = "RL601"  # check → await → act, no asyncio.Lock
+SHARED_MUTATION_ACROSS_AWAIT = "RL602"  # shared container mutated across await
+DISCARDED_TASK = "RL603"          # asyncio.create_task() result dropped
+LOCK_HELD_ACROSS_REMOTE_AWAIT = "RL604"  # asyncio.Lock over remote await
+GATHER_WITHOUT_RETURN_EXCEPTIONS = "RL605"  # bare gather in try-less scope
 
 #: every code → default severity; the single source of truth for docs
 CODE_SEVERITY = {
@@ -173,10 +193,19 @@ CODE_SEVERITY = {
     ARTIFACT_ANNOTATION_INVALID: ERROR,
     ARTIFACTS_WITHOUT_PLAN: WARN,
     ARTIFACT_CONFIG_REPORT: INFO,
+    TRACE_SIGNATURE_DRIFT: ERROR,
+    TRACE_IMPLICIT_PROMOTION: WARN,
+    TRACE_CALLBACK_IN_PURE_FN: ERROR,
+    TRACE_MESH_INDIVISIBLE: ERROR,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,
     HOST_MATERIALIZE_IN_JIT: ERROR,
+    UNLOCKED_CHECK_THEN_ACT: ERROR,
+    SHARED_MUTATION_ACROSS_AWAIT: WARN,
+    DISCARDED_TASK: ERROR,
+    LOCK_HELD_ACROSS_REMOTE_AWAIT: WARN,
+    GATHER_WITHOUT_RETURN_EXCEPTIONS: WARN,
 }
 
 

@@ -196,7 +196,24 @@ def lint_graph(
         findings.extend(_fleet_pass(unit, ann, path_prefix))
         findings.extend(_fleet_obs_pass(unit, ann, path_prefix))
         findings.extend(_artifact_pass(unit, ann, path_prefix))
+        findings.extend(_tracelint_pass(unit, ann, path_prefix))
     return findings
+
+
+def _tracelint_pass(root: "PredictiveUnit", ann: dict,
+                    prefix: str) -> list[Finding]:
+    """GL16xx: trace-verify the registry entries this graph serves
+    (analysis/tracelint.py).  Gated on jax being ALREADY imported — the
+    same posture as ``_visible_devices``: spec-only lints never pay the
+    jax import, while operator admission and ``--trace``/``--self`` CLI
+    runs (jax loaded) get the full trace check."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return []
+    from seldon_core_tpu.analysis.tracelint import lint_unit_traces
+
+    return lint_unit_traces(root, ann, prefix)
 
 
 def lint_deployment(dep: Any) -> list[Finding]:

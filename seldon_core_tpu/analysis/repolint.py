@@ -71,6 +71,21 @@ _NP_MATERIALIZERS = ("asarray", "array", "ascontiguousarray")
 _JIT_NAMES = ("jit", "pjit")
 
 
+def pragma_suppressed(lines: list[str], node: ast.AST, code: str) -> bool:
+    """True if a ``# graphlint: disable=CODE`` pragma covers ``node``.
+
+    A multi-line construct (call spanning lines, decorated def) anchors
+    its finding at ``node.lineno``, but the natural place for the pragma
+    is often the closing line — honor any line in the node's
+    ``lineno..end_lineno`` span, not just the first."""
+    end = getattr(node, "end_lineno", None) or node.lineno
+    for lineno in range(node.lineno, min(end, len(lines)) + 1):
+        m = _DISABLE.search(lines[lineno - 1])
+        if m and code in {c.strip() for c in m.group(1).split(",")}:
+            return True
+    return False
+
+
 def _dotted(node: ast.AST) -> str:
     """Best-effort dotted name of a call target ('time.sleep', 'np.asarray')."""
     parts: list[str] = []
@@ -128,15 +143,11 @@ class _FileLinter(ast.NodeVisitor):
         return name
 
     # -- helpers ---------------------------------------------------------
-    def _suppressed(self, lineno: int, code: str) -> bool:
-        if 1 <= lineno <= len(self.lines):
-            m = _DISABLE.search(self.lines[lineno - 1])
-            if m and code in {c.strip() for c in m.group(1).split(",")}:
-                return True
-        return False
+    def _suppressed(self, node: ast.AST, code: str) -> bool:
+        return pragma_suppressed(self.lines, node, code)
 
     def _emit(self, code: str, node: ast.AST, message: str) -> None:
-        if not self._suppressed(node.lineno, code):
+        if not self._suppressed(node, code):
             self.findings.append(make_finding(
                 code, f"{self.rel_path}:{node.lineno}", message))
 

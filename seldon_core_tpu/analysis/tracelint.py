@@ -1,0 +1,316 @@
+"""GL16xx — jaxpr trace-lint: verify the signature registry against reality.
+
+The GL2xx shape/dtype pass and every downstream consumer (cache keys,
+graph-plan fusion, HBM estimates, tp sharding) trust the *hand-declared*
+:class:`~seldon_core_tpu.models.ModelSignature` registry.  Nothing else
+checks it — a drifted entry silently corrupts every edge check built on
+it.  This pass closes the loop: each registered callable that has a
+trace provider (``models/traceable.py``; third parties use
+``register_trace_provider``) is traced **abstractly** with
+``jax.eval_shape`` / ``jax.make_jaxpr`` on CPU — no weights, no
+execution, no device — and the declaration is checked against the trace:
+
+- **GL1601 ERROR** — declared output shape/dtype disagrees with the
+  traced output (or the declared input contract fails to trace at all).
+- **GL1602 WARN** — a float64 intermediate or a weak-typed output
+  escapes the traced function: weak types re-promote per call site,
+  which fragments executable cache keys (recompile storms) and float64
+  doubles HBM.
+- **GL1603 ERROR** — a host callback (``pure_callback``,
+  ``io_callback``, ``debug_callback``/``debug.print``) inside a node
+  declared ``pure_fn``: the callback breaks fusion, caching, and AOT
+  artifact export, all of which key on ``pure_fn``.
+- **GL1604 ERROR** — a ``dp``/``tp`` axis in ``seldon.io/mesh`` that
+  does not evenly divide the dimension it would shard: ``dp`` against a
+  fixed declared batch dim, ``tp`` against the traced parameter dims
+  named by ``tp_param_specs``.
+
+Activation: the pass never *imports* jax — spec-only lints stay cheap —
+but runs whenever jax is already loaded (operator admission imports it,
+``--self``/``--trace`` CLI runs force it).  Traces are cached per
+(model_class, input binding) so a process traces each model once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from seldon_core_tpu.analysis.findings import (
+    TRACE_CALLBACK_IN_PURE_FN,
+    TRACE_IMPLICIT_PROMOTION,
+    TRACE_MESH_INDIVISIBLE,
+    TRACE_SIGNATURE_DRIFT,
+    Finding,
+    make_finding,
+)
+from seldon_core_tpu.models import (
+    ModelSignature,
+    SIGNATURES,
+    signature_for,
+    trace_target_for,
+)
+
+#: ANY dims bind to these probe sizes (batch dim vs inner dims) — any
+#: fixed value works; the trace only needs concrete ints.
+PROBE_BATCH = 8
+PROBE_DIM = 16
+
+#: jaxpr primitive names that call back into the host
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "callback",
+})
+
+
+class _Trace:
+    """What one abstract trace of ``fn(params, X)`` yielded."""
+
+    def __init__(self) -> None:
+        self.error: Optional[str] = None
+        self.out_shapes: list = []      # [(shape, dtype-str, weak)] per leaf
+        self.f64_eqns: list = []        # primitive names producing float64
+        self.callback_prims: list = []  # host-callback primitive names
+        self.param_dims: dict = {}      # "path/leaf" -> shape tuple
+
+
+#: (model_class, bound input shape, input dtype) → _Trace
+_TRACE_CACHE: dict = {}
+
+
+def _bind_input_shape(sig: ModelSignature) -> tuple:
+    shape = sig.input_shape if sig.input_shape is not None \
+        else (None, None)
+    return tuple(
+        (PROBE_BATCH if i == 0 else PROBE_DIM) if d is None else d
+        for i, d in enumerate(shape)
+    )
+
+
+def _walk_jaxpr(jaxpr: Any, trace: _Trace, seen: set) -> None:
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _CALLBACK_PRIMS:
+            trace.callback_prims.append(name)
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and str(dtype) == "float64":
+                trace.f64_eqns.append(name)
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _walk_jaxpr(inner, trace, seen)
+                elif hasattr(sub, "eqns"):
+                    _walk_jaxpr(sub, trace, seen)
+
+
+def _keystr(path: tuple) -> str:
+    parts = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _trace_model(model_class: str, sig: ModelSignature) -> Optional[_Trace]:
+    """Trace one registry entry; None when it has no provider."""
+    in_shape = _bind_input_shape(sig)
+    in_dtype = sig.input_dtype or "float32"
+    key = (model_class, in_shape, in_dtype)
+    if key in _TRACE_CACHE:
+        return _TRACE_CACHE[key]
+
+    target = trace_target_for(model_class)
+    if target is None:
+        return None
+
+    import jax
+
+    trace = _Trace()
+    x = jax.ShapeDtypeStruct(in_shape, in_dtype)
+    try:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                target.params)[0]:
+            shape = getattr(leaf, "shape", None)
+            if shape is not None:
+                trace.param_dims[_keystr(path)] = tuple(shape)
+        closed = jax.make_jaxpr(target.fn)(target.params, x)
+        out_struct = jax.eval_shape(target.fn, target.params, x)
+        for leaf in jax.tree_util.tree_leaves(out_struct):
+            trace.out_shapes.append((
+                tuple(leaf.shape), str(leaf.dtype),
+                bool(getattr(leaf, "weak_type", False)),
+            ))
+        _walk_jaxpr(closed.jaxpr, trace, set())
+    except Exception as e:  # trace failure IS the finding (GL1601)
+        trace.error = f"{type(e).__name__}: {e}"
+    _TRACE_CACHE[key] = trace
+    return trace
+
+
+def _fmt(shape: Optional[tuple], dtype: Optional[str]) -> str:
+    dims = "?" if shape is None else \
+        "[" + ", ".join("?" if d is None else str(d) for d in shape) + "]"
+    return f"{dtype or '?'}{dims}"
+
+
+def lint_signature(model_class: str, sig: Optional[ModelSignature] = None,
+                   path: Optional[str] = None) -> list[Finding]:
+    """GL1601/GL1602/GL1603 for one registry entry (empty when the class
+    has no trace provider — not statically traceable is not a defect)."""
+    sig = sig if sig is not None else signature_for(model_class)
+    if sig is None:
+        return []
+    at = path or model_class
+    trace = _trace_model(model_class, sig)
+    if trace is None:
+        return []
+    if trace.error is not None:
+        return [make_finding(
+            TRACE_SIGNATURE_DRIFT, at,
+            f"{model_class}: declared input "
+            f"{_fmt(sig.input_shape, sig.input_dtype)} does not trace: "
+            f"{trace.error}",
+        )]
+
+    findings: list[Finding] = []
+
+    if sig.output_shape is not None or sig.output_dtype is not None:
+        if len(trace.out_shapes) != 1:
+            findings.append(make_finding(
+                TRACE_SIGNATURE_DRIFT, at,
+                f"{model_class}: declares one output "
+                f"{_fmt(sig.output_shape, sig.output_dtype)} but traces "
+                f"to {len(trace.out_shapes)} output leaves",
+            ))
+        else:
+            shape, dtype, _weak = trace.out_shapes[0]
+            declared = sig.output_shape
+            shape_ok = declared is None or (
+                len(declared) == len(shape)
+                and all(d is None or d == s
+                        for d, s in zip(declared, shape)))
+            dtype_ok = sig.output_dtype is None or sig.output_dtype == dtype
+            if not (shape_ok and dtype_ok):
+                findings.append(make_finding(
+                    TRACE_SIGNATURE_DRIFT, at,
+                    f"{model_class}: declared output "
+                    f"{_fmt(sig.output_shape, sig.output_dtype)} but "
+                    f"tracing {_fmt(_bind_input_shape(sig), sig.input_dtype)}"
+                    f" yields {_fmt(shape, dtype)} — the registry has "
+                    "drifted from the callable",
+                ))
+
+    weak_outs = [i for i, (_s, _d, weak) in enumerate(trace.out_shapes)
+                 if weak]
+    if trace.f64_eqns or weak_outs:
+        detail = []
+        if trace.f64_eqns:
+            detail.append(
+                f"float64 intermediates from {sorted(set(trace.f64_eqns))}")
+        if weak_outs:
+            detail.append("weak-typed output (re-promotes per call site)")
+        findings.append(make_finding(
+            TRACE_IMPLICIT_PROMOTION, at,
+            f"{model_class}: {'; '.join(detail)} — fragments executable "
+            "cache keys (recompile storm) and float64 doubles HBM; pin "
+            "dtypes explicitly",
+        ))
+
+    if sig.pure_fn and trace.callback_prims:
+        findings.append(make_finding(
+            TRACE_CALLBACK_IN_PURE_FN, at,
+            f"{model_class}: declared pure_fn but the trace contains "
+            f"host callback(s) {sorted(set(trace.callback_prims))} — "
+            "callbacks break fusion, the prediction cache, and AOT "
+            "artifact export, which all key on pure_fn",
+        ))
+    return findings
+
+
+def lint_registry(model_classes=None) -> list[Finding]:
+    """Trace-verify every registry entry (the ``--self`` / CI gate)."""
+    findings: list[Finding] = []
+    for mc in sorted(model_classes or SIGNATURES):
+        findings.extend(lint_signature(mc))
+    return findings
+
+
+def _mesh_findings(model_class: str, sig: ModelSignature, cfg: Any,
+                   at: str) -> list[Finding]:
+    """GL1604 for one node against the parsed placement config."""
+    findings: list[Finding] = []
+    if cfg.dp > 1 and sig.batch_shardable and sig.input_shape:
+        batch = sig.input_shape[0]
+        if batch is not None and batch % cfg.dp:
+            findings.append(make_finding(
+                TRACE_MESH_INDIVISIBLE, at,
+                f"{model_class}: mesh axis dp={cfg.dp} does not divide "
+                f"the declared batch dim {batch} — the sharded dispatch "
+                "cannot split this batch evenly",
+            ))
+    if cfg.tp > 1 and sig.tp_param_specs:
+        trace = _trace_model(model_class, sig)
+        param_dims = trace.param_dims if trace and not trace.error else {}
+        for key, spec in sorted(sig.tp_param_specs.items()):
+            dims = None
+            for pkey, shape in param_dims.items():
+                if pkey == key or pkey.endswith("/" + key) or key in pkey:
+                    dims = shape
+                    break
+            if dims is None:
+                continue  # provider absent or key unmatched — nothing to check
+            for axis, axis_name in enumerate(spec):
+                if axis_name != "tp" or axis >= len(dims):
+                    continue
+                if dims[axis] % cfg.tp:
+                    findings.append(make_finding(
+                        TRACE_MESH_INDIVISIBLE, at,
+                        f"{model_class}: tp_param_specs shards param "
+                        f"{key!r} dim {axis} (= {dims[axis]}) over "
+                        f"tp={cfg.tp}, which does not divide it — "
+                        "uneven shards replicate instead of splitting",
+                    ))
+    return findings
+
+
+def lint_unit_traces(root: Any, ann: dict, prefix: str) -> list[Finding]:
+    """The graphlint pass entry: trace-verify every model node of one
+    predictor graph, plus GL1604 mesh divisibility when ``seldon.io/mesh``
+    is set.  Caller guarantees jax is already imported."""
+    from seldon_core_tpu.placement.config import (
+        MESH_ANNOTATION,
+        placement_config_from_annotations,
+    )
+
+    cfg = None
+    if ann.get(MESH_ANNOTATION) is not None:
+        try:
+            cfg = placement_config_from_annotations(ann)
+        except ValueError:
+            cfg = None  # GL1201 (placement pass) already reported it
+
+    findings: list[Finding] = []
+
+    def visit(u: Any, path: str) -> None:
+        model_class = u.parameters.get("model_class")
+        if isinstance(model_class, str) and model_class:
+            sig = signature_for(model_class)
+            if sig is not None:
+                findings.extend(lint_signature(model_class, sig, path=path))
+                if cfg is not None and cfg.enabled:
+                    findings.extend(
+                        _mesh_findings(model_class, sig, cfg, path))
+        for c in u.children:
+            visit(c, f"{path}/{c.name}")
+
+    visit(root, f"{prefix}/{root.name}" if prefix else root.name)
+    return findings

@@ -411,7 +411,9 @@ class FleetObserver:
                         "error": f"{type(e).__name__}: {e}",
                     }
 
-        results = await asyncio.gather(
+        # one() converts every failure into an unreachable-replica dict,
+        # so the gather cannot raise
+        results = await asyncio.gather(  # graphlint: disable=RL605
             *(one(rid, url) for rid, url in targets))
         replicas: dict = {}
         statuses: dict = {}
@@ -579,7 +581,10 @@ class FleetObserver:
                 cached = self._health_cache.get(deployment)
             if cached is not None and now - cached[0] < ttl:
                 return {**cached[1], "cached": True}
+        # scrape() returns error-shaped payloads instead of raising, so
+        # fail-fast here is unreachable
         health, flights, compiles = await asyncio.gather(
+            # graphlint: disable=RL605
             self.scrape(session, targets, "/admin/health",
                         endpoint="health"),
             self.scrape(session, targets, "/admin/flightrecorder",

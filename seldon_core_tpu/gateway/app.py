@@ -138,6 +138,9 @@ class Gateway:
         # keyed like _caches — rebuilt on annotation change, membership
         # reconciled in place on URL-list change so stats survive.
         self._pools: dict[str, tuple] = {}
+        # strong refs to in-flight background probes: the event loop only
+        # weak-refs its tasks, so a bare create_task can be GC'd mid-probe
+        self._probe_tasks: set = set()
         self._retry_rng = random.Random()
         self.fleet_probe_interval_s = FLEET_PROBE_INTERVAL_S
         # Distributed tracing (docs/observability.md): the gateway is the
@@ -620,8 +623,12 @@ class Gateway:
             else None
         )
         if pool is not None and pool.probe_due(self.fleet_probe_interval_s):
-            # active health sweep, off this request's critical path
-            asyncio.get_running_loop().create_task(self._pool_probe(pool))
+            # active health sweep, off this request's critical path; keep a
+            # strong ref until done (RL603: bare tasks can be GC'd mid-flight)
+            task = asyncio.get_running_loop().create_task(
+                self._pool_probe(pool))
+            self._probe_tasks.add(task)
+            task.add_done_callback(self._probe_tasks.discard)
         last_err: Optional[Exception] = None
         excluded: list[str] = []
         out_body, out_status = b"", 0

@@ -602,8 +602,12 @@ class GraphEngine:
         if len(selected) == 1:
             child_outputs = [await selected[0](transformed)]
         else:
+            # fail-fast on the first child error, matching the Java
+            # engine's @Async future semantics; siblings are cancelled
+            # by the walk deadline
             child_outputs = list(
-                await asyncio.gather(*(w(transformed) for w in selected))
+                await asyncio.gather(  # graphlint: disable=RL605
+                    *(w(transformed) for w in selected))
             )
 
         # 5. aggregate: COMBINER via impl; default = first child output
@@ -1013,7 +1017,15 @@ class GraphEngine:
                 targets = [node.children[routing]]
             else:
                 targets = node.children
-            await asyncio.gather(*(self._feedback_walk(c, fb) for c in targets))
+            # deliver to EVERY branch before propagating a failure — one
+            # broken child must not starve its siblings of reward signal
+            results = await asyncio.gather(
+                *(self._feedback_walk(c, fb) for c in targets),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
         # has() is authoritative when present (ComponentHandle, RemoteComponent);
         # duck-typed impls without has() get feedback iff they define the method
         has = getattr(node.impl, "has", None)

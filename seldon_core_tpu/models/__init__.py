@@ -149,3 +149,51 @@ def register_signature(model_class: str, sig: ModelSignature) -> None:
 
 def signature_for(model_class: str) -> Optional[ModelSignature]:
     return SIGNATURES.get(model_class)
+
+
+# ---------------------------------------------------------------------------
+# trace providers — how the GL16xx trace-lint verifies a signature
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TraceTarget:
+    """Abstract (fn, params) pair the trace-lint feeds to
+    ``jax.eval_shape`` / ``jax.make_jaxpr``.
+
+    ``fn(params, X)`` must be the node's serving function *unbound* from
+    any instance; ``params`` is a pytree of ``jax.ShapeDtypeStruct``
+    leaves (or a zero-cost abstract tree from ``jax.eval_shape`` over
+    the init function) — no weights are ever materialized."""
+
+    fn: object
+    params: object
+
+
+#: module:Class → zero-arg callable returning a :class:`TraceTarget`.
+#: Providers are LAZY: registering one must not import jax; only
+#: invoking it may.  Classes without a provider (stateful engines,
+#: shapeless numpy components) are simply not statically traceable and
+#: the GL16xx pass skips them.
+TRACE_PROVIDERS: dict = {}
+
+_BUILTIN_PROVIDERS_LOADED = False
+
+
+def register_trace_provider(model_class: str, provider) -> None:
+    """Register (or override) the trace provider for a ``module:Class``."""
+    TRACE_PROVIDERS[model_class] = provider
+
+
+def trace_target_for(model_class: str) -> Optional[TraceTarget]:
+    """Resolve and invoke the trace provider for ``model_class``.
+
+    Installs the built-in model-zoo providers (``models/traceable.py``,
+    which imports jax) on first use, keeping this module jax-free at
+    import time."""
+    global _BUILTIN_PROVIDERS_LOADED
+    if model_class not in TRACE_PROVIDERS and not _BUILTIN_PROVIDERS_LOADED:
+        _BUILTIN_PROVIDERS_LOADED = True
+        from seldon_core_tpu.models import traceable
+        traceable.install()
+    provider = TRACE_PROVIDERS.get(model_class)
+    return provider() if provider is not None else None

@@ -1,0 +1,93 @@
+"""Built-in trace providers for the model zoo (GL16xx trace-lint).
+
+Each provider returns a :class:`~seldon_core_tpu.models.TraceTarget`:
+the class's serving function unbound from any instance, plus an
+*abstract* parameter pytree obtained with ``jax.eval_shape`` over the
+same init path the real constructor runs — zero weights allocated, zero
+FLOPs executed.  ``analysis/tracelint.py`` then traces
+``fn(params, X)`` with ``jax.make_jaxpr`` and verifies the hand-declared
+:class:`~seldon_core_tpu.models.ModelSignature` against reality.
+
+This module imports jax and is only ever imported on demand
+(``trace_target_for``), so the signature registry itself stays jax-free.
+
+Not every model is statically traceable, and that is fine:
+
+- ``llm_demo.DemoLLM`` wraps the continuous-batching engine — per-request
+  host state, ragged KV caches; there is no pure ``fn(params, X)``.
+- ``outlier.MahalanobisOutlier`` is a learning numpy component with a
+  shapeless signature; nothing declared means nothing to verify.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models import TraceTarget, register_trace_provider
+
+
+def _iris_target() -> TraceTarget:
+    from seldon_core_tpu.models.iris import IrisClassifier
+
+    # __init__ trains with jax ops; its param tree is statically (4,3)+(3,)
+    params = {
+        "w": jax.ShapeDtypeStruct((4, 3), jnp.float32),
+        "b": jax.ShapeDtypeStruct((3,), jnp.float32),
+    }
+    # predict_fn never touches self — trace it unbound
+    return TraceTarget(
+        fn=lambda p, X: IrisClassifier.predict_fn(None, p, X),
+        params=params,
+    )
+
+
+def _mlp_target() -> TraceTarget:
+    from seldon_core_tpu.models.mlp import init_mlp_params, mlp_apply
+
+    params = jax.eval_shape(
+        lambda: init_mlp_params(jax.random.PRNGKey(0), (784, 512, 256, 10)))
+    return TraceTarget(fn=mlp_apply, params=params)
+
+
+def _resnet_module():
+    from seldon_core_tpu.models.resnet import ResNet
+
+    return ResNet(num_classes=1000, dtype=jnp.bfloat16)
+
+
+def _resnet_variables():
+    module = _resnet_module()
+    return jax.eval_shape(
+        module.init,
+        jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32),
+    )
+
+
+def _resnet_target() -> TraceTarget:
+    module = _resnet_module()
+    return TraceTarget(
+        fn=lambda variables, X: module.apply(variables, jnp.asarray(X)),
+        params=_resnet_variables(),
+    )
+
+
+def _resnet_int8_target() -> TraceTarget:
+    from seldon_core_tpu.models.resnet_int8 import convert_params, forward
+
+    weights = jax.eval_shape(convert_params, _resnet_variables())
+    return TraceTarget(fn=forward, params=weights)
+
+
+def install() -> None:
+    """Register the model-zoo providers (idempotent)."""
+    register_trace_provider(
+        "seldon_core_tpu.models.iris:IrisClassifier", _iris_target)
+    register_trace_provider(
+        "seldon_core_tpu.models.mlp:MNISTMLP", _mlp_target)
+    register_trace_provider(
+        "seldon_core_tpu.models.resnet:ResNet50Model", _resnet_target)
+    register_trace_provider(
+        "seldon_core_tpu.models.resnet_int8:Int8ResNet50Model",
+        _resnet_int8_target)

@@ -454,11 +454,21 @@ def admission_lint(dep: SeldonDeployment) -> list:
 
     Raises :class:`~seldon_core_tpu.analysis.GraphAnalysisError` when an
     enforce-mode predictor carries ERROR findings; returns every finding
-    otherwise so callers can surface WARN/INFO."""
+    otherwise so callers can surface WARN/INFO.
+
+    Unlike the spec-only CLI path, admission runs in the operator
+    process where jax is (or will be) loaded anyway — import it here so
+    the jax-gated passes (GL1202 visible devices, GL16xx trace-lint)
+    always gate admission rather than depending on import order."""
     from seldon_core_tpu.analysis.graphlint import (
         GraphAnalysisError,
         lint_graph,
     )
+
+    try:
+        import jax  # noqa: F401  (activates the jax-gated lint passes)
+    except ImportError:
+        pass  # spec-only environment: those passes stay off
 
     findings = []
     rejects = []

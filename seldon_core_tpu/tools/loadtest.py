@@ -381,7 +381,10 @@ async def run_load(
                     count += 1
                     lat.append((t1 - t0) * 1000.0)
 
-        await asyncio.gather(*(worker() for _ in range(concurrency)))
+        # worker() catches per-request errors into `failures`; the gather
+        # can only fail-fast on a driver bug
+        await asyncio.gather(  # graphlint: disable=RL605
+            *(worker() for _ in range(concurrency)))
         measured = time.perf_counter() - t_start
         return LoadResult(
             protocol=protocol or type(driver).__name__,

@@ -371,6 +371,21 @@ def test_pragma_suppression():
     assert findings == []
 
 
+def test_pragma_suppression_multiline_node():
+    # regression: the disable comment is honored on any line the flagged
+    # node spans — here the closing line of a multi-line blocking call
+    findings = _lint_src("""
+        import requests
+
+        async def fetch(url):
+            return requests.get(
+                url,
+                timeout=30,
+            )  # graphlint: disable=RL401
+    """)
+    assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
@@ -411,6 +426,65 @@ def test_cli_self_on_seeded_bad_file(tmp_path):
             time.sleep(1)
     """))
     assert analysis_main(["--self", str(mod)]) == 1
+
+
+def test_cli_self_flags_rl6xx(tmp_path):
+    mod = tmp_path / "hot.py"
+    mod.write_text(textwrap.dedent("""
+        import asyncio
+
+        async def serve(handler):
+            asyncio.create_task(handler())
+    """))
+    assert analysis_main(["--self", str(mod)]) == 1
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [{"name": "m", "type": "MODEL"}],
+    }))
+    mod = tmp_path / "hot.py"
+    mod.write_text(textwrap.dedent("""
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """))
+    sarif_path = tmp_path / "out.sarif"
+    assert analysis_main(
+        [str(bad), "--self", str(mod), "--sarif", str(sarif_path)]) == 1
+    capsys.readouterr()
+    log = json.loads(sarif_path.read_text())
+    assert log["version"] == "2.1.0"
+    (run,) = log["runs"]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    results = run["results"]
+    by_rule = {r["ruleId"]: r for r in results}
+    # graph finding: logical location (unit path, no file)
+    assert "GL103" in rules
+    loc = by_rule["GL103"]["locations"][0]
+    assert loc["logicalLocations"][0]["fullyQualifiedName"] == "ens"
+    assert by_rule["GL103"]["level"] == "error"
+    # repo-lint finding: physical file + line region
+    assert "RL401" in rules
+    phys = by_rule["RL401"]["locations"][0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"].endswith("hot.py")
+    assert phys["region"]["startLine"] == 5
+
+
+def test_cli_sarif_empty_findings_is_valid(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_model("m", IRIS)))
+    sarif_path = tmp_path / "out.sarif"
+    assert analysis_main([str(good), "--sarif", str(sarif_path)]) == 0
+    capsys.readouterr()
+    log = json.loads(sarif_path.read_text())
+    (run,) = log["runs"]
+    assert run["results"] == []
+    assert run["tool"]["driver"]["rules"] == []
 
 
 def test_cli_module_invocation_runs():

@@ -1,0 +1,323 @@
+"""RL6xx asyncio concurrency lint (ISSUE 16).
+
+Each rule RL601-RL605 is pinned with a seeded-bad snippet asserting the
+exact code and a minimally-fixed twin asserting silence, so the rules
+stay anchored to the defect they were built for.  The suppression
+pragma (including the multi-line anchoring fix from this issue) is
+covered at the bottom.
+"""
+
+import textwrap
+
+from seldon_core_tpu.analysis import lint_source
+from seldon_core_tpu.analysis.asynclint import lint_source as async_only
+from seldon_core_tpu.analysis.findings import (
+    DISCARDED_TASK,
+    GATHER_WITHOUT_RETURN_EXCEPTIONS,
+    LOCK_HELD_ACROSS_REMOTE_AWAIT,
+    SHARED_MUTATION_ACROSS_AWAIT,
+    UNLOCKED_CHECK_THEN_ACT,
+)
+
+
+def lint(src):
+    return async_only(textwrap.dedent(src), "mod.py")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def the(findings, code):
+    hits = [f for f in findings if f.code == code]
+    assert len(hits) == 1, f"expected exactly one {code}, got {findings}"
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# RL601: check -> await -> act on shared state without a lock
+# ---------------------------------------------------------------------------
+
+RL601_BAD = """
+    import asyncio
+
+    class Cache:
+        def __init__(self):
+            self._entries = {}
+            self._lock = asyncio.Lock()
+
+        async def get_or_load(self, key, load):
+            if key in self._entries:
+                return self._entries[key]
+            value = await load(key)
+            self._entries[key] = value
+            return value
+"""
+
+
+def test_rl601_check_then_act_without_lock():
+    f = the(lint(RL601_BAD), UNLOCKED_CHECK_THEN_ACT)
+    assert "self._entries" in f.message
+    assert f.path.startswith("mod.py:")
+
+
+def test_rl601_fixed_with_lock_is_quiet():
+    src = """
+        import asyncio
+
+        class Cache:
+            def __init__(self):
+                self._entries = {}
+                self._lock = asyncio.Lock()
+
+            async def get_or_load(self, key, load):
+                async with self._lock:
+                    if key in self._entries:
+                        return self._entries[key]
+                    value = await load(key)
+                    self._entries[key] = value
+                    return value
+    """
+    assert lint(src) == []
+
+
+def test_rl601_module_global_dict():
+    src = """
+        _REGISTRY = {}
+
+        async def admit(name, build):
+            if name not in _REGISTRY:
+                built = await build(name)
+                _REGISTRY[name] = built
+            return _REGISTRY[name]
+    """
+    the(lint(src), UNLOCKED_CHECK_THEN_ACT)
+
+
+def test_rl601_no_await_between_is_quiet():
+    # check and act with no suspension point between them: atomic under
+    # the event loop, not a race
+    src = """
+        _REGISTRY = {}
+
+        async def admit(name, build):
+            if name not in _REGISTRY:
+                _REGISTRY[name] = object()
+            return _REGISTRY[name]
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RL602: shared container read before an await, mutated after, unlocked
+# ---------------------------------------------------------------------------
+
+RL602_BAD = """
+    class Pool:
+        def __init__(self):
+            self._replicas = []
+
+        async def rebalance(self, probe):
+            snapshot = list(self._replicas)
+            healthy = await probe(snapshot)
+            self._replicas.clear()
+            self._replicas.extend(healthy)
+"""
+
+
+def test_rl602_mutation_across_await():
+    f = the(lint(RL602_BAD), SHARED_MUTATION_ACROSS_AWAIT)
+    assert "self._replicas" in f.message
+
+
+def test_rl602_fixed_with_lock_is_quiet():
+    src = """
+        import asyncio
+
+        class Pool:
+            def __init__(self):
+                self._replicas = []
+                self._lock = asyncio.Lock()
+
+            async def rebalance(self, probe):
+                snapshot = list(self._replicas)
+                healthy = await probe(snapshot)
+                async with self._lock:
+                    self._replicas.clear()
+                    self._replicas.extend(healthy)
+    """
+    assert lint(src) == []
+
+
+def test_rl601_subsumes_rl602_one_finding_per_key():
+    # a checked-then-acted key also read/mutated across the await gets
+    # RL601 only, never both
+    found = codes(lint(RL601_BAD))
+    assert found == [UNLOCKED_CHECK_THEN_ACT]
+
+
+# ---------------------------------------------------------------------------
+# RL603: fire-and-forget task with no reference kept
+# ---------------------------------------------------------------------------
+
+def test_rl603_discarded_create_task():
+    src = """
+        import asyncio
+
+        async def serve(handler):
+            asyncio.create_task(handler())
+    """
+    the(lint(src), DISCARDED_TASK)
+
+
+def test_rl603_discarded_ensure_future():
+    src = """
+        import asyncio
+
+        async def serve(handler):
+            asyncio.ensure_future(handler())
+    """
+    the(lint(src), DISCARDED_TASK)
+
+
+def test_rl603_kept_reference_is_quiet():
+    src = """
+        import asyncio
+
+        async def serve(handler, tasks):
+            task = asyncio.create_task(handler())
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RL604: asyncio lock held across a remote-looking await
+# ---------------------------------------------------------------------------
+
+def test_rl604_lock_held_across_remote_call():
+    src = """
+        import asyncio
+
+        class Client:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def call(self, session, url):
+                async with self._lock:
+                    return await session.post(url)
+    """
+    the(lint(src), LOCK_HELD_ACROSS_REMOTE_AWAIT)
+
+
+def test_rl604_remote_call_outside_lock_is_quiet():
+    src = """
+        import asyncio
+
+        class Client:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+                self._seq = 0
+
+            async def call(self, session, url):
+                async with self._lock:
+                    self._seq += 1
+                return await session.post(url)
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RL605: bare asyncio.gather in a try-less scope
+# ---------------------------------------------------------------------------
+
+def test_rl605_bare_gather():
+    src = """
+        import asyncio
+
+        async def fan_out(workers):
+            await asyncio.gather(*(w() for w in workers))
+    """
+    the(lint(src), GATHER_WITHOUT_RETURN_EXCEPTIONS)
+
+
+def test_rl605_return_exceptions_is_quiet():
+    src = """
+        import asyncio
+
+        async def fan_out(workers):
+            results = await asyncio.gather(
+                *(w() for w in workers), return_exceptions=True)
+            return results
+    """
+    assert lint(src) == []
+
+
+def test_rl605_gather_inside_try_is_quiet():
+    src = """
+        import asyncio
+
+        async def fan_out(workers):
+            try:
+                await asyncio.gather(*(w() for w in workers))
+            except Exception:
+                pass
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression pragmas (shared with RL4xx/RL5xx)
+# ---------------------------------------------------------------------------
+
+def test_rl6xx_pragma_suppression():
+    src = """
+        import asyncio
+
+        async def fan_out(workers):
+            await asyncio.gather(  # graphlint: disable=RL605
+                *(w() for w in workers))
+    """
+    assert lint(src) == []
+
+
+def test_rl6xx_skip_file_pragma():
+    src = """
+        # graphlint: skip-file
+        import asyncio
+
+        async def serve(handler):
+            asyncio.create_task(handler())
+    """
+    assert lint(src) == []
+
+
+def test_pragma_anchors_to_any_line_of_the_node():
+    # regression for the anchoring fix: the disable comment may sit on
+    # any line the flagged node spans, not just its first line
+    src = """
+        import asyncio
+
+        async def fan_out(workers):
+            await asyncio.gather(
+                *(w() for w in workers),
+            )  # graphlint: disable=RL605
+    """
+    assert lint(src) == []
+
+
+def test_combined_lint_source_includes_rl6xx():
+    # the package-level lint_source runs RL4xx/RL5xx and RL6xx together
+    src = """
+        import asyncio
+
+        async def serve(handler):
+            asyncio.create_task(handler())
+    """
+    the(lint_source(textwrap.dedent(src), "mod.py"), DISCARDED_TASK)
+
+
+def test_syntax_error_is_quiet():
+    # repolint owns parse-failure reporting; asynclint stays silent
+    assert async_only("def broken(:\n", "mod.py") == []

@@ -59,3 +59,32 @@ def test_lint_script_exists_and_is_executable():
     path = os.path.join(ROOT, "scripts", "lint.sh")
     assert os.path.exists(path)
     assert os.access(path, os.X_OK)
+
+
+def test_docs_code_table_matches_registry():
+    """docs/static-analysis.md's code table and CODE_SEVERITY must agree
+    both ways: every registered code documented with its severity, and no
+    documented code missing from (or contradicting) the registry."""
+    import re
+
+    from seldon_core_tpu.analysis.findings import CODE_SEVERITY
+
+    doc = os.path.join(ROOT, "docs", "static-analysis.md")
+    with open(doc, encoding="utf-8") as f:
+        text = f.read()
+    row = re.compile(
+        r"^\|\s*`(?P<code>[A-Z]{2}\d+)`\s*\|\s*(?P<sev>ERROR|WARN|INFO)\s*\|",
+        re.MULTILINE)
+    documented = {m.group("code"): m.group("sev")
+                  for m in row.finditer(text)}
+    assert documented, "no code table rows parsed from the docs"
+    undocumented = sorted(set(CODE_SEVERITY) - set(documented))
+    assert not undocumented, \
+        f"codes missing from docs/static-analysis.md: {undocumented}"
+    unregistered = sorted(set(documented) - set(CODE_SEVERITY))
+    assert not unregistered, \
+        f"documented codes missing from CODE_SEVERITY: {unregistered}"
+    drifted = sorted(c for c in documented
+                     if documented[c] != CODE_SEVERITY[c])
+    assert not drifted, \
+        f"severity drift between docs and CODE_SEVERITY: {drifted}"

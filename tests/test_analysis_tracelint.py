@@ -1,0 +1,331 @@
+"""GL16xx jaxpr trace-lint (ISSUE 16).
+
+Each rule GL1601-GL1604 is pinned with a seeded drifted/bad synthetic
+registry entry asserting the code fires, plus a minimally-fixed twin
+asserting silence.  Admission wiring (a drifted registry entry rejects
+the CR with GL1601 on status.analysis) is covered at the bottom.
+
+Synthetic entries use unique ``tests.synthetic:*`` model-class keys so
+the per-process trace cache never leaks between tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.analysis.findings import (
+    TRACE_CALLBACK_IN_PURE_FN,
+    TRACE_IMPLICIT_PROMOTION,
+    TRACE_MESH_INDIVISIBLE,
+    TRACE_SIGNATURE_DRIFT,
+)
+from seldon_core_tpu.analysis.tracelint import (
+    _mesh_findings,
+    lint_registry,
+    lint_signature,
+)
+from seldon_core_tpu.models import (
+    SIGNATURES,
+    TRACE_PROVIDERS,
+    ModelSignature,
+    TraceTarget,
+)
+from seldon_core_tpu.placement.config import PlacementConfig
+
+IRIS = "seldon_core_tpu.models.iris:IrisClassifier"
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def _register(monkeypatch, model_class, sig, fn, params):
+    monkeypatch.setitem(SIGNATURES, model_class, sig)
+    monkeypatch.setitem(
+        TRACE_PROVIDERS, model_class, lambda: TraceTarget(fn, params))
+
+
+def _dense(out_features=3, in_features=4):
+    params = {"w": jax.ShapeDtypeStruct(
+        (in_features, out_features), jnp.float32)}
+    return lambda p, x: jnp.dot(x, p["w"]), params
+
+
+# ---------------------------------------------------------------------------
+# GL1601: declared signature vs traced reality
+# ---------------------------------------------------------------------------
+
+def test_gl1601_output_drift(monkeypatch):
+    fn, params = _dense(out_features=3)
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        output_shape=(None, 5), output_dtype="float32", pure_fn=True)
+    mc = "tests.synthetic:DriftNet"
+    _register(monkeypatch, mc, sig, fn, params)
+    (f,) = lint_signature(mc)
+    assert f.code == TRACE_SIGNATURE_DRIFT
+    assert "drifted" in f.message
+
+
+def test_gl1601_fixed_declaration_is_quiet(monkeypatch):
+    fn, params = _dense(out_features=3)
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        output_shape=(None, 3), output_dtype="float32", pure_fn=True)
+    mc = "tests.synthetic:DriftNetFixed"
+    _register(monkeypatch, mc, sig, fn, params)
+    assert lint_signature(mc) == []
+
+
+def test_gl1601_dtype_drift(monkeypatch):
+    fn, params = _dense()
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        output_shape=(None, 3), output_dtype="bfloat16", pure_fn=True)
+    mc = "tests.synthetic:DtypeDrift"
+    _register(monkeypatch, mc, sig, fn, params)
+    assert codes(lint_signature(mc)) == [TRACE_SIGNATURE_DRIFT]
+
+
+def test_gl1601_untraceable_input_contract(monkeypatch):
+    # declared input width 4 cannot feed a (7, 3) weight: the trace
+    # itself fails, which IS the drift finding
+    fn, params = _dense(in_features=7)
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        output_shape=(None, 3), output_dtype="float32", pure_fn=True)
+    mc = "tests.synthetic:Untraceable"
+    _register(monkeypatch, mc, sig, fn, params)
+    (f,) = lint_signature(mc)
+    assert f.code == TRACE_SIGNATURE_DRIFT
+    assert "does not trace" in f.message
+
+
+def test_no_provider_is_not_a_defect(monkeypatch):
+    sig = ModelSignature(input_shape=(None, 4), input_dtype="float32")
+    mc = "tests.synthetic:NoProvider"
+    monkeypatch.setitem(SIGNATURES, mc, sig)
+    assert lint_signature(mc) == []
+
+
+# ---------------------------------------------------------------------------
+# GL1602: weak types / implicit promotion
+# ---------------------------------------------------------------------------
+
+def test_gl1602_weak_typed_output(monkeypatch):
+    # python scalar -> weak-typed result: re-promotes per call site,
+    # fragmenting executable cache keys
+    sig = ModelSignature(input_shape=(None, 4), input_dtype="float32")
+    mc = "tests.synthetic:WeakOut"
+    _register(monkeypatch, mc, sig, lambda p, x: jnp.exp(1.0), {})
+    (f,) = lint_signature(mc)
+    assert f.code == TRACE_IMPLICIT_PROMOTION
+    assert "weak" in f.message
+
+
+def test_gl1602_pinned_dtype_is_quiet(monkeypatch):
+    sig = ModelSignature(input_shape=(None, 4), input_dtype="float32")
+    mc = "tests.synthetic:StrongOut"
+    _register(monkeypatch, mc, sig,
+              lambda p, x: jnp.exp(jnp.float32(1.0)), {})
+    assert lint_signature(mc) == []
+
+
+# ---------------------------------------------------------------------------
+# GL1603: host callback inside a pure_fn node
+# ---------------------------------------------------------------------------
+
+def _callback_fn(p, x):
+    return jax.pure_callback(
+        lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+
+def test_gl1603_callback_in_pure_fn(monkeypatch):
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32", pure_fn=True)
+    mc = "tests.synthetic:CallbackPure"
+    _register(monkeypatch, mc, sig, _callback_fn, {})
+    (f,) = lint_signature(mc)
+    assert f.code == TRACE_CALLBACK_IN_PURE_FN
+    assert "pure_callback" in f.message
+
+
+def test_gl1603_callback_without_pure_fn_is_quiet(monkeypatch):
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32", pure_fn=False)
+    mc = "tests.synthetic:CallbackImpure"
+    _register(monkeypatch, mc, sig, _callback_fn, {})
+    assert lint_signature(mc) == []
+
+
+# ---------------------------------------------------------------------------
+# GL1604: mesh axes must divide the dims they shard
+# ---------------------------------------------------------------------------
+
+def test_gl1604_dp_does_not_divide_batch():
+    sig = ModelSignature(
+        input_shape=(6, 4), input_dtype="float32", batch_shardable=True)
+    cfg = PlacementConfig(enabled=True, dp=4)
+    (f,) = _mesh_findings("tests.synthetic:FixedBatch", sig, cfg, "p/m")
+    assert f.code == TRACE_MESH_INDIVISIBLE
+    assert "dp=4" in f.message
+
+
+def test_gl1604_dp_divides_batch_is_quiet():
+    sig = ModelSignature(
+        input_shape=(6, 4), input_dtype="float32", batch_shardable=True)
+    cfg = PlacementConfig(enabled=True, dp=3)
+    assert _mesh_findings("tests.synthetic:FixedBatch", sig, cfg,
+                          "p/m") == []
+
+
+def test_gl1604_dp_skips_non_batch_shardable():
+    sig = ModelSignature(
+        input_shape=(6, 4), input_dtype="float32", batch_shardable=False)
+    cfg = PlacementConfig(enabled=True, dp=4)
+    assert _mesh_findings("tests.synthetic:CrossRow", sig, cfg, "p/m") == []
+
+
+def test_gl1604_tp_does_not_divide_param_dim(monkeypatch):
+    fn, params = _dense(out_features=10)
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        tp_param_specs={"w": (None, "tp")})
+    mc = "tests.synthetic:TpNet"
+    _register(monkeypatch, mc, sig, fn, params)
+    cfg = PlacementConfig(enabled=True, tp=3)
+    (f,) = _mesh_findings(mc, sig, cfg, "p/m")
+    assert f.code == TRACE_MESH_INDIVISIBLE
+    assert "'w'" in f.message and "tp=3" in f.message
+
+
+def test_gl1604_tp_divides_param_dim_is_quiet(monkeypatch):
+    fn, params = _dense(out_features=10)
+    sig = ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        tp_param_specs={"w": (None, "tp")})
+    mc = "tests.synthetic:TpNetEven"
+    _register(monkeypatch, mc, sig, fn, params)
+    cfg = PlacementConfig(enabled=True, tp=2)
+    assert _mesh_findings(mc, sig, cfg, "p/m") == []
+
+
+def test_gl1604_through_lint_deployment(monkeypatch):
+    # end to end: a meshed deployment whose model declares a fixed batch
+    # the dp axis cannot split (other mesh findings like GL1202 may
+    # accompany it on a 1-device CPU host — assert only on GL1604)
+    from seldon_core_tpu.analysis import lint_deployment
+
+    fn, params = _dense()
+    sig = ModelSignature(
+        input_shape=(6, 4), input_dtype="float32",
+        output_shape=(6, 3), output_dtype="float32",
+        pure_fn=True, batch_shardable=True)
+    mc = "tests.synthetic:MeshedNet"
+    _register(monkeypatch, mc, sig, fn, params)
+    dep = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "d"},
+        "spec": {
+            "name": "d",
+            "annotations": {"seldon.io/mesh": "dp=4"},
+            "predictors": [{"name": "p", "graph": {
+                "name": "m", "type": "MODEL",
+                "parameters": [{"name": "model_class", "value": mc,
+                                "type": "STRING"}],
+            }}],
+        },
+    }
+    assert TRACE_MESH_INDIVISIBLE in codes(lint_deployment(dep))
+    dep["spec"]["annotations"]["seldon.io/mesh"] = "dp=3"
+    assert TRACE_MESH_INDIVISIBLE not in codes(lint_deployment(dep))
+
+
+# ---------------------------------------------------------------------------
+# registry + admission wiring
+# ---------------------------------------------------------------------------
+
+def test_shipped_registry_traces_clean():
+    # the acceptance gate behind `--self`: every shipped signature that
+    # has a trace provider verifies against its callable
+    assert lint_registry() == []
+
+
+def test_reconcile_rejects_drifted_registry_entry(monkeypatch):
+    from seldon_core_tpu.operator.reconcile import (
+        FakeKubeApi,
+        SeldonDeploymentController,
+    )
+
+    orig = SIGNATURES[IRIS]
+    monkeypatch.setitem(SIGNATURES, IRIS, ModelSignature(
+        input_shape=orig.input_shape, input_dtype=orig.input_dtype,
+        output_shape=(None, 5), output_dtype="float32",
+        hbm_bytes=orig.hbm_bytes, pure_fn=orig.pure_fn))
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "d", "namespace": "default"},
+        "spec": {"name": "d", "predictors": [{"name": "p", "graph": {
+            "name": "m", "type": "MODEL",
+            "parameters": [{"name": "model_class", "value": IRIS,
+                            "type": "STRING"}],
+        }}]},
+    }
+    api = FakeKubeApi()
+    api.create(cr)
+    status = SeldonDeploymentController(api).reconcile(cr)
+    assert status["state"] == "Failed"
+    analysis = status.get("analysis") or []
+    assert TRACE_SIGNATURE_DRIFT in [f["code"] for f in analysis]
+    drift = [f for f in analysis
+             if f["code"] == TRACE_SIGNATURE_DRIFT][0]
+    assert drift["path"] == "p/m"
+
+
+def test_reconcile_accepts_clean_registry_entry():
+    from seldon_core_tpu.operator.reconcile import (
+        FakeKubeApi,
+        SeldonDeploymentController,
+    )
+
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "d", "namespace": "default"},
+        "spec": {"name": "d", "predictors": [{"name": "p", "graph": {
+            "name": "m", "type": "MODEL",
+            "parameters": [{"name": "model_class", "value": IRIS,
+                            "type": "STRING"}],
+        }}]},
+    }
+    api = FakeKubeApi()
+    api.create(cr)
+    status = SeldonDeploymentController(api).reconcile(cr)
+    assert status["state"] != "Failed"
+    analysis = status.get("analysis") or []
+    assert TRACE_SIGNATURE_DRIFT not in [f["code"] for f in analysis]
+
+
+def test_trace_failure_does_not_crash_lint(monkeypatch):
+    # a provider that raises at trace time must surface GL1601, never an
+    # exception out of the lint pass
+    def exploding(p, x):
+        raise RuntimeError("boom")
+
+    sig = ModelSignature(input_shape=(None, 4), input_dtype="float32")
+    mc = "tests.synthetic:Exploding"
+    _register(monkeypatch, mc, sig, exploding, {})
+    (f,) = lint_signature(mc)
+    assert f.code == TRACE_SIGNATURE_DRIFT
+
+
+@pytest.mark.parametrize("mc", sorted(
+    mc for mc in SIGNATURES if ":" in mc))
+def test_each_shipped_signature_lints_without_error(mc):
+    # smoke: lint_signature never raises for any shipped entry, provider
+    # or not (DemoLLM and MahalanobisOutlier have none by design)
+    for f in lint_signature(mc):
+        raise AssertionError(f"shipped registry entry drifted: {f}")
