@@ -1691,6 +1691,219 @@ def shard_smoke() -> int:
     return 1 if failures else 0
 
 
+def _tp_bench_deployment(name: str, extra_ann: dict):
+    """A single-node MNISTMLPClassifier LocalDeployment — the tp-span
+    reference model: its hidden layers carry declared column-parallel
+    ``tp_param_specs`` and its argmax output survives tensor-parallel
+    reduction reordering bitwise (docs/sharding.md)."""
+    from seldon_core_tpu.operator.local import LocalDeployment
+    from seldon_core_tpu.operator.spec import SeldonDeployment
+
+    dep = SeldonDeployment.from_dict({
+        "apiVersion": "machinelearning.seldon.io/v1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": name, "annotations": {
+            "seldon.io/batching": "false",
+            **extra_ann,
+        }},
+        "spec": {"predictors": [{
+            "name": "p", "replicas": 1,
+            "graph": {
+                "name": "clf", "type": "MODEL",
+                "parameters": [{
+                    "name": "model_class",
+                    "value":
+                        "seldon_core_tpu.models.mlp:MNISTMLPClassifier",
+                    "type": "STRING",
+                }],
+                "children": [],
+            },
+            "componentSpecs": [],
+        }]},
+    })
+    return LocalDeployment(dep, seed=0)
+
+
+def tp_smoke() -> int:
+    """Fast CI gate for tensor-parallel spans (8 forced host devices,
+    docs/sharding.md#tensor-parallel-spans): a segment whose weights
+    exceed the simulated per-device HBM budget must reject at admission
+    when replicated (GL1204 at dp=2) but plan as a tp span at tp=2
+    (GL1205 reports it); at runtime the tp=2 deployment must arm with
+    per-param NamedSharding weights, serve every bucket byte-identically
+    to the walk and unsharded fused modes through >0 sharded dispatches,
+    surface the span at /admin/placement; a second boot against the same
+    artifact store must hydrate the tp executables warm through the
+    byte-parity gate; and a rule-derived layout naming an indivisible
+    dim must reject with GL1207.  Returns a process exit code."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from seldon_core_tpu.analysis.graphlint import lint_graph
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.placement.http import placement_body
+
+    failures: list[str] = []
+    report: dict = {}
+    n_dev = jax.device_count()
+    report["devices"] = n_dev
+    if n_dev < 8:
+        print(json.dumps({"tp_smoke": report, "failures": [
+            f"need 8 host devices (XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8), got {n_dev}"]}))
+        return 1
+
+    graph = {"name": "clf", "type": "MODEL", "parameters": [{
+        "name": "model_class",
+        "value": "seldon_core_tpu.models.mlp:MNISTMLPClassifier",
+        "type": "STRING"}], "children": []}
+
+    # -- admission flip: infeasible replicated, feasible as a tp span ----
+    # 0.003 GiB budget over 2 mesh devices = ~1.61 MiB per device; the
+    # MLP's ~2.04 MiB of weights overflow that replicated (dp=2) but fit
+    # once the declared layouts shard them over tp=2 (~1.02 MiB/device)
+    budget = {"seldon.io/graph-plan": "fused", "seldon.io/tpu-hbm-gb": "0.003"}
+    dp_codes = {f.code for f in lint_graph(
+        graph, {**budget, "seldon.io/mesh": "dp=2"}) if f.severity == "ERROR"}
+    report["replicated_codes"] = sorted(dp_codes)
+    if "GL1204" not in dp_codes:
+        failures.append(
+            f"2.04 MiB of replicated weights on a 1.61 MiB/device budget "
+            f"must reject with GL1204, got {sorted(dp_codes)}")
+    tp_findings = lint_graph(graph, {**budget, "seldon.io/mesh": "tp=2"})
+    tp_codes = {f.code for f in tp_findings if f.severity == "ERROR"}
+    report["tp_codes"] = sorted(tp_codes)
+    if "GL1204" in tp_codes:
+        failures.append("the same weights over tp=2 must plan as a tp "
+                        "span, not reject with GL1204")
+    gl1205 = [f.message for f in tp_findings if f.code == "GL1205"]
+    if not any("planned tp span" in m for m in gl1205):
+        failures.append(f"GL1205 must report the planned tp span: {gl1205}")
+
+    # -- runtime: tp=2 arms, serves sharded, byte parity ----------------
+    store_dir = tempfile.mkdtemp(prefix="seldon-tp-smoke-")
+    xs = [np.linspace(0.0, 1.0, n * 784, dtype=np.float32).reshape(n, 784)
+          for n in (1, 4, 8)]
+    try:
+        tp_ann = {"seldon.io/graph-plan": "fused", "seldon.io/mesh": "tp=2",
+                  "seldon.io/artifact-store": store_dir}
+        sharded = _tp_bench_deployment("tp-smoke", tp_ann)
+        fused = _tp_bench_deployment("tp-smoke-fused", {
+            "seldon.io/graph-plan": "fused"})
+        walk = _tp_bench_deployment("tp-smoke-walk", {})
+
+        plane = sharded.placement
+        seg = sharded.predictors[0].engine.plan.segments[0]
+        report["mesh"] = plane.mesh_shape()
+        report["shard_parity"] = seg.shard_parity
+        report["mesh_slice"] = seg.shard_slice
+        report["tp_sharded_param_bytes"] = seg.tp_sharded_param_bytes
+        if plane.sharded_segments != [seg.name]:
+            failures.append(f"segment {seg.name!r} did not arm tp sharding "
+                            f"(sharded: {plane.sharded_segments})")
+        if seg.shard_tp != 2 or seg.shard_slice != "tp=2":
+            failures.append(f"expected a tp=2 span, got tp={seg.shard_tp} "
+                            f"slice {seg.shard_slice!r}")
+        if seg.shard_parity != "verified":
+            failures.append(f"arm-time parity probe: {seg.shard_parity!r}, "
+                            "expected 'verified'")
+        if not seg.tp_sharded_param_bytes:
+            failures.append("tp span armed but no param bytes shard")
+
+        def drive(dep):
+            eng = dep.predictors[0].engine
+            return [eng.predict_sync(
+                SeldonMessage.from_ndarray(x)).to_dict()["data"] for x in xs]
+
+        s0 = seg.n_sharded_calls  # boot warmup dispatches once already
+        outs = drive(sharded)
+        report["sharded_dispatches"] = seg.n_sharded_calls - s0
+        if seg.n_sharded_calls - s0 != len(xs):
+            failures.append(
+                f"{len(xs)} buckets served {seg.n_sharded_calls - s0} "
+                f"sharded dispatch(es) — every bucket must dispatch sharded")
+        bad = {k: v.get("parity") for k, v in seg.shard_cost_by_bucket.items()
+               if v.get("parity") != "verified"}
+        if bad:
+            failures.append(f"bucket parity gate failures: {bad}")
+        if outs != drive(fused) or outs != drive(walk):
+            failures.append("tp-sharded responses != unsharded fused / "
+                            "walk responses (byte parity broken)")
+
+        # -- /admin/placement: the span is visible --------------------
+        status, payload = placement_body(plane, {})
+        span_rows = [s for s in payload.get("segments", [])
+                     if s.get("source") == "tp-span"]
+        spans = payload.get("tpSpans", [])
+        report["placement"] = {"status": status, "spanRows": span_rows,
+                               "tpSpans": spans}
+        if status != 200 or not span_rows:
+            failures.append(
+                f"/admin/placement must plan the segment as a tp span "
+                f"(status {status}, rows {payload.get('segments')})")
+        if not any(s.get("meshSlice") == "tp=2" and s.get("params")
+                   for s in spans):
+            failures.append(f"/admin/placement tpSpans must name the "
+                            f"armed slice and sharded params: {spans}")
+
+        # -- warm boot: tp executables hydrate through the store ------
+        warm = _tp_bench_deployment("tp-smoke-warm", tp_ann)
+        wseg = warm.predictors[0].engine.plan.segments[0]
+        wouts = drive(warm)
+        report["warm"] = {
+            "hydrated_shard_buckets": len(wseg.shard_hydrated),
+            "sharded_dispatches": wseg.n_sharded_calls,
+            "plane": warm.predictors[0].artifacts.snapshot(),
+        }
+        if len(wseg.shard_hydrated) < len(xs):
+            failures.append(
+                f"warm boot hydrated {len(wseg.shard_hydrated)} of "
+                f"{len(xs)} tp buckets from the store")
+        if report["warm"]["plane"].get("liveCompiles", 0) != 0:
+            failures.append(
+                f"warm boot hit live compiles: {report['warm']['plane']}")
+        if wouts != outs:
+            failures.append("warm (hydrated) responses differ from cold")
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    # -- admission: a rule-derived indivisible layout rejects (GL1207) --
+    from seldon_core_tpu.models import (
+        ModelSignature,
+        TraceTarget,
+        register_signature,
+        register_trace_provider,
+    )
+
+    import jax.numpy as jnp
+
+    register_signature("tp_smoke:OddFfn", ModelSignature(
+        input_shape=(None, 4), input_dtype="float32",
+        hbm_bytes=60, pure_fn=True))
+    register_trace_provider("tp_smoke:OddFfn", lambda: TraceTarget(
+        fn=lambda p, X: X @ p["w1"],
+        params={"w1": jax.ShapeDtypeStruct((4, 3), jnp.float32)}))
+    fs = lint_graph(
+        {"name": "odd", "type": "MODEL", "parameters": [{
+            "name": "model_class", "value": "tp_smoke:OddFfn",
+            "type": "STRING"}], "children": []},
+        {"seldon.io/graph-plan": "fused", "seldon.io/mesh": "tp=2"},
+    )
+    codes = {f.code for f in fs if f.severity == "ERROR"}
+    report["indivisible_codes"] = sorted(codes)
+    if "GL1207" not in codes:
+        failures.append(
+            f"a w1 of (4, 3) under the rule table at tp=2 must reject "
+            f"with GL1207, got {sorted(codes)}")
+
+    print(json.dumps({"tp_smoke": report, "failures": failures}))
+    return 1 if failures else 0
+
+
 def artifact_smoke() -> int:
     """Fast CI gate for the artifact plane (CPU-only, docs/artifacts.md):
     boot the same 3-bucket fused MLP deployment twice against one
@@ -3825,6 +4038,17 @@ def main() -> None:
                          "modes, /admin/placement reports every segment "
                          "placed, and dp=16 on 8 devices rejects at "
                          "admission with GL1202; then exit")
+    ap.add_argument("--tp-smoke", action="store_true",
+                    help="fast CI gate (XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8): a "
+                         "segment whose weights overflow the per-device "
+                         "HBM budget rejects replicated (GL1204 at dp=2) "
+                         "but plans as a tp span at tp=2, arms with "
+                         "per-param NamedSharding weights, serves every "
+                         "bucket byte-identically through sharded "
+                         "dispatches, hydrates tp executables warm from "
+                         "the artifact store, and an indivisible layout "
+                         "rejects with GL1207; then exit")
     args = ap.parse_args()
 
     _enable_compile_cache()
@@ -3848,6 +4072,8 @@ def main() -> None:
         sys.exit(artifact_smoke())
     if args.shard_smoke:
         sys.exit(shard_smoke())
+    if args.tp_smoke:
+        sys.exit(tp_smoke())
     if os.environ.get("JAX_PLATFORMS"):
         # some TPU plugin images force-append their platform, overriding the
         # env; re-assert the user's explicit choice

@@ -108,6 +108,7 @@ PLACEMENT_UNKNOWN_SEGMENT = "GL1203"  # override names no fused segment
 PLACEMENT_HBM_INFEASIBLE = "GL1204"  # per-device HBM exceeds the GL3xx budget
 PLACEMENT_CONFIG_REPORT = "GL1205"  # placement report: mesh + assignments
 PLACEMENT_WITHOUT_MESH = "GL1206"   # placement overrides set, mesh absent
+PLACEMENT_TP_INDIVISIBLE = "GL1207"  # param dim indivisible by tp under the effective layout
 FLEET_ANNOTATION_INVALID = "GL1301"  # seldon.io/fleet-* value invalid
 FLEET_KNOBS_WITHOUT_FLEET = "GL1302"  # fleet knobs set, fleet-replicas absent
 FLEET_AUTOSCALE_BLIND = "GL1303"    # autoscale on, no health/profile signals
@@ -182,6 +183,7 @@ CODE_SEVERITY = {
     PLACEMENT_HBM_INFEASIBLE: ERROR,
     PLACEMENT_CONFIG_REPORT: INFO,
     PLACEMENT_WITHOUT_MESH: WARN,
+    PLACEMENT_TP_INDIVISIBLE: ERROR,
     FLEET_ANNOTATION_INVALID: ERROR,
     FLEET_KNOBS_WITHOUT_FLEET: WARN,
     FLEET_AUTOSCALE_BLIND: WARN,

@@ -1132,9 +1132,12 @@ def _placement_pass(root: PredictiveUnit, ann: dict,
     inventory (GL1202 — ``dp=16`` on 8 devices fails here, not at the
     first sharded dispatch), rejects overrides naming segments the plan
     compiler will not form (GL1203), proves per-device HBM feasibility
-    against the GL3xx budget split across the mesh (GL1204), warns when
+    against the GL3xx budget split across the mesh (GL1204 — with a ``tp``
+    axis, segments whose members declare ``tp_param_specs`` plan as tp
+    spans first, so covered weights divide by tp instead of replicating
+    and a spec infeasible at tp=1 can admit at tp=2), warns when
     overrides are set without a mesh (GL1206), and reports the effective
-    mesh + assignments (GL1205)."""
+    mesh + assignments including planned tp spans (GL1205)."""
     from seldon_core_tpu.placement.config import (
         MESH_ANNOTATION,
         PLACEMENT_ANNOTATION,
@@ -1187,6 +1190,7 @@ def _placement_pass(root: PredictiveUnit, ann: dict,
     if budget_gb is None:
         chips = _num(ann.get(CHIPS_ANNOTATION))
         budget_gb = chips * HBM_PER_CHIP_GB if chips and chips > 0 else None
+    tp_spans: list = []
     if budget_gb is not None and budget_gb > 0 and segments:
         from seldon_core_tpu.placement.planner import (
             SegmentFacts,
@@ -1197,6 +1201,7 @@ def _placement_pass(root: PredictiveUnit, ann: dict,
         for seg in segments:
             hbm = 0
             shardable = True
+            tp_bytes = 0
             for u in seg:
                 sig, _ = _node_signature(u)
                 if sig is None:
@@ -1205,19 +1210,27 @@ def _placement_pass(root: PredictiveUnit, ann: dict,
                 hbm += sig.hbm_bytes
                 if not sig.batch_shardable:
                     shardable = False
+                # static tp-shardability: a member declaring per-param
+                # layouts contributes its weights to the tp span (the
+                # runtime's resolve_layout sharpens this to the exact
+                # covered bytes; GL1207 rejects indivisible dims)
+                if cfg.tp > 1 and sig.tp_param_specs:
+                    tp_bytes += sig.hbm_bytes
             facts.append(SegmentFacts(
                 name=seg[0].name, hbm_bytes=hbm, measured_hbm_bytes=0,
                 shardable=shardable and cfg.dp > 1,
                 members=tuple(sorted(u.name for u in seg)),
+                tp_shardable_bytes=tp_bytes,
             ))
         per_device = budget_gb * (1 << 30) / cfg.n_devices
         plan = plan_placement(
-            facts, n_devices=cfg.n_devices, dp=cfg.dp,
+            facts, n_devices=cfg.n_devices, dp=cfg.dp, tp=cfg.tp,
             mesh_spec=cfg.spec(),
             overrides={k: min(v, cfg.n_devices - 1)
                        for k, v in cfg.override_map().items()},
             capacity_bytes=int(per_device),
         )
+        tp_spans = [a for a in plan.assignments if a.source == "tp-span"]
         if plan.over_capacity:
             worst = max(plan.device_hbm_bytes.values(), default=0)
             findings.append(make_finding(
@@ -1239,6 +1252,12 @@ def _placement_pass(root: PredictiveUnit, ann: dict,
     else:
         detail += ("; graph-plan is not 'fused' — no segments to place "
                    "until it is")
+    if tp_spans:
+        spans = ", ".join(
+            f"{a.segment}(tp={cfg.tp}, "
+            f"{a.tp_bytes_per_device / (1 << 20):.2f} MiB/device)"
+            for a in tp_spans)
+        detail += f"; planned tp span(s): {spans}"
     findings.append(make_finding(PLACEMENT_CONFIG_REPORT, path0, detail))
     return findings
 

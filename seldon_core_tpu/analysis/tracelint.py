@@ -24,6 +24,11 @@ execution, no device — and the declaration is checked against the trace:
   does not evenly divide the dimension it would shard: ``dp`` against a
   fixed declared batch dim, ``tp`` against the traced parameter dims
   named by ``tp_param_specs``.
+- **GL1207 ERROR** — the *effective* tp layout (declared
+  ``tp_param_specs`` merged over ``placement/layouts.py``'s rule table)
+  names a traced param dim ``tp`` does not divide: the runtime would
+  silently replicate that param, voiding the tp-span HBM plan the
+  placement pass admitted.
 
 Activation: the pass never *imports* jax — spec-only lints stay cheap —
 but runs whenever jax is already loaded (operator admission imports it,
@@ -36,6 +41,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from seldon_core_tpu.analysis.findings import (
+    PLACEMENT_TP_INDIVISIBLE,
     TRACE_CALLBACK_IN_PURE_FN,
     TRACE_IMPLICIT_PROMOTION,
     TRACE_MESH_INDIVISIBLE,
@@ -257,14 +263,16 @@ def _mesh_findings(model_class: str, sig: ModelSignature, cfg: Any,
                 f"the declared batch dim {batch} — the sharded dispatch "
                 "cannot split this batch evenly",
             ))
-    if cfg.tp > 1 and sig.tp_param_specs:
+    if cfg.tp > 1:
         trace = _trace_model(model_class, sig)
         param_dims = trace.param_dims if trace and not trace.error else {}
-        for key, spec in sorted(sig.tp_param_specs.items()):
+        flagged: set = set()
+        for key, spec in sorted((sig.tp_param_specs or {}).items()):
             dims = None
+            matched = None
             for pkey, shape in param_dims.items():
                 if pkey == key or pkey.endswith("/" + key) or key in pkey:
-                    dims = shape
+                    dims, matched = shape, pkey
                     break
             if dims is None:
                 continue  # provider absent or key unmatched — nothing to check
@@ -272,6 +280,7 @@ def _mesh_findings(model_class: str, sig: ModelSignature, cfg: Any,
                 if axis_name != "tp" or axis >= len(dims):
                     continue
                 if dims[axis] % cfg.tp:
+                    flagged.add(matched)
                     findings.append(make_finding(
                         TRACE_MESH_INDIVISIBLE, at,
                         f"{model_class}: tp_param_specs shards param "
@@ -279,6 +288,26 @@ def _mesh_findings(model_class: str, sig: ModelSignature, cfg: Any,
                         f"tp={cfg.tp}, which does not divide it — "
                         "uneven shards replicate instead of splitting",
                     ))
+        # GL1207: the EFFECTIVE layout (declared specs merged over the
+        # SpecLayout rule table) against the traced param shapes — a rule
+        # the operator never wrote can still name an indivisible dim
+        # (e.g. a qkv head dim at an odd head count), and silently
+        # replicating a matrix the planner budgeted as sharded turns the
+        # feasible tp-span plan back into an HBM overflow at load time.
+        from seldon_core_tpu.placement import layouts
+
+        for pkey, axis, dim in layouts.check_divisibility(
+                param_dims, cfg.tp, declared=sig.tp_param_specs):
+            if pkey in flagged:
+                continue  # declared-spec violation already reported above
+            findings.append(make_finding(
+                PLACEMENT_TP_INDIVISIBLE, at,
+                f"{model_class}: the tp layout shards param {pkey!r} "
+                f"dim {axis} (= {dim}) over tp={cfg.tp}, which does not "
+                "divide it — the runtime would replicate this param, "
+                "breaking the tp-span HBM plan; pick a divisible tp or "
+                "declare a replicated spec for it",
+            ))
     return findings
 
 

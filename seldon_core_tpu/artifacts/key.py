@@ -15,6 +15,12 @@ than a wrong or crashing executable:
   executable AOT-lowered against one device topology must never load
   into another, so the placement plane's canonical mesh spec string
   (``PlacementConfig.spec()``, "" for single-device) is part of the key.
+- **sharding slice**: the mesh slice the program's in/out shardings
+  actually partition over ("" unsharded, "dp=2", "tp=2", "dp=2,tp=2").
+  One deployment holds BOTH an unsharded and a sharded executable per
+  bucket under the same mesh spec — without this field a dp program
+  and a tp program for the same segment would collide, and hydrating
+  one as the other rejects (best case) or answers with wrong layouts.
 - **jaxlib version**: serialized XLA executables are not stable across
   compiler releases; a rolled jaxlib invalidates the whole store.
 - **format version**: the store's own layout escape hatch.
@@ -36,8 +42,9 @@ __all__ = [
     "artifact_key",
 ]
 
-#: bump when the on-disk payload layout changes (pickle envelope fields)
-FORMAT_VERSION = 1
+#: bump when the on-disk payload layout or key material changes
+#: (v2: the sharding slice joined the key schema)
+FORMAT_VERSION = 2
 
 
 def jaxlib_version() -> str:
@@ -103,9 +110,12 @@ def segment_fingerprint(segment) -> str:
 
 def artifact_key(segment_fp: str, bucket_shape: Iterable[int], dtype: str,
                  mesh_spec: str = "", jaxlib: str | None = None,
-                 format_version: int = FORMAT_VERSION) -> str:
+                 format_version: int = FORMAT_VERSION,
+                 sharding: str = "") -> str:
     """The store key: segment hash × bucket × dtype × mesh spec ×
-    jaxlib version × format version, blake2b-hexed."""
+    sharding slice × jaxlib version × format version, blake2b-hexed.
+    ``sharding`` is "" for the unsharded executable and the armed mesh
+    slice (``FusedSegment.shard_slice``) for the sharded one."""
     h = hashlib.blake2b(digest_size=16)
     h.update(str(segment_fp).encode())
     h.update(b"|")
@@ -114,6 +124,8 @@ def artifact_key(segment_fp: str, bucket_shape: Iterable[int], dtype: str,
     h.update(str(dtype).encode())
     h.update(b"|")
     h.update(str(mesh_spec or "").encode())
+    h.update(b"|")
+    h.update(str(sharding or "").encode())
     h.update(b"|")
     h.update((jaxlib if jaxlib is not None else jaxlib_version()).encode())
     h.update(b"|")

@@ -160,19 +160,29 @@ class ArtifactPlane:
 
     def _hydrate_segment(self, seg) -> int:
         fp = self._fingerprint(seg)
+        shard_slice = str(getattr(seg, "shard_slice", "") or "")
         n = 0
         for sc in self.store.sidecars(fp):
             shape = tuple(int(d) for d in sc.get("bucketShape", ()))
             dtype = str(sc.get("dtype", ""))
             key = sc.get("key", "")
+            sharding = str(sc.get("sharding", "") or "")
             expect = artifact_key(fp, shape, dtype, self.mesh_spec,
-                                  self.jaxlib)
+                                  self.jaxlib, sharding=sharding)
             if key != expect:
                 # different mesh/jaxlib/format vintage: not ours to load
                 continue
+            # sharded executables hydrate only into a segment armed on
+            # the SAME mesh slice (enable_sharding runs before
+            # hydrate_plan — engine wiring order); publish was
+            # parity-gated, so the sidecar's verdict carries over
+            is_shard = bool(sharding)
+            if is_shard and (not shard_slice or sharding != shard_slice):
+                continue
             bucket = (shape, dtype)
+            target = seg._shard_compiled if is_shard else seg._compiled
             with seg._compile_lock:
-                if seg._compiled.get(bucket) is not None:
+                if target.get(bucket) is not None:
                     continue
             blob = self.store.get(fp, key)
             if blob is None:
@@ -188,18 +198,27 @@ class ArtifactPlane:
             cost["source"] = "aot-cache"
             cost["hydrate_ms"] = round(wall_ms, 3)
             with seg._compile_lock:
-                seg._compiled[bucket] = loaded
-                seg.hydrated.add(bucket)
-                seg.cost_by_bucket[bucket] = cost
+                if is_shard:
+                    seg._shard_compiled[bucket] = loaded
+                    seg.shard_hydrated.add(bucket)
+                    seg.shard_cost_by_bucket[bucket] = cost
+                else:
+                    seg._compiled[bucket] = loaded
+                    seg.hydrated.add(bucket)
+                    seg.cost_by_bucket[bucket] = cost
             n += 1
-            self.note_hydrated(seg, bucket, wall_ms, cost)
+            self.note_hydrated(
+                seg, bucket, wall_ms, cost,
+                label=seg.shard_label() if is_shard else None)
         return n
 
     def note_hydrated(self, seg, bucket: tuple, wall_ms: float,
-                      cost: dict) -> None:
+                      cost: dict, label: str | None = None) -> None:
         """Ledger + counters for one bucket served from the store —
         recorded as ``source=aot-cache``, never as a compile (the
-        warm-boot zero-compiles gate depends on the distinction)."""
+        warm-boot zero-compiles gate depends on the distinction).
+        ``label`` overrides the ledger row's segment label (sharded
+        buckets carry the mesh-slice tag)."""
         with self._lock:
             self.hydrated += 1
         watch = seg.compile_watch
@@ -207,7 +226,7 @@ class ArtifactPlane:
             try:
                 shape, dtype = bucket
                 watch.note_compile(
-                    seg.label,
+                    label or seg.label,
                     bucket="x".join(str(d) for d in shape) + f":{dtype}",
                     wall_ms=wall_ms,
                     flops=cost.get("flops", 0.0),
@@ -220,12 +239,12 @@ class ArtifactPlane:
         if self.metrics is not None:
             try:
                 self.metrics.counter_inc(
-                    _HYDRATIONS_COUNTER, {"segment": seg.label})
+                    _HYDRATIONS_COUNTER, {"segment": label or seg.label})
             except Exception:
                 pass
 
     # -- request-path hooks (FusedSegment._compile_bucket) ----------------
-    def load_bucket(self, seg, bucket: tuple, x):
+    def load_bucket(self, seg, bucket: tuple, x, sharding: str = ""):
         """Store lookup on a compiled-map miss (called under the
         segment's compile lock, before a live compile).  Returns
         ``(loaded, cost)`` or ``(None, None)`` on miss/corruption —
@@ -234,7 +253,7 @@ class ArtifactPlane:
             fp = self._fingerprint(seg)
             shape, dtype = bucket
             key = artifact_key(fp, shape, dtype, self.mesh_spec,
-                               self.jaxlib)
+                               self.jaxlib, sharding=sharding)
             blob = self.store.get(fp, key)
             if blob is None:
                 with self._lock:
@@ -252,11 +271,26 @@ class ArtifactPlane:
             cost = {"source": "aot-cache",
                     "hydrate_ms":
                         round((time.perf_counter() - t0) * 1000.0, 3)}
+            if sharding:
+                cost["meshSlice"] = sharding
+                cost["parity"] = "verified"  # publish-gated precondition
             return loaded, cost
         except Exception:
             logger.debug("artifact load failed for segment %s bucket %s",
                          seg.label, bucket, exc_info=True)
             return None, None
+
+    def load_shard_bucket(self, seg, bucket: tuple, x):
+        """Store lookup for the SHARDED executable of a bucket
+        (``FusedSegment._compile_shard_bucket``) — keyed by the
+        segment's armed mesh slice so a dp program can never hydrate
+        into a tp arming (or vice versa).  A hit skips both the live
+        compile and the runtime parity gate: only gate-passing
+        executables are ever published."""
+        sharding = str(getattr(seg, "shard_slice", "") or "")
+        if not sharding:
+            return None, None
+        return self.load_bucket(seg, bucket, x, sharding=sharding)
 
     def note_live_compile(self, seg, bucket: tuple) -> None:
         """A bucket compiled live in this process (the warm-coverage
@@ -264,45 +298,53 @@ class ArtifactPlane:
         with self._lock:
             self.live_compiles += 1
 
-    def publish_bucket(self, seg, bucket: tuple, compiled, x) -> bool:
+    def publish_bucket(self, seg, bucket: tuple, compiled, x,
+                       sharding: str = "") -> bool:
         """Serialize a freshly live-compiled executable into the store,
         byte-parity-gated: the artifact's deserialized copy must
         reproduce ``compiled``'s output bitwise on the live input, or
         nothing is stored.  Called OUTSIDE the segment's compile lock
-        (it runs executables); never raises."""
+        (it runs executables); never raises.  ``sharding`` (the armed
+        mesh slice) keys + tags sharded executables — the parity gate
+        then feeds both copies the device_put sharded params."""
         if not self.config.publish:
             return False
         try:
             fp = self._fingerprint(seg)
             shape, dtype = bucket
+            label = seg.shard_label() if sharding else seg.label
+            params = seg._shard_params if sharding else seg._params
             key = artifact_key(fp, shape, dtype, self.mesh_spec,
-                               self.jaxlib)
+                               self.jaxlib, sharding=sharding)
             blob = _serialize_executable(compiled)
             parity = "unverified"
             if self.config.parity:
                 loaded = _deserialize_executable(blob)
-                ref = compiled(seg._params, x)
-                got = loaded(seg._params, x)
+                ref = compiled(params, x)
+                got = loaded(params, x)
                 if not _bitwise_equal(ref, got):
                     with self._lock:
                         self.parity_failures += 1
                     if self.metrics is not None:
                         self.metrics.counter_inc(
-                            _PARITY_FAIL_COUNTER, {"segment": seg.label})
+                            _PARITY_FAIL_COUNTER, {"segment": label})
                     logger.warning(
                         "segment %s bucket %s: artifact parity gate "
-                        "FAILED — not storing", seg.label, bucket)
+                        "FAILED — not storing", label, bucket)
                     return False
                 parity = "verified"
-            cost = dict(seg.cost_by_bucket.get(bucket) or {})
+            src = seg.shard_cost_by_bucket if sharding \
+                else seg.cost_by_bucket
+            cost = dict(src.get(bucket) or {})
             cost.pop("source", None)
             self.store.put(fp, key, blob, {
                 "key": key,
-                "segment": seg.label,
+                "segment": label,
                 "segmentFingerprint": fp,
                 "bucketShape": list(shape),
                 "dtype": dtype,
                 "meshSpec": self.mesh_spec,
+                "sharding": sharding,
                 "jaxlibVersion": self.jaxlib,
                 "formatVersion": FORMAT_VERSION,
                 "parity": parity,
@@ -314,7 +356,7 @@ class ArtifactPlane:
                 self.published += 1
             if self.metrics is not None:
                 self.metrics.counter_inc(
-                    _PUBLISHES_COUNTER, {"segment": seg.label})
+                    _PUBLISHES_COUNTER, {"segment": label})
             self._export_store_gauges()
             return True
         except Exception:
@@ -323,6 +365,17 @@ class ArtifactPlane:
             logger.debug("artifact publish failed for segment %s bucket %s",
                          seg.label, bucket, exc_info=True)
             return False
+
+    def publish_shard_bucket(self, seg, bucket: tuple, compiled, x) -> bool:
+        """Publish the SHARDED executable of a bucket — only called
+        after the runtime bucket parity gate passed, so everything in
+        the store under a sharding key is double-gated (runtime bitwise
+        vs unsharded + serialize-roundtrip bitwise here)."""
+        sharding = str(getattr(seg, "shard_slice", "") or "")
+        if not sharding:
+            return False
+        return self.publish_bucket(seg, bucket, compiled, x,
+                                   sharding=sharding)
 
     def _quarantine(self, seg, fp: str, key: str, why: str) -> None:
         with self._lock:
@@ -434,6 +487,19 @@ class ArtifactPlane:
                     "fingerprint": self._fingerprint(seg),
                     "buckets": buckets,
                 }
+                shard_buckets = {}
+                for (shape, dtype), cost in getattr(
+                        seg, "shard_cost_by_bucket", {}).items():
+                    label = "x".join(str(d) for d in shape) + f":{dtype}"
+                    shard_buckets[label] = {
+                        "source": cost.get("source", "live"),
+                        **{k: cost[k] for k in
+                           ("compile_ms", "hydrate_ms", "parity",
+                            "meshSlice")
+                           if k in cost},
+                    }
+                if shard_buckets:
+                    entry["shardBuckets"] = shard_buckets
                 stored = self.store.sidecars(entry["fingerprint"])
                 entry["stored"] = len(stored)
                 segments.append(entry)

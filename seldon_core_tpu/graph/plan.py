@@ -316,13 +316,24 @@ class FusedSegment:
         self._names_cache: dict = {}
         # sharded executor (placement plane, enable_sharding): a second
         # jitted callable whose in/out shardings split the batch dim over
-        # the mesh's dp axis — one dispatch spanning every dp device
+        # the mesh's dp axis — one dispatch spanning every dp device.
+        # With a tp axis and per-param layouts (placement/layouts.py)
+        # the weights themselves shard: _shard_params holds the
+        # device_put copies living on NamedShardings, _params stays the
+        # unsharded reference the fallback path and parity gates use
         self._shard_fn = None
         self._shard_mesh = None
+        self._shard_params = None
         self.shard_rows = 1          # batch must be a multiple of this
+        self.shard_tp = 1            # tp group size weights shard over
+        self.shard_slice = ""        # mesh slice ("dp=2,tp=2"); "" unarmed
+        self.shard_slug = ""         # ledger/artifact tag ("dp2tp2")
+        self.tp_sharded_param_bytes = 0
+        self.tp_layouts: dict = {}   # member → {param path → axes}
         self.n_sharded_calls = 0
         self._shard_compiled: dict = {}
         self.shard_cost_by_bucket: dict = {}
+        self.shard_hydrated: set = set()
         self._on_sharded_dispatch = None
         self.shard_parity = None     # "verified" | "unprobed" | "failed"
         # prediction-cache eligibility: every member is a pure tensor fn by
@@ -411,38 +422,74 @@ class FusedSegment:
         backend (``shard_parity`` records the outcome).
 
         ``tp_param_specs`` optionally maps member name → {param key →
-        axis tuple} (from the signature registry's ``tp_param_specs``)
-        to shard large weights over the ``tp`` axis instead of
-        replicating them.  Returns False when jax's sharding API is
-        unavailable or the mesh has no usable dp axis.
+        axis tuple} (the signature registry's declared layouts); the
+        ``SpecLayout`` rule table (``placement/layouts.py``) covers
+        registered param names (qkv/attn-out, ffn up/down, embeddings)
+        for the rest.  Covered weights are ``jax.device_put`` onto
+        their ``NamedSharding``s HERE, at plan build — each tp device
+        holds 1/tp of them from the first dispatch on, which is the
+        whole point: a segment whose weights exceed one device's HBM
+        becomes placeable.  A pure-tp mesh (dp=1) arms on weights
+        alone; rows then stay replicated.  Returns False when jax's
+        sharding API is unavailable or no axis has anything to split.
         """
         try:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
         except Exception:
             return False
+        from seldon_core_tpu.placement import layouts as tp_layouts_mod
+
         dp = int(dict(mesh.shape).get("dp", 1))
-        if dp < 2:
-            return False
         tp = int(dict(mesh.shape).get("tp", 1))
+        # effective per-member tp layouts: declared specs beat the rule
+        # table; leaves with indivisible dims drop out (replicate)
+        member_layouts: dict = {}
+        tp_bytes = 0
+        if tp > 1:
+            for st in self.members:
+                layout = tp_layouts_mod.resolve_layout(
+                    st.params, declared=(tp_param_specs or {}).get(st.name),
+                    tp=tp)
+                if layout:
+                    member_layouts[st.name] = layout
+                    tp_bytes += tp_layouts_mod.tp_param_bytes(
+                        st.params, layout)
+        if dp < 2 and not member_layouts:
+            return False
         repl = NamedSharding(mesh, PartitionSpec())
         params_shardings: dict = {}
         for st in self.members:
-            spec_map = (tp_param_specs or {}).get(st.name) if tp > 1 else None
-            if spec_map and isinstance(st.params, dict):
-                params_shardings[st.name] = {
-                    k: (NamedSharding(mesh, PartitionSpec(*spec_map[k]))
-                        if k in spec_map else repl)
-                    for k in st.params
-                }
+            if st.name in member_layouts:
+                params_shardings[st.name] = tp_layouts_mod.build_shardings(
+                    mesh, st.params, member_layouts[st.name])
             else:
                 params_shardings[st.name] = repl
-        rows = NamedSharding(mesh, PartitionSpec("dp"))
+        rows = NamedSharding(
+            mesh, PartitionSpec("dp") if dp > 1 else PartitionSpec())
         self._shard_fn = jax.jit(self._traced,
                                  in_shardings=(params_shardings, rows),
                                  out_shardings=rows)
         self._shard_mesh = mesh
+        # tp members live on their shardings NOW; everything else keeps
+        # its host/replicated copy (the in_shardings spec places it)
+        if member_layouts:
+            self._shard_params = {
+                name: (jax.device_put(p, params_shardings[name])
+                       if name in member_layouts else p)
+                for name, p in self._params.items()
+            }
+        else:
+            self._shard_params = self._params
         self.shard_rows = dp
+        self.shard_tp = tp if member_layouts else 1
+        axes = [("dp", dp)] if dp > 1 else []
+        if member_layouts:
+            axes.append(("tp", tp))
+        self.shard_slice = ",".join(f"{a}={n}" for a, n in axes)
+        self.shard_slug = "".join(f"{a}{n}" for a, n in axes)
+        self.tp_sharded_param_bytes = tp_bytes
+        self.tp_layouts = member_layouts
         self._on_sharded_dispatch = on_dispatch
         self._shard_compiled = {}
         if probe is None:
@@ -453,7 +500,13 @@ class FusedSegment:
             return True
         self._shard_fn = None
         self._shard_mesh = None
+        self._shard_params = None
         self.shard_rows = 1
+        self.shard_tp = 1
+        self.shard_slice = ""
+        self.shard_slug = ""
+        self.tp_sharded_param_bytes = 0
+        self.tp_layouts = {}
         self._on_sharded_dispatch = None
         self.shard_parity = "failed"
         return False
@@ -464,7 +517,7 @@ class FusedSegment:
 
         try:
             ref = np.asarray(self._fn(self._params, probe))
-            got = np.asarray(self._shard_fn(self._params, probe))
+            got = np.asarray(self._shard_fn(self._shard_params, probe))
         except Exception:
             logger.debug("segment %s: sharding parity probe errored",
                          self.label, exc_info=True)
@@ -475,8 +528,9 @@ class FusedSegment:
     def _compile_shard_bucket(self, key: tuple, x):
         """First sharded dispatch of a shape bucket: AOT-compile the
         sharded executable (mirror of ``_compile_bucket``; the ledger and
-        CompileWatch rows carry a ``@dp`` label so attribution can tell
-        the two programs apart), then run the **bucket parity gate** —
+        CompileWatch rows carry the mesh-slice tag (``@dp4``/``@tp2``/
+        ``@dp2tp2``) so attribution can tell the programs apart), then
+        run the **bucket parity gate** —
         the live input goes through BOTH executables and the outputs must
         agree bitwise.  Backend tiling is shape-dependent, so the
         arm-time probe cannot vouch for every batch size; this gate can:
@@ -484,16 +538,35 @@ class FusedSegment:
         permanently routed to the unsharded executable (``None`` in the
         bucket map), and a bucket that passed serves sharded knowing its
         program is bitwise-equivalent.  Costs one extra dispatch per
-        bucket, once."""
+        bucket, once.
+
+        With an artifact plane attached the store is consulted first —
+        a stored sharded executable (keyed by the mesh slice, so tp and
+        dp programs for the same segment never collide) was
+        parity-gated at publish and hydrates in milliseconds — and a
+        live compile that passed the gate is published back."""
+        art = self.artifacts
         with self._compile_lock:
             hit = self._shard_compiled.get(key, _UNCOMPILED)
             if hit is not _UNCOMPILED:
                 return hit
+            if art is not None:
+                t0 = time.perf_counter()
+                loaded, acost = art.load_shard_bucket(self, key, x)
+                if loaded is not None:
+                    wall_ms = (time.perf_counter() - t0) * 1000.0
+                    self._shard_compiled[key] = loaded
+                    self.shard_hydrated.add(key)
+                    self.shard_cost_by_bucket[key] = acost
+                    art.note_hydrated(self, key, wall_ms, acost,
+                                      label=self.shard_label())
+                    return loaded
             t0 = time.perf_counter()
             compiled = None
             cost: dict = {}
             try:
-                compiled = self._shard_fn.lower(self._params, x).compile()
+                compiled = self._shard_fn.lower(
+                    self._shard_params, x).compile()
                 cost = _cost_summary(compiled)
             except Exception:
                 logger.debug("segment %s: sharded AOT compile "
@@ -510,6 +583,8 @@ class FusedSegment:
             wall_ms = (time.perf_counter() - t0) * 1000.0
             cost["compile_ms"] = round(wall_ms, 3)
             cost["parity"] = "verified" if ok else "failed"
+            if self.shard_slice:
+                cost["meshSlice"] = self.shard_slice
             self._shard_compiled[key] = fn if ok else None
             self.shard_cost_by_bucket[key] = cost
         watch = self.compile_watch
@@ -517,7 +592,7 @@ class FusedSegment:
             try:
                 shape, dtype = key
                 watch.note_compile(
-                    f"{self.label}@dp{self.shard_rows}",
+                    self.shard_label(),
                     bucket="x".join(str(d) for d in shape) + f":{dtype}",
                     wall_ms=wall_ms,
                     flops=cost.get("flops", 0.0),
@@ -526,12 +601,23 @@ class FusedSegment:
                 )
             except Exception:
                 pass
+        if art is not None and ok and compiled is not None:
+            # publish OUTSIDE the compile lock (the parity gate inside
+            # publish runs executables); only buckets that passed the
+            # runtime gate are ever stored
+            art.publish_shard_bucket(self, key, compiled, x)
         return self._shard_compiled[key]
+
+    def shard_label(self) -> str:
+        """Ledger/CompileWatch label of the sharded program — the mesh
+        slice tag keeps its rows distinct from the unsharded ones
+        (``clf@dp4``, ``clf@tp2``, ``clf@dp2tp2``)."""
+        return f"{self.label}@{self.shard_slug or f'dp{self.shard_rows}'}"
 
     def _bucket_parity(self, shard_fn, x) -> bool:
         import numpy as np
 
-        got = np.asarray(shard_fn(self._params, x))
+        got = np.asarray(shard_fn(self._shard_params, x))
         ref = np.asarray(self._fn(self._params, x))
         return ref.dtype == got.dtype and ref.shape == got.shape \
             and np.array_equal(ref, got, equal_nan=True)
@@ -546,7 +632,7 @@ class FusedSegment:
         if compiled is None:
             return None
         try:
-            y = compiled(self._params, x)
+            y = compiled(self._shard_params, x)
         except Exception:
             # sharding/layout drift at call time: retire the bucket to
             # the unsharded path for good — parity over performance
@@ -744,6 +830,15 @@ class FusedSegment:
         }
         if self._shard_fn is not None:
             out["shardRows"] = self.shard_rows
+            if self.shard_tp > 1:
+                out["tpSpan"] = {
+                    "meshSlice": self.shard_slice,
+                    "shardedParamBytes": int(self.tp_sharded_param_bytes),
+                    "tpBytesPerDevice":
+                        int(self.tp_sharded_param_bytes) // self.shard_tp,
+                    "params": {m: sorted(lay)
+                               for m, lay in self.tp_layouts.items()},
+                }
         if self.shard_parity is not None:
             out["shardParity"] = self.shard_parity
         return out

@@ -90,6 +90,26 @@ SIGNATURES: dict[str, ModelSignature] = {
         output_shape=(ANY, 10), output_dtype="float32",
         hbm_bytes=_dense_bytes((784, 512, 256, 10)),
         pure_fn=True,
+        # column-parallel hidden layers (512 and 256 divide every power-
+        # of-two tp); the final (256, 10) layer replicates — column-only
+        # splits keep CPU byte-parity exact (no cross-device psum)
+        tp_param_specs={
+            "0/w": (None, "tp"), "0/b": ("tp",),
+            "1/w": (None, "tp"), "1/b": ("tp",),
+        },
+    ),
+    "seldon_core_tpu.models.mlp:MNISTMLPClassifier": ModelSignature(
+        input_shape=(ANY, 784), input_dtype="float32",
+        output_shape=(ANY,), output_dtype="int32",
+        hbm_bytes=_dense_bytes((784, 512, 256, 10)),
+        pure_fn=True,
+        # same weights, discrete output: argmax survives the ULP noise
+        # of tp reductions, so the byte-parity gate holds where the
+        # softmax variant's float outputs fail it
+        tp_param_specs={
+            "0/w": (None, "tp"), "0/b": ("tp",),
+            "1/w": (None, "tp"), "1/b": ("tp",),
+        },
     ),
     "seldon_core_tpu.models.resnet:ResNet50Model": ModelSignature(
         input_shape=(ANY, 224, 224, 3), input_dtype="float32",

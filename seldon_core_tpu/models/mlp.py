@@ -33,6 +33,17 @@ def mlp_apply(params, x):
     return jax.nn.softmax(x @ last["w"] + last["b"], axis=-1)
 
 
+def mlp_classify(params, x):
+    """Class ids instead of probabilities.  The discrete output is what
+    makes this variant the tensor-parallel reference: sharding weights
+    over "tp" perturbs the logits by an ULP (cross-device reductions
+    reorder float adds), which fails the byte-parity gate on float
+    outputs — but an argmax over well-separated logits is stable under
+    that noise, so the tp-sharded program stays bitwise-identical to the
+    unsharded one (docs/sharding.md, the LLM token-parity argument)."""
+    return jnp.argmax(mlp_apply(params, x), axis=-1).astype(jnp.int32)
+
+
 class MNISTMLP:
     """Graph MODEL component.  Duck-type contract per
     ``wrappers/python/model_microservice.py:32-43``."""
@@ -68,3 +79,15 @@ class MNISTMLP:
 
         host = jax.tree.map(np.asarray, self.params)
         return save_checkpoint(path, host, {"family": "mlp"})
+
+
+class MNISTMLPClassifier(MNISTMLP):
+    """The same MLP serving class ids (``mlp_classify``) — the model the
+    placement plane's tp spans are exercised with, because its discrete
+    output survives tensor-parallel reduction reordering bitwise."""
+
+    def predict_fn(self, params, X):
+        return mlp_classify(params, jnp.asarray(X, jnp.float32))
+
+    def tags(self):
+        return {"model": "mnist-mlp-classifier"}

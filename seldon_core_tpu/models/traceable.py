@@ -50,6 +50,14 @@ def _mlp_target() -> TraceTarget:
     return TraceTarget(fn=mlp_apply, params=params)
 
 
+def _mlp_classifier_target() -> TraceTarget:
+    from seldon_core_tpu.models.mlp import init_mlp_params, mlp_classify
+
+    params = jax.eval_shape(
+        lambda: init_mlp_params(jax.random.PRNGKey(0), (784, 512, 256, 10)))
+    return TraceTarget(fn=mlp_classify, params=params)
+
+
 def _resnet_module():
     from seldon_core_tpu.models.resnet import ResNet
 
@@ -86,6 +94,9 @@ def install() -> None:
         "seldon_core_tpu.models.iris:IrisClassifier", _iris_target)
     register_trace_provider(
         "seldon_core_tpu.models.mlp:MNISTMLP", _mlp_target)
+    register_trace_provider(
+        "seldon_core_tpu.models.mlp:MNISTMLPClassifier",
+        _mlp_classifier_target)
     register_trace_provider(
         "seldon_core_tpu.models.resnet:ResNet50Model", _resnet_target)
     register_trace_provider(

@@ -30,6 +30,8 @@ _DISPATCH_COUNTER = "seldon_placement_dispatches_total"
 _SHARDED_COUNTER = "seldon_placement_sharded_dispatches_total"
 _SEGMENTS_GAUGE = "seldon_placement_segments"
 _DEVICE_HBM_GAUGE = "seldon_placement_device_hbm_bytes"
+_TP_SPANS_GAUGE = "seldon_placement_tp_spans"
+_TP_BYTES_GAUGE = "seldon_placement_tp_bytes_per_device"
 
 
 def _member_units(root_node, names: set) -> dict:
@@ -93,7 +95,25 @@ def _tp_specs(seg) -> dict:
     return out
 
 
-def segment_facts(seg) -> SegmentFacts:
+def _tp_shardable_bytes(seg, tp: int, tp_specs: dict) -> int:
+    """Bytes of the segment's live params the effective tp layouts
+    (declared ``tp_param_specs`` first, the ``SpecLayout`` rule table
+    second) actually cover at this ``tp`` — the planner's numerator for
+    the per-device HBM split."""
+    if tp < 2:
+        return 0
+    from seldon_core_tpu.placement import layouts
+
+    total = 0
+    for st in seg.members:
+        layout = layouts.resolve_layout(
+            st.params, declared=tp_specs.get(st.name), tp=tp)
+        if layout:
+            total += layouts.tp_param_bytes(st.params, layout)
+    return total
+
+
+def segment_facts(seg, tp: int = 1) -> SegmentFacts:
     """Planner inputs for one live :class:`FusedSegment`.
 
     Static HBM comes from the signature registry; the measured peak
@@ -123,6 +143,7 @@ def segment_facts(seg) -> SegmentFacts:
     return SegmentFacts(
         name=seg.name, hbm_bytes=hbm, measured_hbm_bytes=measured,
         shardable=shardable, members=tuple(sorted(names)),
+        tp_shardable_bytes=_tp_shardable_bytes(seg, tp, _tp_specs(seg)),
     )
 
 
@@ -154,8 +175,13 @@ class PlacementPlane:
             self._segments = list(graph_plan.segments)
             self.sharded_segments = []
             for seg in self._segments:
-                facts = segment_facts(seg)
-                if facts.shardable and self.config.dp > 1 and seg.enable_sharding(
+                facts = segment_facts(seg, tp=self.config.tp)
+                # two ways into the sharded executor: a dp axis with
+                # row-shardable members, and/or a tp axis with per-param
+                # layouts — a pure-tp mesh (dp=1) arms on weights alone
+                dp_armable = facts.shardable and self.config.dp > 1
+                tp_armable = self.config.tp > 1 and facts.tp_shardable_bytes
+                if (dp_armable or tp_armable) and seg.enable_sharding(
                         self.mesh, on_dispatch=self._note_sharded,
                         tp_param_specs=_tp_specs(seg),
                         probe=_parity_probe(seg, self.config.dp)):
@@ -197,10 +223,11 @@ class PlacementPlane:
         estimates sharpen as compile ledgers fill in."""
         with self._plan_lock:
             segs = list(self._segments)
-        facts = [segment_facts(s) for s in segs]
+        facts = [segment_facts(s, tp=self.config.tp) for s in segs]
         overrides = self.config.override_map()
         plan = plan_placement(
             facts, n_devices=self.config.n_devices, dp=self.config.dp,
+            tp=self.config.tp,
             mesh_spec=self.config.spec(), overrides=overrides,
             capacity_bytes=self.capacity_bytes,
         )
@@ -211,12 +238,42 @@ class PlacementPlane:
                     self.metrics.gauge_set(
                         _DEVICE_HBM_GAUGE, float(b),
                         {"deployment": dep, "device": str(d)})
+                spans = [a for a in plan.assignments
+                         if a.source == "tp-span"]
+                self.metrics.gauge_set(
+                    _TP_SPANS_GAUGE, float(len(spans)),
+                    {"deployment": dep})
+                for a in spans:
+                    self.metrics.gauge_set(
+                        _TP_BYTES_GAUGE, float(a.tp_bytes_per_device),
+                        {"deployment": dep, "segment": a.segment})
             except Exception:
                 pass
         return plan
 
     def mesh_shape(self) -> str:
         return self.config.spec()
+
+    def tp_spans(self) -> list:
+        """Armed tp spans, from the live segments: which params shard,
+        over which mesh slice, and the per-device HBM share."""
+        with self._plan_lock:
+            segs = list(self._segments)
+        spans = []
+        for seg in segs:
+            tp = int(getattr(seg, "shard_tp", 1))
+            if tp < 2:
+                continue
+            sharded = int(getattr(seg, "tp_sharded_param_bytes", 0))
+            layouts_ = getattr(seg, "tp_layouts", {}) or {}
+            spans.append({
+                "segment": seg.name,
+                "meshSlice": getattr(seg, "shard_slice", ""),
+                "shardedParamBytes": sharded,
+                "tpBytesPerDevice": sharded // tp,
+                "params": {m: sorted(lay) for m, lay in layouts_.items()},
+            })
+        return spans
 
     def describe(self) -> dict:
         """Full admin-surface payload (``/admin/placement``)."""
@@ -228,6 +285,9 @@ class PlacementPlane:
             "shardedSegments": list(self.sharded_segments),
             "shardedDispatches": self.n_sharded_dispatches,
         })
+        spans = self.tp_spans()
+        if spans:
+            out["tpSpans"] = spans
         if self.capacity_bytes:
             out["deviceCapacityBytes"] = int(self.capacity_bytes)
         return out
@@ -236,7 +296,7 @@ class PlacementPlane:
     def snapshot(self) -> dict:
         """Compact posture for the CR's ``status.placement`` block."""
         plan = self.placement()
-        return {
+        out = {
             "mesh": self.config.spec(),
             "devices": self.config.n_devices,
             "segments": {
@@ -244,3 +304,9 @@ class PlacementPlane:
             },
             "shardedSegments": list(self.sharded_segments),
         }
+        spans = self.tp_spans()
+        if spans:
+            out["tpSpans"] = {
+                s["segment"]: s["meshSlice"] for s in spans
+            }
+        return out

@@ -9,7 +9,13 @@ three inputs, in priority order:
 2. Shardability — a segment whose members all declare shardable batch
    dims executes as ONE sharded dispatch spanning the whole ``dp`` axis,
    so its "placement" is the submesh, not a single device (its weights
-   are replicated per dp group).
+   are replicated per dp group).  With a ``tp`` axis and per-param
+   layouts (``placement/layouts.py``) the span becomes a **tp span**:
+   the covered weight bytes divide by ``tp`` instead of replicating, so
+   a segment whose peak HBM exceeds one device's budget can still be
+   planned — the per-device charge is ``tp_bytes/tp + the replicated
+   remainder``, and a plan that would hard-stop with GL1204 at tp=1
+   fits at tp=2.
 3. Greedy bin-packing for the rest: segments sorted by descending HBM
    estimate, each onto the least-loaded device — the classic LPT
    heuristic, within 4/3 of optimal makespan, which is more than enough
@@ -39,10 +45,25 @@ class SegmentFacts:
     measured_hbm_bytes: int = 0
     shardable: bool = False
     members: tuple = ()
+    #: bytes covered by per-param tp layouts (0 = nothing tp-shards);
+    #: these divide by ``tp`` in the per-device charge, the rest
+    #: replicates
+    tp_shardable_bytes: int = 0
 
     @property
     def estimate(self) -> int:
         return self.measured_hbm_bytes or self.hbm_bytes
+
+    def per_device_bytes(self, tp: int) -> int:
+        """HBM one device holds when this segment spans a ``tp`` group:
+        the layout-covered fraction divides, the remainder replicates.
+        The covered *fraction* comes from the static split so a larger
+        measured peak scales proportionally."""
+        est = self.estimate
+        if tp < 2 or not self.tp_shardable_bytes or not est:
+            return est
+        frac = min(1.0, self.tp_shardable_bytes / max(1, self.hbm_bytes))
+        return int(est * frac / tp + est * (1.0 - frac))
 
 
 @dataclass(frozen=True)
@@ -51,7 +72,13 @@ class Assignment:
     #: mesh device ordinals this segment dispatches to
     devices: tuple
     hbm_bytes: int
-    source: str  # "override" | "sharded" | "bin-pack"
+    source: str  # "override" | "sharded" | "tp-span" | "bin-pack"
+    #: tp-span only: HBM each device in the span holds (sharded share
+    #: of the weights + the replicated remainder)
+    tp_bytes_per_device: int = 0
+    #: tp-span only: the mesh slice the span partitions over ("tp=2",
+    #: "dp=2,tp=2")
+    mesh_slice: str = ""
 
 
 @dataclass
@@ -74,6 +101,9 @@ class PlacementPlan:
                     "devices": list(a.devices),
                     "hbmBytes": int(a.hbm_bytes),
                     "source": a.source,
+                    **({"meshSlice": a.mesh_slice,
+                        "tpBytesPerDevice": int(a.tp_bytes_per_device)}
+                       if a.source == "tp-span" else {}),
                 }
                 for a in self.assignments
             ],
@@ -90,6 +120,7 @@ def plan_placement(
     segments: Sequence[SegmentFacts],
     n_devices: int,
     dp: int = 1,
+    tp: int = 1,
     mesh_spec: str = "dp=1",
     overrides: Optional[dict] = None,
     capacity_bytes: Optional[int] = None,
@@ -98,19 +129,25 @@ def plan_placement(
 
     ``capacity_bytes`` (per device) is advisory here — feasibility is an
     admission-time ERROR (GL1204); at runtime the plan is still produced
-    so ``/admin/placement`` can show the operator the overflow."""
+    so ``/admin/placement`` can show the operator the overflow.  With
+    ``tp > 1`` a segment carrying ``tp_shardable_bytes`` is planned as a
+    **tp span**: it dispatches across every mesh device, each charged
+    the per-device share (layout-covered bytes ÷ tp + the replicated
+    remainder) — the path that turns "peak HBM exceeds one device"
+    (GL1204 at tp=1) into a feasible plan."""
     overrides = dict(overrides or {})
     plan = PlacementPlan(mesh_spec=mesh_spec, n_devices=n_devices)
     load: dict[int, int] = {d: 0 for d in range(max(1, n_devices))}
 
     pinned: list[tuple[SegmentFacts, int]] = []
-    sharded: list[SegmentFacts] = []
+    spanned: list[SegmentFacts] = []
     packed: list[SegmentFacts] = []
     for seg in segments:
         if seg.name in overrides:
             pinned.append((seg, overrides[seg.name]))
-        elif seg.shardable and dp > 1:
-            sharded.append(seg)
+        elif (seg.shardable and dp > 1) or (
+                tp > 1 and seg.tp_shardable_bytes):
+            spanned.append(seg)
         else:
             packed.append(seg)
 
@@ -121,12 +158,20 @@ def plan_placement(
             seg.name, (ordinal,), seg.estimate, "override"))
 
     all_devices = tuple(range(max(1, n_devices)))
-    for seg in sharded:
-        # replicated weights: every device in the dp span holds a copy
+    slice_axes = [a for a in (("dp", dp), ("tp", tp)) if a[1] > 1]
+    mesh_slice = ",".join(f"{a}={n}" for a, n in slice_axes) or "dp=1"
+    for seg in spanned:
+        tp_span = tp > 1 and bool(seg.tp_shardable_bytes)
+        # tp span: each device holds the sharded share; dp-only span:
+        # replicated weights, every device holds a full copy
+        per_dev = seg.per_device_bytes(tp) if tp_span else seg.estimate
         for d in all_devices:
-            load[d] += seg.estimate
+            load[d] += per_dev
         plan.assignments.append(Assignment(
-            seg.name, all_devices, seg.estimate, "sharded"))
+            seg.name, all_devices, seg.estimate,
+            "tp-span" if tp_span else "sharded",
+            tp_bytes_per_device=per_dev if tp_span else 0,
+            mesh_slice=mesh_slice if tp_span else ""))
 
     # LPT: largest first, each onto the currently least-loaded device
     for seg in sorted(packed, key=lambda s: -s.estimate):

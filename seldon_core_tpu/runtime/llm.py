@@ -442,6 +442,40 @@ class LLMEngine:
         host = jax.tree.map(np.asarray, self.params)
         return save_transformer(path, host, self.cfg)
 
+    def tp_span(self):
+        """This engine's tensor-parallel posture, in the placement
+        plane's tp-span vocabulary (``/admin/placement`` ``tpSpans``):
+        which mesh slice the weights partition over, how many bytes
+        actually shard on "tp", and the per-device HBM share (sharded
+        bytes ÷ tp + the replicated remainder).  None off-mesh or when
+        the mesh has no tp axis — there is no span to report."""
+        if self.mesh is None:
+            return None
+        tp = int(self.mesh.shape.get("tp", 1))
+        if tp < 2:
+            return None
+        total = 0
+        sharded = 0
+        for leaf in jax.tree.leaves(self.params):
+            nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+            total += nbytes
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            if spec is None:
+                continue
+            axes = []
+            for a in spec:
+                axes.extend(a if isinstance(a, tuple) else (a,))
+            if "tp" in axes:
+                sharded += nbytes
+        return {
+            "meshSlice": ",".join(
+                f"{a}={int(n)}" for a, n in self.mesh.shape.items()
+                if int(n) > 1),
+            "paramBytes": total,
+            "shardedParamBytes": sharded,
+            "tpBytesPerDevice": sharded // tp + (total - sharded),
+        }
+
     def _replicated(self, *arrs):
         """Constrain host-fetched tick outputs to FULLY REPLICATED on the
         mesh.  Without the constraint XLA may shard these tiny arrays over

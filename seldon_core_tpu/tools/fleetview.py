@@ -10,6 +10,11 @@ With ``--decisions`` pointing at an ``/admin/fleet/decisions`` dump, the
 audit ring is appended as a chronological ledger, so "why is the fleet
 shaped like this" and "who is dragging it" answer from one screen.
 
+With ``--placement`` pointing at an ``/admin/placement`` dump, the
+device-placement table is appended: one row per fused segment (pinned /
+bin-packed / dp-sharded / tp-span) and, for tensor-parallel spans, the
+mesh slice, per-device HBM share and the params that shard over ``tp``.
+
 Usage::
 
     curl -s gw:8080/admin/fleet/health | \\
@@ -111,6 +116,69 @@ def render_fleet(payload: dict, width: int = 100) -> str:
     return "\n".join(lines)
 
 
+def _mib(n) -> str:
+    try:
+        return f"{float(n) / (1 << 20):.2f} MiB"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def render_placement(payload: dict) -> str:
+    """An ``/admin/placement`` dump as a device-placement table: one row
+    per segment (pinned / bin-packed / dp-sharded / tp-span) and, for tp
+    spans, the mesh slice, per-device HBM share and which params shard —
+    the "does the big segment actually fit now" screen."""
+    segments = payload.get("segments")
+    if not isinstance(segments, list) or not segments:
+        return "no segments in payload (is this /admin/placement?)"
+    lines = [
+        f"placement {payload.get('deployment') or '?'}: "
+        f"mesh {payload.get('mesh', '?')!r} over "
+        f"{payload.get('devices', '?')} device(s), "
+        f"{payload.get('shardedDispatches', 0)} sharded dispatch(es)",
+        f"  {'segment':<16s} {'source':<9s} {'devices':<12s} "
+        f"{'HBM':>12s}  slice",
+    ]
+    for row in segments:
+        if not isinstance(row, dict):
+            continue
+        devs = row.get("devices") or []
+        dev_s = ",".join(str(d) for d in devs)
+        if len(dev_s) > 12:
+            dev_s = f"{devs[0]}..{devs[-1]} ({len(devs)})"
+        slice_s = ""
+        if row.get("source") == "tp-span":
+            slice_s = (f"{row.get('meshSlice', '?')} -> "
+                       f"{_mib(row.get('tpBytesPerDevice'))}/device")
+        lines.append(
+            f"  {str(row.get('segment', '?')):<16s} "
+            f"{str(row.get('source', '?')):<9s} {dev_s:<12s} "
+            f"{_mib(row.get('hbmBytes')):>12s}  {slice_s}")
+    over = payload.get("overCapacity") or []
+    if over:
+        cap = payload.get("deviceCapacityBytes")
+        lines.append(
+            f"  OVER CAPACITY: device(s) "
+            f"{', '.join(str(d) for d in over)}"
+            + (f" (budget {_mib(cap)}/device)" if cap else ""))
+    for span in payload.get("tpSpans") or []:
+        if not isinstance(span, dict):
+            continue
+        lines.append(
+            f"  tp span {span.get('segment', '?')}: "
+            f"slice {span.get('meshSlice', '?')}, "
+            f"{_mib(span.get('shardedParamBytes'))} sharded -> "
+            f"{_mib(span.get('tpBytesPerDevice'))}/device")
+        params = span.get("params")
+        if isinstance(params, dict):
+            for member in sorted(params):
+                keys = params[member]
+                lines.append(
+                    f"    {member}: "
+                    f"{', '.join(keys) if keys else '(none)'}")
+    return "\n".join(lines)
+
+
 def render_decisions(doc: dict, last: int = 15) -> str:
     """The audit ring as a chronological ledger (oldest first)."""
     decisions = doc.get("decisions") if isinstance(doc, dict) else None
@@ -144,6 +212,9 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--decisions", default="",
                     help="/admin/fleet/decisions JSON dump appended as an "
                          "audit ledger")
+    ap.add_argument("--placement", default="",
+                    help="/admin/placement JSON dump appended as a "
+                         "device-placement table (dp rows + tp spans)")
     ap.add_argument("--last", type=int, default=15,
                     help="max decision rows (0 = all)")
     ap.add_argument("--width", type=int, default=100)
@@ -154,14 +225,19 @@ def main(argv: Optional[list] = None) -> int:
     else:
         with open(args.path) as f:
             payload = load_fleet_health(f)
-    if not payload:
+    if not payload and not args.placement:
         print("no fleet health payload", file=sys.stderr)
         return 1
-    print(render_fleet(payload, width=args.width))
+    if payload:
+        print(render_fleet(payload, width=args.width))
     if args.decisions:
         with open(args.decisions) as f:
             doc = json.load(f)
         print(render_decisions(doc, last=args.last))
+    if args.placement:
+        with open(args.placement) as f:
+            doc = json.load(f)
+        print(render_placement(doc))
     return 0
 
 

@@ -441,8 +441,16 @@ async def run_open_loop(
             finally:
                 inflight -= 1
             t1 = time.perf_counter()
-            if t0 >= t_start:
+            # throughput counts completions OBSERVED in the measured
+            # window (t1), not arrivals scheduled in it (t0): a long
+            # stream admitted during warmup that finishes mid-window is
+            # real served work, and gating on t0 reports 0 req/s for
+            # runs whose every arrival predates t_start.  Latency stays
+            # t0-gated — a warmup arrival's duration is not a sample of
+            # the offered-rate service time.
+            if t1 >= t_start:
                 count += 1
+            if t0 >= t_start:
                 lat.append((t1 - t0) * 1000.0)
 
         loop = asyncio.get_running_loop()

@@ -520,6 +520,21 @@ CATALOG: tuple[MetricInfo, ...] = (
         ("deployment", "device"),
     ),
     MetricInfo(
+        "seldon_placement_tp_spans", "gauge",
+        "Fused segments planned as tensor-parallel spans: their "
+        "layout-covered weights shard over the mesh's tp axis instead "
+        "of replicating (the /admin/placement tpSpans list)",
+        ("deployment",),
+    ),
+    MetricInfo(
+        "seldon_placement_tp_bytes_per_device", "gauge",
+        "Per-device HBM share of one tp-span segment: layout-covered "
+        "weight bytes divided by tp plus the replicated remainder — "
+        "the number that turns an HBM-infeasible segment (GL1204 at "
+        "tp=1) into a feasible plan",
+        ("deployment", "segment"),
+    ),
+    MetricInfo(
         "seldon_runtime_placement_devices", "gauge",
         "Mesh size seen by the placement plane at sample time "
         "(introspection sampler placement probe)",
@@ -1066,6 +1081,15 @@ def grafana_dashboard() -> dict:
                ["max(seldon_fleet_obs_verdict) by (deployment)",
                 "max(seldon_fleet_obs_unreachable) by (deployment)"],
                y=80, x=12),
+        _panel(23, "Placement: tp spans + sharded dispatch rate",
+               ["sum(seldon_placement_tp_spans) by (deployment)",
+                "sum(rate(seldon_placement_sharded_dispatches_total[5m])) "
+                "by (deployment, segment)"], y=88, x=0),
+        _panel(24, "Placement: per-device HBM (tp-span share)",
+               ["max(seldon_placement_device_hbm_bytes) "
+                "by (deployment, device)",
+                "max(seldon_placement_tp_bytes_per_device) "
+                "by (deployment, segment)"], y=88, x=12, unit="bytes"),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",

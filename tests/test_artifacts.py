@@ -94,7 +94,8 @@ def test_artifact_key_invalidation_matrix():
         kw = {**base, **over}
         return artifact_key(kw["segment_fp"], kw["bucket_shape"],
                             kw["dtype"], kw["mesh_spec"], kw["jaxlib"],
-                            format_version=kw.get("format_version", 1))
+                            format_version=kw.get("format_version", 1),
+                            sharding=kw.get("sharding", ""))
 
     keys = [
         key(),
@@ -106,10 +107,24 @@ def test_artifact_key_invalidation_matrix():
         key(mesh_spec="dp=2,tp=2"),
         key(jaxlib="0.4.37"),
         key(format_version=2),
+        key(mesh_spec="dp=2,tp=2", sharding="tp=2"),
+        key(mesh_spec="dp=2,tp=2", sharding="dp=2,tp=2"),
     ]
     assert len(set(keys)) == len(keys)
     # deterministic: same inputs, same key
     assert key() == key()
+
+
+def test_artifact_key_tp_vs_dp_never_collide():
+    """The sharding slice is its own key field: a tp=2 executable
+    (weights split over the mesh) must never hydrate where a dp=2 one
+    (weights replicated, rows split) — or the unsharded program — is
+    expected, even though all three share a mesh spec."""
+    def key(sharding):
+        return artifact_key("fp0", (4, 784), "float32", "dp=2,tp=2",
+                            "0.4.36", format_version=2, sharding=sharding)
+
+    assert len({key(""), key("dp=2"), key("tp=2"), key("dp=2,tp=2")}) == 4
 
 
 def test_segment_fingerprint_tracks_params(tmp_path):

@@ -445,3 +445,255 @@ class TestSurfaces:
             assert "seldon_runtime_placement_device_bytes{" in rendered
         finally:
             unpublish("pl-probe")
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel spans (docs/sharding.md#tensor-parallel-spans)
+# ---------------------------------------------------------------------------
+
+CLF = "seldon_core_tpu.models.mlp:MNISTMLPClassifier"
+
+
+class TestTpPlanner:
+    def test_per_device_bytes_shards_covered_fraction(self):
+        # half the bytes carry a tp layout: that half divides, the
+        # rest replicates
+        f = SegmentFacts(name="a", hbm_bytes=100, tp_shardable_bytes=50)
+        assert f.per_device_bytes(2) == 25 + 50
+        assert f.per_device_bytes(1) == 100  # no tp axis: full estimate
+        # measured peak scales the static covered *fraction*
+        g = SegmentFacts(name="b", hbm_bytes=100, measured_hbm_bytes=200,
+                         tp_shardable_bytes=50)
+        assert g.per_device_bytes(2) == 50 + 100
+
+    def test_tp_span_assignment(self):
+        plan = plan_placement(
+            [SegmentFacts(name="a", hbm_bytes=100, tp_shardable_bytes=80),
+             _facts("b", 10)],
+            n_devices=2, tp=2, mesh_spec="tp=2")
+        by_seg = {a.segment: a for a in plan.assignments}
+        assert by_seg["a"].source == "tp-span"
+        assert by_seg["a"].devices == (0, 1)
+        assert by_seg["a"].mesh_slice == "tp=2"
+        assert by_seg["a"].tp_bytes_per_device == 40 + 20
+        assert by_seg["b"].source == "bin-pack"
+        row = next(s for s in plan.to_dict()["segments"]
+                   if s["segment"] == "a")
+        assert row["meshSlice"] == "tp=2"
+        assert row["tpBytesPerDevice"] == 60
+
+    def test_tp_span_turns_overflow_into_feasible(self):
+        # 100 bytes on a 60-byte device: GL1204 territory replicated,
+        # feasible once the 80 covered bytes divide over tp=2
+        facts = [SegmentFacts(name="a", hbm_bytes=100,
+                              tp_shardable_bytes=80)]
+        replicated = plan_placement(
+            facts, n_devices=2, dp=2, mesh_spec="dp=2", capacity_bytes=60)
+        assert replicated.over_capacity  # 100 replicated on every device
+        spanned = plan_placement(
+            facts, n_devices=2, tp=2, mesh_spec="tp=2", capacity_bytes=60)
+        assert spanned.over_capacity == []  # 40 + 20 = 60 per device
+
+    def test_no_layout_means_no_span(self):
+        plan = plan_placement(
+            [_facts("a", 100)], n_devices=2, tp=2, mesh_spec="tp=2")
+        assert plan.assignments[0].source == "bin-pack"
+
+
+class TestTpLayouts:
+    def test_rule_table_megatron_splits(self):
+        from seldon_core_tpu.placement import layouts
+
+        lay = layouts.SpecLayout()
+        # qkv column-parallel (heads split): 3-D layer stacks
+        assert lay.spec_for("layers/3/attn/wq", 3) == (None, "tp", None)
+        # attn out row-parallel: contraction dim splits
+        assert lay.spec_for("layers/3/attn/wo", 3) == ("tp", None, None)
+        # ffn up column / down row, as plain 2-D matrices
+        assert lay.spec_for("layers/0/mlp/w1", 2) == (None, "tp")
+        assert lay.spec_for("layers/0/mlp/w2", 2) == ("tp", None)
+        assert lay.spec_for("embedding", 2) == (None, "tp")
+        # unknown layouts must never guess: no rule, or no rank entry
+        assert lay.spec_for("some/bias", 1) is None
+        assert lay.spec_for("layers/3/attn/wq", 2) is None
+
+    def test_resolve_layout_drops_indivisible(self):
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.placement import layouts
+
+        params = {"w1": jnp.zeros((4, 6)), "odd": jnp.zeros((4, 3))}
+        lay = layouts.resolve_layout(
+            params, declared={"odd": (None, "tp")}, tp=2)
+        assert lay == {"w1": (None, "tp")}  # 3 % 2 != 0: replicated
+
+    def test_check_divisibility_reports_rule_hits(self):
+        from seldon_core_tpu.placement import layouts
+
+        bad = layouts.check_divisibility(
+            {"blk/w1": (4, 3)}, tp=2, declared=None)
+        assert bad == [("blk/w1", 1, 3)]
+        assert layouts.check_divisibility(
+            {"blk/w1": (4, 6)}, tp=2, declared=None) == []
+
+
+class TestTpLint:
+    def test_gl1204_flips_to_tp_span(self):
+        node = {"name": "clf", "type": "MODEL", "parameters": [{
+            "name": "model_class", "value": CLF, "type": "STRING"}],
+            "children": []}
+        # ~2.04 MiB of weights vs 0.003 GiB / 2 devices = 1.61 MiB each:
+        # replicated overflows, the tp=2 span (~1.02 MiB/device) fits
+        budget = {"seldon.io/graph-plan": "fused",
+                  "seldon.io/tpu-hbm-gb": "0.003"}
+        fs = _lint({**budget, MESH: "dp=2"}, node=node)
+        assert fs["GL1204"].severity == "ERROR"
+        fs = _lint({**budget, MESH: "tp=2"}, node=node)
+        assert "GL1204" not in fs
+        assert "planned tp span" in fs["GL1205"].message
+        assert "clf(tp=2" in fs["GL1205"].message
+
+    def test_gl1207_rule_derived_indivisible(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models import (
+            SIGNATURES,
+            TRACE_PROVIDERS,
+            ModelSignature,
+            TraceTarget,
+        )
+
+        mc = "tests.synthetic:OddFfnPlacement"
+        monkeypatch.setitem(SIGNATURES, mc, ModelSignature(
+            input_shape=(None, 4), input_dtype="float32",
+            hbm_bytes=60, pure_fn=True))
+        monkeypatch.setitem(TRACE_PROVIDERS, mc, lambda: TraceTarget(
+            fn=lambda p, X: X @ p["w1"],
+            params={"w1": jax.ShapeDtypeStruct((4, 3), jnp.float32)}))
+        node = {"name": "odd", "type": "MODEL", "parameters": [{
+            "name": "model_class", "value": mc, "type": "STRING"}],
+            "children": []}
+        fs = _lint({"seldon.io/graph-plan": "fused", MESH: "tp=2"},
+                   node=node)
+        assert fs["GL1207"].severity == "ERROR"
+        assert "'w1'" in fs["GL1207"].message
+        # tp=1: the rule table never engages
+        fs = _lint({"seldon.io/graph-plan": "fused", MESH: "dp=2"},
+                   node=node)
+        assert "GL1207" not in fs
+
+
+class TestTpExecution:
+    def _boot(self, name, mesh):
+        return _deployment(name, {
+            "seldon.io/graph-plan": "fused", MESH: mesh},
+            model_class=CLF, node_name="clf")
+
+    def _drive(self, dep, xs):
+        eng = dep.predictors[0].engine
+        return [eng.predict_sync(_msg(x)).to_dict()["data"] for x in xs]
+
+    @pytest.mark.parametrize("mesh", ["tp=2", "dp=2,tp=2"])
+    def test_tp_byte_parity_every_bucket(self, mesh):
+        """The tp-sharded classifier must serve every shape bucket
+        byte-identically to the walk and the unsharded fused plan —
+        the discrete argmax output is what makes this hold bitwise
+        (the float-output MNISTMLP correctly fails the probe)."""
+        from seldon_core_tpu.placement import unpublish
+
+        slug = mesh.replace("=", "").replace(",", "-")
+        sharded = self._boot(f"pl-{slug}", mesh)
+        fused = _deployment(f"pl-{slug}-fused",
+                            {"seldon.io/graph-plan": "fused"},
+                            model_class=CLF, node_name="clf")
+        walk = _deployment(f"pl-{slug}-walk", {},
+                           model_class=CLF, node_name="clf")
+        try:
+            seg = sharded.predictors[0].engine.plan.segments[0]
+            assert sharded.placement.sharded_segments == [seg.name]
+            assert seg.shard_parity == "verified"
+            assert seg.shard_tp == 2
+            assert seg.shard_slice == mesh
+            assert seg.tp_sharded_param_bytes > 0
+
+            xs = [np.random.RandomState(i).uniform(
+                size=(n, 784)).astype("float32")
+                for i, n in enumerate((2, 4, 8))]
+            s0 = seg.n_sharded_calls
+            a = self._drive(sharded, xs)
+            assert seg.n_sharded_calls - s0 == len(xs)
+            assert all(v["parity"] == "verified"
+                       for v in seg.shard_cost_by_bucket.values())
+            assert a == self._drive(fused, xs) == self._drive(walk, xs)
+        finally:
+            unpublish(f"pl-{slug}")
+
+    def test_float_output_mlp_disarms_not_diverges(self):
+        """The parity gate doing its job: tp reductions perturb float
+        outputs by an ULP on CPU, so the softmax MLP must fall back to
+        unsharded — and still answer byte-equal to the walk."""
+        from seldon_core_tpu.placement import unpublish
+
+        sharded = _deployment("pl-tpfloat", {
+            "seldon.io/graph-plan": "fused", MESH: "tp=2"},
+            model_class=MLP, node_name="mlp")
+        walk = _deployment("pl-tpfloat-walk", {},
+                           model_class=MLP, node_name="mlp")
+        try:
+            seg = sharded.predictors[0].engine.plan.segments[0]
+            x = np.random.RandomState(2).uniform(
+                size=(8, 784)).astype("float32")
+            a = sharded.predictors[0].engine.predict_sync(_msg(x))
+            b = walk.predictors[0].engine.predict_sync(_msg(x))
+            assert a.to_dict() == b.to_dict()
+            if seg.shard_parity == "failed":
+                assert sharded.placement.sharded_segments == []
+        finally:
+            unpublish("pl-tpfloat")
+
+    def test_tp_spans_surface(self):
+        from seldon_core_tpu.placement import snapshot, unpublish
+        from seldon_core_tpu.placement.http import placement_body
+
+        dep = self._boot("pl-tpsurf", "tp=2")
+        try:
+            plane = dep.placement
+            spans = plane.tp_spans()
+            assert len(spans) == 1
+            span = spans[0]
+            assert span["meshSlice"] == "tp=2"
+            assert span["shardedParamBytes"] > 0
+            assert 0 < span["tpBytesPerDevice"] < span["shardedParamBytes"]
+            assert any(span["params"].values())
+
+            status, payload = placement_body(plane, {})
+            assert status == 200
+            row = next(s for s in payload["segments"]
+                       if s["source"] == "tp-span")
+            assert row["meshSlice"] == "tp=2"
+            assert row["tpBytesPerDevice"] > 0
+            assert payload["tpSpans"] == spans
+
+            snap = snapshot("pl-tpsurf")
+            assert snap["predictors"][0]["tpSpans"] == {"clf": "tp=2"}
+        finally:
+            unpublish("pl-tpsurf")
+
+    def test_tp_gauges_exported(self):
+        from seldon_core_tpu.placement import unpublish
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+        dep = self._boot("pl-tpgauge", "tp=2")
+        try:
+            plane = dep.placement
+            reg = MetricsRegistry()
+            plane.metrics = reg
+            plane.deployment = "pl-tpgauge"
+            plane.placement()  # gauge export rides the plan read
+            rendered = reg.render()
+            assert 'seldon_placement_tp_spans{deployment="pl-tpgauge"} 1' \
+                in rendered
+            assert "seldon_placement_tp_bytes_per_device{" in rendered
+        finally:
+            unpublish("pl-tpgauge")

@@ -309,6 +309,34 @@ class TestLoadHarness:
         )
         assert res.extra["dropped"] > 0
 
+    async def test_open_loop_counts_warmup_admitted_completions(self):
+        """Throughput counts completions OBSERVED in the measured window
+        (t1-gated), latency samples stay arrival-gated (t0): a long
+        stream admitted during warmup that finishes mid-window is real
+        served work. The old t0-gated count reported 0 req/s for SLO
+        drills whose every stream was admitted before the window
+        opened, with streams visibly completing."""
+        import asyncio as _a
+
+        from seldon_core_tpu.tools.loadtest import run_open_loop
+
+        class Stream:
+            async def __aenter__(self):
+                return self
+
+            async def __aexit__(self, *exc):
+                pass
+
+            async def __call__(self):
+                await _a.sleep(0.3)
+
+        res = await run_open_loop(
+            Stream(), rate=20.0, seconds=0.5, warmup_s=0.25, seed=0)
+        # warmup arrivals (t0 < t_start) complete inside the window:
+        # counted toward throughput, excluded from the latency samples
+        assert res.requests > len(res.latencies_ms) > 0
+        assert res.to_dict()["req_per_s"] > 0
+
     async def test_grpc_load(self):
         from seldon_core_tpu.serving.grpc_api import (
             GrpcServer,
