@@ -41,6 +41,9 @@ greps, and operator status all key on it), a severity, the unit path or
   (no execution, no weights) — declared-vs-traced drift, implicit
   float64/weak-type promotion, host callbacks inside ``pure_fn`` nodes,
   and mesh-axis divisibility against ``seldon.io/mesh``
+- ``GL17xx`` — device-plane admission (``seldon.io/device-plane*``
+  annotation validation, plane knobs set while the plane is off,
+  effective enable/remote-mode report)
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 - ``RL6xx`` — asyncio concurrency lint (``analysis/asynclint.py``):
@@ -120,6 +123,9 @@ FLEET_OBS_CONFIG_REPORT = "GL1403"  # fleet-obs report: effective config
 ARTIFACT_ANNOTATION_INVALID = "GL1501"  # seldon.io/artifact-* value invalid
 ARTIFACTS_WITHOUT_PLAN = "GL1502"   # artifact knobs set, graph-plan not fused
 ARTIFACT_CONFIG_REPORT = "GL1503"   # artifact report: effective config
+DEVICE_PLANE_ANNOTATION_INVALID = "GL1701"  # seldon.io/device-plane* invalid
+DEVICE_PLANE_KNOBS_WITHOUT_PLANE = "GL1702"  # plane knobs set, plane off
+DEVICE_PLANE_CONFIG_REPORT = "GL1703"  # device-plane report: effective config
 TRACE_SIGNATURE_DRIFT = "GL1601"    # declared output shape/dtype != traced
 TRACE_IMPLICIT_PROMOTION = "GL1602"  # float64/weak-type escapes the segment
 TRACE_CALLBACK_IN_PURE_FN = "GL1603"  # host callback inside a pure_fn node
@@ -195,6 +201,9 @@ CODE_SEVERITY = {
     ARTIFACT_ANNOTATION_INVALID: ERROR,
     ARTIFACTS_WITHOUT_PLAN: WARN,
     ARTIFACT_CONFIG_REPORT: INFO,
+    DEVICE_PLANE_ANNOTATION_INVALID: ERROR,
+    DEVICE_PLANE_KNOBS_WITHOUT_PLANE: WARN,
+    DEVICE_PLANE_CONFIG_REPORT: INFO,
     TRACE_SIGNATURE_DRIFT: ERROR,
     TRACE_IMPLICIT_PROMOTION: WARN,
     TRACE_CALLBACK_IN_PURE_FN: ERROR,

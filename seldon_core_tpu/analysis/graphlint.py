@@ -39,6 +39,9 @@ from seldon_core_tpu.analysis.findings import (
     COMBINER_ARITY,
     COMBINER_INPUT_DIVERGENCE,
     DEADLINE_INFEASIBLE,
+    DEVICE_PLANE_ANNOTATION_INVALID,
+    DEVICE_PLANE_CONFIG_REPORT,
+    DEVICE_PLANE_KNOBS_WITHOUT_PLANE,
     DTYPE_MISMATCH,
     DUPLICATE_NAME,
     FLEET_ANNOTATION_INVALID,
@@ -196,6 +199,7 @@ def lint_graph(
         findings.extend(_fleet_pass(unit, ann, path_prefix))
         findings.extend(_fleet_obs_pass(unit, ann, path_prefix))
         findings.extend(_artifact_pass(unit, ann, path_prefix))
+        findings.extend(_device_plane_pass(unit, ann, path_prefix))
         findings.extend(_tracelint_pass(unit, ann, path_prefix))
     return findings
 
@@ -1418,6 +1422,49 @@ def _artifact_pass(root: PredictiveUnit, ann: dict,
         f"{'on' if cfg.publish else 'off'}",
     ))
     return findings
+
+
+def _device_plane_pass(root: PredictiveUnit, ann: dict,
+                       prefix: str) -> list[Finding]:
+    """GL17xx: device-plane admission.  Validates the
+    ``seldon.io/device-plane*`` family through the same parser the
+    operator uses (GL1701), warns when sub-knobs are set while the
+    master switch is off — the configured remote fast path silently
+    never engages (GL1702) — and reports the effective enable/remote
+    posture (GL1703)."""
+    from seldon_core_tpu.runtime.device_plane import (
+        DEVICE_PLANE_ANNOTATION,
+        DEVICE_PLANE_PREFIX,
+        device_plane_config_from_annotations,
+    )
+
+    keys = [k for k in ann
+            if k == DEVICE_PLANE_ANNOTATION
+            or k.startswith(DEVICE_PLANE_PREFIX)]
+    if not keys:
+        return []
+    path0 = _join(prefix, root.name)
+    try:
+        cfg = device_plane_config_from_annotations(ann, "lint")
+    except ValueError as e:
+        return [make_finding(DEVICE_PLANE_ANNOTATION_INVALID, path0, str(e))]
+    if cfg is None or not cfg.enabled:
+        knobs = sorted(k for k in keys if k != DEVICE_PLANE_ANNOTATION)
+        if knobs:
+            return [make_finding(
+                DEVICE_PLANE_KNOBS_WITHOUT_PLANE, path0,
+                f"{', '.join(knobs)} set but {DEVICE_PLANE_ANNOTATION} is "
+                "off — remote edges stay on the byte wire and cache edges "
+                "keep defensive host copies",
+            )]
+        return []
+    return [make_finding(
+        DEVICE_PLANE_CONFIG_REPORT, path0,
+        f"device plane on: cache/chain edges hand out HBM handles, "
+        f"meta-only routers skip D2H, remote fast path {cfg.remote!r} "
+        "(loopback refs in-process, shm staging same-host, bytes across "
+        "hosts)",
+    )]
 
 
 def _join(prefix: str, name: str) -> str:

@@ -55,13 +55,31 @@ from seldon_core_tpu.utils import maybe_await as _maybe_await  # noqa: E402
 
 
 class _Node:
-    __slots__ = ("unit", "impl", "children", "type")
+    __slots__ = ("unit", "impl", "children", "type", "meta_only_route")
 
     def __init__(self, unit: PredictiveUnit, impl: NodeImpl, children: list["_Node"]):
         self.unit = unit
         self.impl = impl
         self.children = children
         self.type = unit.resolved_type
+        self.meta_only_route = _routes_on_meta(unit)
+
+
+def _routes_on_meta(unit: PredictiveUnit) -> bool:
+    """True when this ROUTER's registered signature declares the route
+    decision reads meta/names only (``ModelSignature.routes_on``) — the
+    device plane then skips materializing the tensor for the route call
+    entirely (no D2H, no defensive copy)."""
+    if unit.resolved_type != "ROUTER":
+        return False
+    from seldon_core_tpu import models as _models
+
+    if unit.implementation:
+        sig = _models.BUILTIN_SIGNATURES.get(unit.implementation)
+    else:
+        model_class = (unit.parameters or {}).get("model_class")
+        sig = _models.signature_for(model_class) if model_class else None
+    return sig is not None and sig.routes_on == "meta"
 
 
 class GraphEngine:
@@ -90,6 +108,7 @@ class GraphEngine:
         profiler: Optional[Any] = None,
         placement: Optional[Any] = None,
         artifacts: Optional[Any] = None,
+        device_plane: Optional[Any] = None,
     ):
         from seldon_core_tpu.utils.tracing import NULL_TRACER
 
@@ -212,6 +231,14 @@ class GraphEngine:
                     spec = ""
             self.artifacts.attach_plan(self.plan, mesh_spec=spec)
             self.artifacts.hydrate_plan(self.plan)
+        # device plane (runtime/device_plane.py, docs/device-plane.md):
+        # tensors stay in HBM across interpreter-boundary edges — cache
+        # entries hand out the immutable jax.Array handle (promoted to
+        # device at PUT time), meta-only routers skip their D2H, and
+        # remote clients negotiate per-peer deviceRef fast paths.  Pure
+        # policy + accounting: with the plane off every path below
+        # behaves exactly as before.
+        self.device_plane = device_plane
         # replica identity (fleet observability, docs/observability.md):
         # stamped on root spans, meta.tags["replica"], and flight records
         # so fleet-level merges can attribute every record to the engine
@@ -325,6 +352,11 @@ class GraphEngine:
             # otherwise — tools/replay.py parity runs assert it (replay
             # strips tags from the canonical body, so parity holds)
             meta.tags["artifact-source"] = self.artifacts.source_tag()
+        if self.device_plane is not None and self.device_plane.enabled:
+            # parity evidence: tools/replay.py --expect-device-plane
+            # asserts this stamp; replay strips tags from the canonical
+            # body, so plane-on ≡ plane-off byte parity holds
+            meta.tags["device-plane"] = "on"
         # QoS context: the wire channel (meta tags, stamped by the
         # gateway/REST layer) wins; in-process callers inherit the ambient
         # contextvar.  Restamped onto the request so remote hops see the
@@ -585,7 +617,24 @@ class GraphEngine:
         #    (getBranchIndex, PredictiveUnitBean.java:271-281)
         selected = child_walks
         if node.type == "ROUTER":
-            branch = int(await _maybe_await(impl.route(transformed)))
+            route_msg = transformed
+            if (
+                node.meta_only_route
+                and self.device_plane is not None
+                and self.device_plane.enabled
+                and transformed.data is not None
+            ):
+                # the router's signature declares the decision never reads
+                # tensor values — route on a data-less view so the
+                # component runtime cannot trigger the D2H (or defensive
+                # copy) it would otherwise pay to materialize the input
+                if transformed.is_device_resident:
+                    self.device_plane.note_avoided(
+                        "d2h", int(transformed.nbytes or 0))
+                route_msg = SeldonMessage(
+                    names=list(transformed.names), meta=transformed.meta
+                )
+            branch = int(await _maybe_await(impl.route(route_msg)))
             meta.routing[unit.name] = branch
             if branch >= 0:
                 if branch >= len(node.children):
@@ -774,8 +823,9 @@ class GraphEngine:
         async def compute():
             sub = Meta()
             cold = await self._walk_node(node, msg, sub)
-            e = (cold.data, list(cold.names), sub)
-            self.cache.put(key, e, _entry_nbytes(cold.data, cold.names, sub))
+            data = self._promote_device(cold.data)
+            e = (data, list(cold.names), sub)
+            self.cache.put(key, e, _entry_nbytes(data, cold.names, sub))
             return e
 
         entry, coalesced = await self._flight.run(key, compute)
@@ -787,6 +837,30 @@ class GraphEngine:
             out = self._replay_entry(entry, meta, node)
         self._observe(name, time.perf_counter() - t0)
         return out
+
+    def _promote_device(self, arr: Any) -> Any:
+        """Device-plane cache promotion: store a freshly computed entry as
+        the immutable ``jax.Array`` HBM handle so every future hit hands
+        out the handle instead of a defensive host copy (and downstream
+        device consumers skip their H2D).  Guarded by dtype
+        canonicalization — with x64 disabled, ``device_put`` on a float64
+        result would silently downcast and break the plane's byte-parity
+        guarantee, so such entries keep the host-copy path."""
+        plane = self.device_plane
+        if plane is None or not plane.enabled or arr is None:
+            return arr
+        import numpy as _np
+
+        if not isinstance(arr, _np.ndarray):
+            return arr  # already device-resident, or a host scalar/list
+        try:
+            import jax
+
+            if jax.dtypes.canonicalize_dtype(arr.dtype) != arr.dtype:
+                return arr
+            return jax.device_put(arr)
+        except Exception:
+            return arr
 
     def _replay_entry(
         self, entry: tuple, meta: Meta, node: _Node
@@ -804,6 +878,13 @@ class GraphEngine:
 
         if node is not self.root and isinstance(data, _np.ndarray):
             data = data.copy()
+        elif data is not None and not isinstance(data, _np.ndarray):
+            plane = self.device_plane
+            if plane is not None and plane.enabled:
+                # the defensive copy (and any host materialization) the
+                # off-plane path would have paid for this hit never happens
+                plane.note_avoided(
+                    "copy", int(getattr(data, "nbytes", 0) or 0))
         return SeldonMessage(data=data, names=list(names))
 
     # ------------------------------------------------------------------
@@ -886,7 +967,8 @@ class GraphEngine:
             return self._segment_entry(entry, interior)
 
         async def compute():
-            e = await self._dispatch_segment(seg, x, msg.names)
+            y, names = await self._dispatch_segment(seg, x, msg.names)
+            e = (self._promote_device(y), names)
             self.cache.put(key, e, _entry_nbytes(e[0], e[1]))
             return e
 
@@ -954,16 +1036,22 @@ class GraphEngine:
             names = seg.out_names(x, in_names)
         return y, list(names)
 
-    @staticmethod
-    def _segment_entry(entry: tuple, interior: bool) -> tuple:
+    def _segment_entry(self, entry: tuple, interior: bool) -> tuple:
         """Chain segments feed an interpreted (possibly mutating)
         remainder — hand interior consumers a private numpy copy so they
-        can never corrupt the shared cached buffer."""
+        can never corrupt the shared cached buffer.  Device-resident
+        entries (device-plane promotion) are immutable, so the handle
+        itself crosses the chain edge: zero copies, and the plane bills
+        the copy it skipped."""
         y, names = entry
         import numpy as _np
 
         if interior and isinstance(y, _np.ndarray):
             y = y.copy()
+        elif interior and y is not None and not isinstance(y, _np.ndarray):
+            plane = self.device_plane
+            if plane is not None and plane.enabled:
+                plane.note_avoided("copy", int(getattr(y, "nbytes", 0) or 0))
         return y, list(names)
 
     # ------------------------------------------------------------------

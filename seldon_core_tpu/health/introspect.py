@@ -80,6 +80,13 @@ GAUGES = {
     "artifact_coverage": "seldon_artifact_coverage",
     "compile_cache_hits": "seldon_compile_cache_hits",
     "compile_cache_misses": "seldon_compile_cache_misses",
+    "device_plane_transfers_avoided":
+        "seldon_runtime_device_plane_transfers_avoided",
+    "device_plane_bytes_avoided":
+        "seldon_runtime_device_plane_bytes_avoided",
+    "device_plane_remote_refs": "seldon_runtime_device_plane_remote_refs",
+    "device_plane_downgrades": "seldon_runtime_device_plane_downgrades",
+    "device_plane_donations": "seldon_runtime_device_plane_donations",
 }
 
 

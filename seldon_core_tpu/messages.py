@@ -41,6 +41,7 @@ __all__ = [
     "Meta",
     "Status",
     "SeldonMessage",
+    "DeviceTensorRef",
     "Feedback",
     "new_puid",
 ]
@@ -199,6 +200,44 @@ def _to_numpy(x: ArrayLike) -> np.ndarray:
     return np.asarray(x)  # device→host transfer for jax.Array
 
 
+@dataclass(frozen=True)
+class DeviceTensorRef:
+    """A device-resident tensor by reference, not by value.
+
+    The handle rides the framed wire's meta blob (``serving/framed.py``)
+    and the proto's ``DeviceTensor`` oneof arm (``proto/convert.py``) so
+    co-scheduled peers exchange HBM buffers without serializing bytes:
+    ``ref`` is either a process-scoped registry key
+    (``runtime/device_registry.py`` — zero copies, in-process loopback)
+    or an ``shm:`` segment name (same host, exactly one D2H + one H2D).
+    ``shape``/``dtype``/``nbytes`` are carried alongside so receivers
+    and observability paths can reason about the payload without
+    resolving (and thereby consuming) the one-shot ref.
+    """
+
+    ref: str
+    shape: tuple = ()
+    dtype: str = ""
+    nbytes: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "ref": self.ref,
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "nbytes": self.nbytes,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeviceTensorRef":
+        return cls(
+            ref=str(d.get("ref", "")),
+            shape=tuple(int(s) for s in d.get("shape", ())),
+            dtype=str(d.get("dtype", "")),
+            nbytes=int(d.get("nbytes", 0)),
+        )
+
+
 @dataclass
 class SeldonMessage:
     """The unit of data flowing through an inference graph.
@@ -231,6 +270,39 @@ class SeldonMessage:
     @property
     def is_device_resident(self) -> bool:
         return self.data is not None and _is_jax_array(self.data)
+
+    @property
+    def shape(self) -> Optional[tuple]:
+        """Tensor shape WITHOUT materializing ``data`` on host.
+
+        ``jax.Array.shape`` is metadata — observability paths (flight
+        recorder, introspection sampler, attribution) must use this
+        instead of ``host_data().shape``, which is the accidental-D2H
+        trap documented at :func:`_to_numpy`.
+        """
+        if self.data is None:
+            return None
+        shape = getattr(self.data, "shape", None)
+        if shape is not None:
+            return tuple(shape)
+        return np.asarray(self.data).shape  # host-side list/scalar payloads
+
+    @property
+    def nbytes(self) -> Optional[int]:
+        """Payload size in bytes WITHOUT materializing ``data`` on host
+        (same contract as :attr:`shape`; ``jax.Array.nbytes`` is
+        metadata).  Covers the byte payloads too so accounting paths can
+        bill any message with one accessor."""
+        if self.data is not None:
+            nbytes = getattr(self.data, "nbytes", None)
+            if nbytes is not None:
+                return int(nbytes)
+            return int(np.asarray(self.data).nbytes)
+        if self.bin_data is not None:
+            return len(self.bin_data)
+        if self.str_data is not None:
+            return len(self.str_data.encode("utf-8", errors="replace"))
+        return None
 
     def host_data(self) -> Optional[np.ndarray]:
         """Materialize ``data`` on host (device→host copy iff needed)."""
@@ -302,6 +374,18 @@ class SeldonMessage:
                 raw = base64.b64decode(t["b64"])
                 dtype = _np_dtype(t.get("dtype", "float32"))
                 msg.data = np.frombuffer(raw, dtype=dtype).reshape(t["shape"])
+                msg.encoding = "binTensor"
+            elif "deviceRef" in datad:
+                # device-plane fast path: the tensor never rode the wire —
+                # resolve the HBM handle (loopback) or shm segment (same
+                # host).  A ref that cannot resolve here RAISES
+                # (ForeignProcessRef/KeyError), which the transport maps to
+                # an explicit error the sender downgrades on — never a
+                # silent empty message.
+                from seldon_core_tpu.runtime.device_registry import registry
+
+                ref = DeviceTensorRef.from_dict(datad["deviceRef"])
+                msg.data = registry.resolve(ref.ref)
                 msg.encoding = "binTensor"
         elif "binData" in d:
             msg.bin_data = base64.b64decode(d["binData"])

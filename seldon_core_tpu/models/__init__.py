@@ -57,6 +57,16 @@ class ModelSignature:
     ``PartitionSpec`` axis tuples (e.g. ``{"w1": (None, "tp")}``) so the
     sharded executor can shard large weight matrices over the ``tp``
     axis instead of replicating them; ``None`` replicates everything.
+
+    ``routes_on`` (routers only) declares what the ``route()`` decision
+    actually reads: ``"tensor"`` (the conservative default — the router
+    may inspect values, so a device-resident payload must be
+    materialized on host before the call) or ``"meta"`` (the decision
+    depends only on meta/names/internal state — RNG splits, bandit
+    state, static branches).  The device plane skips the D2H entirely
+    for ``"meta"`` routers (``serving/client.py`` remote route,
+    ``graph/engine.py`` walk); declaring ``"meta"`` for a router that
+    reads values is a correctness bug on the declarer.
     """
 
     input_shape: Optional[Shape] = None
@@ -68,6 +78,7 @@ class ModelSignature:
     deterministic: bool = True
     batch_shardable: bool = True
     tp_param_specs: Optional[dict] = None
+    routes_on: str = "tensor"
 
 
 def _dense_bytes(sizes: tuple, dtype_bytes: int = 4) -> int:
@@ -150,13 +161,15 @@ BUILTIN_SIGNATURES: dict[str, ModelSignature] = {
         output_shape=(ANY, 3), output_dtype="float64",
     ),
     # always branch 0 — deterministic, but routers are still cache
-    # boundaries (control flow re-runs per request)
-    "SIMPLE_ROUTER": ModelSignature(),
+    # boundaries (control flow re-runs per request); route() ignores X
+    "SIMPLE_ROUTER": ModelSignature(routes_on="meta"),
     # RNG split per request (graph/builtins.py RandomABTest; a `seed`
-    # graph parameter pins it for tests, but the stream still advances)
-    "RANDOM_ABTEST": ModelSignature(deterministic=False),
-    # epsilon-greedy MAB: RNG exploration + reward state learned online
-    "EPSILON_GREEDY": ModelSignature(deterministic=False),
+    # graph parameter pins it for tests, but the stream still advances);
+    # the split reads only the RNG stream, never the tensor
+    "RANDOM_ABTEST": ModelSignature(deterministic=False, routes_on="meta"),
+    # epsilon-greedy MAB: RNG exploration + reward state learned online;
+    # route() reads RNG + learned values, not the request tensor
+    "EPSILON_GREEDY": ModelSignature(deterministic=False, routes_on="meta"),
     # element-wise mean over children, pure on-device
     "AVERAGE_COMBINER": ModelSignature(pure_fn=True),
 }

@@ -439,6 +439,25 @@ def artifact_config(dep: SeldonDeployment, p: PredictorSpec):
         raise DeploymentValidationError(str(e)) from None
 
 
+def device_plane_config(dep: SeldonDeployment, p: PredictorSpec):
+    """``seldon.io/device-plane*`` annotations → a validated
+    :class:`~seldon_core_tpu.runtime.device_plane.DevicePlaneConfig` (or
+    None when the plane is off).  Invalid values — a non-boolean enable
+    knob, an unknown remote mode — reject at admission; graphlint's
+    GL17xx pass reports the same defects, this is the hard stop for
+    callers that skip linting."""
+    from seldon_core_tpu.operator.spec import DeploymentValidationError
+    from seldon_core_tpu.runtime.device_plane import (
+        device_plane_config_from_annotations,
+    )
+
+    ann = {**dep.annotations, **p.annotations}
+    try:
+        return device_plane_config_from_annotations(ann, f"{dep.name}/{p.name}")
+    except ValueError as e:
+        raise DeploymentValidationError(str(e)) from None
+
+
 def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
     """``seldon.io/graphlint`` enforcement mode: ``enforce`` (default,
     ERROR findings reject the spec), ``warn`` (compile anyway), ``off``

@@ -42,6 +42,7 @@ def resolve_component(
     annotations: Optional[dict] = None,
     metrics: Optional[MetricsRegistry] = None,
     qos=None,  # qos.policy.EngineQos: breakers around remote clients
+    device_plane=None,  # runtime.device_plane.DevicePlane: remote fast path
 ):
     """Instantiate one graph node's implementation.
 
@@ -85,6 +86,7 @@ def resolve_component(
                 timeout_s=_timeout_s(ann, "seldon.io/grpc-read-timeout", 30.0),
             )
         else:
+            from seldon_core_tpu.graph.engine import _routes_on_meta
             from seldon_core_tpu.serving.client import RemoteComponent
 
             scheme_port = unit.endpoint.service_port or 8000
@@ -96,6 +98,10 @@ def resolve_component(
                 connect_timeout_s=_timeout_s(
                     ann, "seldon.io/rest-connection-timeout", None
                 ),
+                # meta-only routers never need the tensor serialized at
+                # all; device_plane turns on the negotiated ref fast path
+                route_meta_only=_routes_on_meta(unit),
+                device_plane=device_plane,
             )
         if qos is not None and qos.config.breakers_enabled:
             from seldon_core_tpu.qos import BreakerWrapper
@@ -181,6 +187,7 @@ class LocalPredictor:
         ann = {**dep.annotations, **pred.annotations}
         from seldon_core_tpu.operator.compile import (
             artifact_config,
+            device_plane_config,
             graph_plan_mode,
             health_config,
             placement_config,
@@ -288,6 +295,27 @@ class LocalPredictor:
                 art_cfg, metrics=self.metrics.registry,
                 deployment=dep.name,
             )
+        # Device-resident tensor plane (docs/device-plane.md): cache and
+        # chain edges hand out immutable HBM handles instead of defensive
+        # host copies, meta-only routers skip D2H entirely, and remote
+        # edges negotiate loopback/shm device refs per peer.
+        # seldon.io/device-plane turns it on; byte parity with the plane
+        # off is provable via tools/replay.py --expect-device-plane.
+        dp_cfg = device_plane_config(dep, pred)
+        self.device_plane = None
+        if dp_cfg is not None and dp_cfg.enabled:
+            from seldon_core_tpu.runtime.device_plane import DevicePlane
+            from seldon_core_tpu.runtime.device_registry import (
+                registry as _device_registry,
+            )
+
+            self.device_plane = DevicePlane(
+                dp_cfg, metrics=self.metrics.registry
+            )
+            _device_registry.attach_metrics(self.metrics.registry)
+            # a crashed producer's shm segments must not leak across
+            # restarts: sweep orphans before minting new ones
+            _device_registry.reap_orphan_shm()
         # persistent XLA compile cache: seldon.io/compile-cache is either a
         # boolean (default dir) or a cache-dir path; idempotent across
         # predictors (utils.enable_compile_cache)
@@ -303,7 +331,8 @@ class LocalPredictor:
         # via tools/chaos.ChaosWrapper to prove least-loaded steering)
         def _resolve(u):
             handle = resolve_component(
-                u, ann, self.metrics.registry, qos=self.qos
+                u, ann, self.metrics.registry, qos=self.qos,
+                device_plane=self.device_plane,
             )
             return component_wrap(handle) if component_wrap else handle
 
@@ -325,6 +354,7 @@ class LocalPredictor:
             profiler=self.profiler,
             placement=self.placement,
             artifacts=self.artifacts,
+            device_plane=self.device_plane,
         )
         if self.engine.plan is None:
             self.artifacts = None  # nothing fused: nothing to serialize
@@ -378,6 +408,13 @@ class LocalPredictor:
                                 metrics=self.metrics.registry))
         if self.artifacts is not None:
             sampler.add_probe("artifacts", self.artifacts.probe())
+        if self.device_plane is not None:
+            from seldon_core_tpu.runtime.device_plane import (
+                device_plane_probe,
+            )
+
+            sampler.add_probe("device_plane",
+                              device_plane_probe(self.device_plane))
         plan = self.engine.plan
         if plan is not None:
             for seg in plan.segments:
@@ -594,6 +631,16 @@ class LocalDeployment:
         for p in self.predictors:
             if p.artifacts is not None:
                 return p.artifacts
+        return None
+
+    @property
+    def device_plane(self):
+        """First device-plane-enabled predictor's plane (bench/tests
+        read the avoided-transfer counters through here — same
+        delegation rationale as ``tracer``/``health``)."""
+        for p in self.predictors:
+            if p.device_plane is not None:
+                return p.device_plane
         return None
 
     async def predict(self, msg):

@@ -30,6 +30,16 @@ returns an ``shm:`` ref any process on the host resolves with ONE H2D
 straight out of the mapping (no protobuf byte copy, no socket payload, no
 intermediate host copy).  Consumption unlinks the segment; producer-side
 reaping bounds leaks when a consumer dies.
+
+Steady-state edges (a long-lived framed connection between co-scheduled
+peers) use :class:`ShmChannel` instead: the same one-D2H/one-H2D
+contract, but the segment PERSISTS and is rewritten in place, so the
+per-message segment create/unlink (the dominant cost of ``put_shm`` at
+transport rates) is paid once per connection, not once per tensor.
+In-place reuse is race-free because the framed protocol is strict
+request/response per connection: the consumer has fully copied message N
+off the segment before the producer can possibly observe the reply that
+licenses writing N+1.
 """
 
 from __future__ import annotations
@@ -41,7 +51,13 @@ import uuid
 from collections import OrderedDict
 from typing import Any
 
-__all__ = ["DeviceBufferRegistry", "registry", "process_token"]
+__all__ = ["DeviceBufferRegistry", "ShmChannel", "registry",
+           "process_token", "host_token", "ForeignProcessRef", "SHM_PREFIX"]
+
+#: namespace prefix of every shm export — the orphan reaper scans it
+SHM_PREFIX = "seldon_dtr_"
+
+_HOST_TOKEN: "str | None" = None
 
 _BASE = uuid.uuid4().hex
 
@@ -55,8 +71,76 @@ def process_token() -> str:
     return f"{_BASE}-{os.getpid()}"
 
 
+def host_token() -> str:
+    """Machine identity for the same-host shm tier: two processes with
+    equal host tokens share a POSIX shm namespace, so an ``shm:`` ref is
+    resolvable between them.  Boot id (not hostname) — containers in one
+    pod share the kernel (and ``/dev/shm`` when mounted shared) but may
+    see different hostnames, while clones of a VM image share a hostname
+    without sharing memory."""
+    global _HOST_TOKEN
+    if _HOST_TOKEN is None:
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                _HOST_TOKEN = f.read().strip()
+        except OSError:
+            import socket
+
+            _HOST_TOKEN = socket.gethostname()
+    return _HOST_TOKEN
+
+
 class ForeignProcessRef(ValueError):
     """A DeviceTensorRef crossed a real process/transport boundary."""
+
+
+def _ref_layout(dtype_name: str, shape_csv: str):
+    """(shape, dtype) from the layout fields of an shm/channel ref."""
+    import numpy as np
+
+    shape = tuple(int(s) for s in shape_csv.split(",")) if shape_csv else ()
+    try:
+        dtype = np.dtype(dtype_name)
+    except TypeError:
+        # ml_dtypes families (bfloat16, float8_*, int4, ...) are not in
+        # numpy's registry by name
+        import ml_dtypes
+
+        dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+    return shape, dtype
+
+
+_CPU_BACKEND: "bool | None" = None
+
+
+def _cpu_backend() -> bool:
+    global _CPU_BACKEND
+    if _CPU_BACKEND is None:
+        import jax
+
+        _CPU_BACKEND = jax.default_backend() == "cpu"
+    return _CPU_BACKEND
+
+
+def _off_mapping(view):
+    """One copy off a HOST shm mapping onto the consumer's device — or,
+    on the CPU backend, a plain detached numpy copy: there is no device
+    to move to, and materializing a ``jax.Array`` there costs a full
+    PJRT buffer round trip (~150us on 200KB) for nothing.  Every caller
+    needs the copy anyway (one-shot resolution unmaps the segment;
+    channel resolution hands the buffer back to the producer)."""
+    import numpy as np
+
+    if _cpu_backend():
+        return np.array(view)
+    import jax
+    import jax.numpy as jnp
+
+    out = jnp.asarray(view)  # H2D directly from the mapping
+    # the H2D copy is ASYNC and PJRT holds the host buffer by reference
+    # only — it must complete before the mapping is reused or unmapped
+    jax.block_until_ready(out)
+    return out
 
 
 class DeviceBufferRegistry:
@@ -69,9 +153,15 @@ class DeviceBufferRegistry:
             OrderedDict()
         self._lock = threading.Lock()
         self._shm_exports: "OrderedDict[str, float]" = OrderedDict()
+        #: consumer-side channel attachments (lane name → SharedMemory);
+        #: bounded LRU — an evicted mapping just re-attaches on next use
+        self._shmc_cache: "OrderedDict[str, Any]" = OrderedDict()
+        self._shmc_cache_cap = 64
         self.metrics = metrics
         self._bytes = 0
         self._reaped = 0
+        #: direction → bytes moved (d2h/h2d) or not moved (avoided)
+        self._transfer_bytes: "dict[str, int]" = {}
 
     # -- observability ---------------------------------------------------
     def attach_metrics(self, metrics) -> None:
@@ -113,6 +203,30 @@ class DeviceBufferRegistry:
             except Exception:
                 pass
 
+    def _note_transfer(self, direction: str, nbytes: int) -> None:
+        """Bill a host↔device transfer the registry performed (``d2h`` on
+        ``put_shm``, ``h2d`` on shm resolution) or skipped entirely
+        (``avoided`` on a loopback ref resolution that hands back the
+        HBM handle).  Feeds
+        ``seldon_device_registry_transfer_bytes_total{direction}``."""
+        nbytes = int(nbytes)
+        with self._lock:
+            self._transfer_bytes[direction] = \
+                self._transfer_bytes.get(direction, 0) + nbytes
+        if self.metrics is not None and nbytes:
+            try:
+                self.metrics.counter_inc(
+                    "seldon_device_registry_transfer_bytes_total",
+                    {"direction": direction}, nbytes)
+            except Exception:
+                pass
+
+    @property
+    def transfer_bytes(self) -> dict:
+        """direction → cumulative bytes (``d2h``/``h2d``/``avoided``)."""
+        with self._lock:
+            return dict(self._transfer_bytes)
+
     # -- cross-process (same host): POSIX shared-memory staging ---------
     def put_shm(self, array: Any) -> str:
         """Export ``array`` for ANOTHER process on this host: one D2H into
@@ -145,6 +259,7 @@ class DeviceBufferRegistry:
         with self._lock:
             self._shm_exports[name] = now
             self._reap_shm(now)
+        self._note_transfer("d2h", host.nbytes)
         shape = ",".join(str(s) for s in host.shape)
         return f"shm:{name}:{host.dtype.name}:{shape}"
 
@@ -167,8 +282,50 @@ class DeviceBufferRegistry:
                 pass  # consumed
         self._export_locked()
 
-    @staticmethod
-    def _resolve_shm(ref: str) -> Any:
+    def reap_orphan_shm(self, max_age_s: "float | None" = None) -> int:
+        """Unlink ``shm:`` segments left behind by DEAD producers.
+
+        The in-process ``_reap_shm`` bounds leaks while the producer
+        lives; when the producer dies between ``put_shm`` and the
+        consumer's resolve, nobody unlinks and the segment outlives both
+        processes.  Called at process start (``operator/local.py``,
+        framed server boot): scan the host shm namespace for the
+        :data:`SHM_PREFIX` family and unlink anything older than
+        ``max_age_s`` (default: this registry's TTL) that this process
+        does not itself track.  Returns the number reaped; each counts
+        as ``kind="orphan"`` in ``seldon_device_registry_reaped_total``.
+        """
+        age_limit = self.ttl_s if max_age_s is None else float(max_age_s)
+        shm_dir = "/dev/shm"
+        try:
+            names = os.listdir(shm_dir)
+        except OSError:
+            return 0  # non-Linux shm namespace; nothing to scan
+        now = time.time()
+        reaped = 0
+        with self._lock:
+            own = set(self._shm_exports)
+        for name in names:
+            if not name.startswith(SHM_PREFIX) or name in own:
+                continue
+            path = os.path.join(shm_dir, name)
+            try:
+                age = now - os.stat(path).st_mtime
+            except OSError:
+                continue  # raced with a consumer's unlink
+            if age <= age_limit:
+                continue  # a live producer may still have a consumer coming
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            reaped += 1
+        if reaped:
+            with self._lock:
+                self._note_reaped_locked("orphan", reaped)
+        return reaped
+
+    def _resolve_shm(self, ref: str) -> Any:
         """Attach a same-host shm export, H2D straight from the mapping,
         unlink.  Works from ANY process on the host (that is the point)."""
         import numpy as np
@@ -178,16 +335,7 @@ class DeviceBufferRegistry:
             _, name, dtype_name, shape_csv = ref.split(":", 3)
         except ValueError:
             raise ValueError(f"malformed shm ref {ref!r}")
-        shape = tuple(int(s) for s in shape_csv.split(",")) if shape_csv \
-            else ()
-        try:
-            dtype = np.dtype(dtype_name)
-        except TypeError:
-            # ml_dtypes families (bfloat16, float8_*, int4, ...) are not in
-            # numpy's registry by name
-            import ml_dtypes
-
-            dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+        shape, dtype = _ref_layout(dtype_name, shape_csv)
         try:
             shm = shared_memory.SharedMemory(name=name)
         except FileNotFoundError:
@@ -196,25 +344,64 @@ class DeviceBufferRegistry:
                 "reaped, or producer on a different host)"
             )
         try:
-            import jax
-            import jax.numpy as jnp
-
-            view = np.ndarray(shape, dtype, buffer=shm.buf)
-            if jax.default_backend() == "cpu":
-                # CPU backend may ALIAS the numpy buffer zero-copy; the
-                # unlink below would unmap it under the live array
-                out = jnp.asarray(np.array(view))
-            else:
-                out = jnp.asarray(view)  # H2D directly from the mapping
-                # the H2D copy is ASYNC and PJRT holds the host buffer by
-                # reference only — it must complete before the munmap below
-                jax.block_until_ready(out)
+            out = _off_mapping(np.ndarray(shape, dtype, buffer=shm.buf))
         finally:
             shm.close()
             try:
                 shm.unlink()  # one-shot consume
             except FileNotFoundError:
                 pass
+        self._note_transfer("h2d", getattr(out, "nbytes", 0) or 0)
+        return out
+
+    # -- pooled same-host staging lanes (shmc:) -------------------------
+    def channel(self) -> "ShmChannel":
+        """A fresh producer-side staging lane (see :class:`ShmChannel`).
+        One per connection direction; the holder must ``close()`` it."""
+        return ShmChannel(self)
+
+    def _resolve_shmc(self, ref: str) -> Any:
+        """Copy a message off a peer's staging lane.  The mapping AND the
+        typed view over it are cached by lane name (attach and build
+        once per connection layout, not per message); the segment is
+        NEVER unlinked here — the producer owns its lifetime and reuses
+        the buffer for the next message."""
+        import numpy as np
+        from multiprocessing import shared_memory
+
+        try:
+            _, name, dtype_name, shape_csv, _gen = ref.split(":", 4)
+        except ValueError:
+            raise ValueError(f"malformed channel ref {ref!r}")
+        layout = f"{dtype_name}:{shape_csv}"
+        with self._lock:
+            entry = self._shmc_cache.get(name)
+            if entry is not None:
+                self._shmc_cache.move_to_end(name)
+        if entry is None or entry[1] != layout:
+            shape, dtype = _ref_layout(dtype_name, shape_csv)
+            if entry is not None:
+                shm = entry[0]  # same segment, new message layout
+            else:
+                try:
+                    shm = shared_memory.SharedMemory(name=name)
+                except FileNotFoundError:
+                    raise KeyError(
+                        f"shm DeviceTensorRef lane {name!r} not found "
+                        "(producer gone, lane closed, or reaped as an "
+                        "orphan); the sender must downgrade to bytes"
+                    )
+            entry = (shm, layout, np.ndarray(shape, dtype, buffer=shm.buf))
+            with self._lock:
+                self._shmc_cache[name] = entry
+                self._shmc_cache.move_to_end(name)
+                # evicted entries are DROPPED, not closed: a concurrent
+                # resolver may still be copying off the old view, and the
+                # mapping is reclaimed when the last view dies anyway
+                while len(self._shmc_cache) > self._shmc_cache_cap:
+                    self._shmc_cache.popitem(last=False)
+        out = _off_mapping(entry[2])
+        self._note_transfer("h2d", getattr(out, "nbytes", 0) or 0)
         return out
 
     def put(self, array: Any) -> str:
@@ -239,6 +426,10 @@ class DeviceBufferRegistry:
         return f"{process_token()}/{key}"
 
     def resolve(self, ref: str, consume: bool = True) -> Any:
+        if ref.startswith("shmc:"):
+            # channel messages are copied off the lane, never consumed —
+            # the producer reuses the segment; ``consume`` is meaningless
+            return self._resolve_shmc(ref)
         if ref.startswith("shm:"):
             if not consume:
                 raise ValueError(
@@ -265,11 +456,108 @@ class DeviceBufferRegistry:
                 del self._entries[key]
                 self._bytes -= entry[2]
                 self._export_locked()
+        # a loopback resolution hands back the HBM handle itself — the
+        # serialize→copy→deserialize round trip these bytes would have
+        # paid on the wire never happens
+        self._note_transfer("avoided", entry[2])
         return entry[0]
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+
+class ShmChannel:
+    """Producer side of a POOLED same-host staging lane.
+
+    ``put_shm`` pays a segment create + unlink per tensor — fine for
+    occasional handoffs, dominant at transport rates (the create alone
+    costs more than memcpying the 64x784 payload).  A channel keeps ONE
+    segment per connection direction and rewrites it in place:
+
+    - ``put(array)`` stages the tensor (one D2H) and returns a
+      ``shmc:<lane>:<dtype>:<shape>:<gen>`` ref; the segment grows (new
+      lane name, old one unlinked) when a payload outsizes it.  The gen
+      counter sits LAST so the lane/layout prefix — and the typed view
+      over the segment — are computed once per layout, not per message.
+    - the consumer's ``resolve`` COPIES the message off the lane and
+      caches the attachment; it never unlinks — the producer owns the
+      segment and ``close()`` unlinks it when the connection ends.
+
+    In-place reuse is safe only under strict request/response framing
+    (FramedClient / FramedComponentServer replies): the consumer has
+    fully copied message N off the lane before the producer can observe
+    the acknowledgement that licenses writing N+1.  Concurrent producers
+    must each hold their OWN channel — the framed clients serialize
+    ``put`` + round trip under their connection lock.
+
+    Lane names carry :data:`SHM_PREFIX`, so a crashed producer's lane is
+    collected by ``reap_orphan_shm`` at the next process boot on the
+    host.  A reaped-but-live lane degrades safely: the consumer's cached
+    mapping keeps working, and a fresh attach fails with the
+    ``DeviceTensorRef`` error marker that makes the sender downgrade to
+    bytes.
+    """
+
+    def __init__(self, owner: DeviceBufferRegistry):
+        self._owner = owner
+        self._shm = None
+        self._gen = 0
+        self._layout = None  # (shape, dtype) the cached view/prefix serve
+        self._view = None
+        self._prefix = ""
+
+    def put(self, array: Any) -> str:
+        """Stage ``array`` for the peer (one D2H into the lane)."""
+        import numpy as np
+        from multiprocessing import shared_memory
+
+        host = np.asarray(array)  # D2H (the only device hop on this side)
+        if host.dtype == object:
+            raise ValueError(
+                "shm DeviceTensorRef requires a numeric tensor (got object "
+                "dtype; ragged/str payloads must use the byte codecs)"
+            )
+        layout = (host.shape, host.dtype)
+        if self._shm is None or self._shm.size < host.nbytes:
+            self.close()
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=max(host.nbytes, 1),
+                name=f"{SHM_PREFIX}ch_{uuid.uuid4().hex[:16]}",
+            )
+        if layout != self._layout:
+            self._view = np.ndarray(host.shape, host.dtype,
+                                    buffer=self._shm.buf)
+            shape = ",".join(str(s) for s in host.shape)
+            self._prefix = (f"shmc:{self._shm.name}:"
+                            f"{host.dtype.name}:{shape}:")
+            self._layout = layout
+        self._view[...] = host
+        self._gen += 1
+        self._owner._note_transfer("d2h", host.nbytes)
+        return f"{self._prefix}{self._gen}"
+
+    def close(self) -> None:
+        """Unlink the lane (the consumer's cached mapping, if any, stays
+        valid until it is evicted — POSIX keeps unlinked segments alive
+        while mapped)."""
+        if self._shm is None:
+            return
+        self._layout = None
+        self._view = None  # release the exported buffer before close()
+        self._prefix = ""
+        self._shm.close()
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass  # orphan-reaped by another process's boot
+        self._shm = None
+
+    def __del__(self):  # best-effort: close() is the contract
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 registry = DeviceBufferRegistry()

@@ -53,12 +53,26 @@ class RemoteComponent:
         encoding: str = "ndarray",
         session: Optional[aiohttp.ClientSession] = None,
         methods: Sequence[str] = (),
+        route_meta_only: bool = False,
+        device_plane=None,
     ):
         """``timeout_s`` / ``connect_timeout_s`` are the reference's
         ``seldon.io/rest-read-timeout`` / ``rest-connection-timeout``
         annotations (docs/annotations.md:17-25 there), plumbed per
         deployment by operator/local.py — a read past the deadline sheds
-        with 504 DEADLINE_EXCEEDED instead of stalling the graph walk."""
+        with 504 DEADLINE_EXCEEDED instead of stalling the graph walk.
+
+        ``route_meta_only`` (from ``ModelSignature.routes_on == "meta"``)
+        strips the tensor from ``/route`` calls — the router's declared
+        contract is that the decision never reads values, so a
+        device-resident payload skips its D2H entirely.  ``device_plane``
+        (a ``runtime.device_plane.DevicePlane``) enables per-peer
+        ``deviceRef`` negotiation: once a response advertises the peer's
+        identity (``X-Seldon-Device-Plane``), payloads to an in-process
+        peer ride registry refs and same-host peers ride shm segments;
+        an unresolvable ref comes back as an explicit error and the
+        client permanently downgrades this peer to bytes — never a
+        silent wrong answer."""
         self.base_url = base_url.rstrip("/")
         self.name = name or self.base_url
         self.timeout = aiohttp.ClientTimeout(
@@ -68,6 +82,12 @@ class RemoteComponent:
         self._session = session
         self._own_session = session is None
         self._methods = set(methods)
+        self.route_meta_only = route_meta_only
+        self.device_plane = device_plane
+        #: latest peer identity header ("<process-token>|<host-token>")
+        self._peer_plane: Optional[str] = None
+        #: sticky bytes-only fallback after a failed ref resolution
+        self._device_disabled = False
 
     def has(self, method: str) -> bool:
         # without a declared methods list, assume the remote supports what
@@ -101,6 +121,9 @@ class RemoteComponent:
                 headers=headers,
             ) as resp:
                 raw = await resp.read()
+                peer = resp.headers.get("X-Seldon-Device-Plane")
+                if peer:
+                    self._peer_plane = peer
         except _ConnectTimeout as e:
             # connect-phase expiry (rest-connection-timeout) subclasses
             # asyncio.TimeoutError too, but an unreachable backend is
@@ -144,6 +167,87 @@ class RemoteComponent:
         finally:
             msg.encoding = prev
 
+    # ---- device-plane fast path ---------------------------------------
+    def _device_mode(self) -> str:
+        """Negotiated ref tier for THIS peer right now: ``loopback`` |
+        ``shm`` | ``off``.  Derived from the peer's advertised identity
+        (captured off every response) intersected with the plane's
+        ``remote`` cap — no identity seen yet means the first request
+        rides bytes and negotiation costs zero extra round trips."""
+        plane = self.device_plane
+        if plane is None or not plane.enabled or self._device_disabled:
+            return "off"
+        cap = plane.config.remote
+        if cap == "off" or not self._peer_plane:
+            return "off"
+        from seldon_core_tpu.runtime.device_registry import (
+            host_token,
+            process_token,
+        )
+
+        token, _, host = self._peer_plane.partition("|")
+        if token == process_token() and cap in ("auto", "loopback"):
+            return "loopback"
+        if host and host == host_token() and cap in ("auto", "shm"):
+            return "shm"
+        return "off"
+
+    def _encode_maybe_device(self, msg: SeldonMessage) -> "tuple[dict, bool]":
+        """Encode ``msg`` for the wire, riding a ``deviceRef`` instead of
+        tensor bytes when the peer negotiation allows it.  Returns
+        ``(payload, used_ref)`` so callers know a retry-as-bytes path
+        exists for this request."""
+        mode = self._device_mode()
+        if mode == "off" or msg.data is None:
+            return self._encode(msg), False
+        from seldon_core_tpu.messages import DeviceTensorRef
+        from seldon_core_tpu.runtime.device_registry import registry
+
+        plane = self.device_plane
+        nbytes = int(msg.nbytes or 0)
+        try:
+            if mode == "loopback":
+                ref = registry.put(msg.data)
+                # the serialize→socket→deserialize round trip for these
+                # bytes never happens; device payloads also skip the D2H
+                plane.note_avoided(
+                    "d2h" if msg.is_device_resident else "copy", nbytes)
+            else:
+                ref = registry.put_shm(msg.data)  # exactly one D2H
+        except ValueError:
+            # non-numeric payload (object dtype) — shm cannot carry it
+            plane.note_downgrade("dtype")
+            return self._encode(msg), False
+        plane.note_remote_ref(mode)
+        slim = SeldonMessage(names=list(msg.names), meta=msg.meta,
+                             status=msg.status)
+        payload = slim.to_dict()
+        payload["data"] = {
+            "names": list(msg.names),
+            "deviceRef": DeviceTensorRef(
+                ref=ref, shape=tuple(msg.shape or ()),
+                dtype=str(getattr(msg.data, "dtype", "") or ""),
+                nbytes=nbytes,
+            ).to_dict(),
+        }
+        return payload, True
+
+    async def _msg_call(self, path: str, msg: SeldonMessage) -> SeldonMessage:
+        payload, used_ref = self._encode_maybe_device(msg)
+        try:
+            return self._decode(await self._post(path, payload))
+        except SeldonComponentError as e:
+            if not used_ref or "DeviceTensorRef" not in str(e):
+                raise
+            # the peer could not resolve our ref (restarted process with a
+            # recycled identity, fork, unshared /dev/shm): downgrade this
+            # peer to bytes permanently and retry the SAME request — the
+            # payload is still in hand, so the caller sees one slower
+            # answer instead of a wrong or failed one
+            self.device_plane.note_downgrade("resolve-failed")
+            self._device_disabled = True
+            return self._decode(await self._post(path, self._encode(msg)))
+
     @staticmethod
     def _decode(d: dict) -> SeldonMessage:
         out = SeldonMessage.from_dict(d)
@@ -157,7 +261,7 @@ class RemoteComponent:
 
     # ---- component surface --------------------------------------------
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        return self._decode(await self._post("/predict", self._encode(msg)))
+        return await self._msg_call("/predict", msg)
 
     async def stream(self, msg: SeldonMessage):
         """Consume the remote component's SSE ``/stream`` route as an async
@@ -201,21 +305,40 @@ class RemoteComponent:
             )
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
-        return self._decode(await self._post("/transform-input", self._encode(msg)))
+        return await self._msg_call("/transform-input", msg)
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
-        return self._decode(await self._post("/transform-output", self._encode(msg)))
+        return await self._msg_call("/transform-output", msg)
 
     async def route(self, msg: SeldonMessage) -> int:
-        out = self._decode(await self._post("/route", self._encode(msg)))
+        if self.route_meta_only and msg.data is not None:
+            # the router's registered signature declares the decision
+            # reads meta/names only — skip the tensor serialization (and,
+            # for a device-resident payload, the D2H it would force)
+            if self.device_plane is not None and msg.is_device_resident:
+                self.device_plane.note_avoided("d2h", int(msg.nbytes or 0))
+            msg = SeldonMessage(names=list(msg.names), meta=msg.meta)
+        out = await self._msg_call("/route", msg)
         data = out.host_data()
         if data is None:
             return -1
         return int(data.ravel()[0])
 
     async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
-        payload = {"seldonMessages": [self._encode(m) for m in msgs]}
-        return self._decode(await self._post("/aggregate", payload))
+        encoded = [self._encode_maybe_device(m) for m in msgs]
+        payload = {"seldonMessages": [p for p, _ in encoded]}
+        try:
+            return self._decode(await self._post("/aggregate", payload))
+        except SeldonComponentError as e:
+            if not any(u for _, u in encoded) or "DeviceTensorRef" not in str(e):
+                raise
+            # refs the peer resolved before failing were consumed, but the
+            # source arrays are still in hand — re-encode everything as
+            # bytes (leaked refs age out via the registry TTL reaper)
+            self.device_plane.note_downgrade("resolve-failed")
+            self._device_disabled = True
+            payload = {"seldonMessages": [self._encode(m) for m in msgs]}
+            return self._decode(await self._post("/aggregate", payload))
 
     async def send_feedback(self, fb: Feedback) -> Optional[SeldonMessage]:
         d = await self._post("/send-feedback", fb.to_dict())

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import threading
 from typing import Optional
 
 import numpy as np
@@ -98,22 +99,185 @@ def _apply_blob(msg: SeldonMessage, blob: dict) -> SeldonMessage:
     return msg
 
 
+def _device_ref_entry(msg: SeldonMessage, mode: str, plane,
+                      lane=None) -> dict:
+    """Register ``msg.data`` for the peer and return the ``deviceRef``
+    meta-blob entry.  ``loopback`` hands the peer the in-process handle
+    (zero copies); ``shm`` stages exactly one D2H — onto the
+    connection's persistent staging ``lane`` when one is held (the
+    steady-state path: no segment create per message), else into a
+    fresh one-shot segment.  Raises ``ValueError`` for payloads shm
+    cannot carry (object dtype) — the caller downgrades to bytes."""
+    from seldon_core_tpu.runtime.device_registry import registry
+
+    nbytes = int(msg.nbytes or 0)
+    if mode == "loopback":
+        ref = registry.put(msg.data)
+        if plane is not None:
+            # the frame-serialize→socket→parse round trip never happens;
+            # device-resident payloads also skip their D2H
+            plane.note_avoided(
+                "d2h" if msg.is_device_resident else "copy", nbytes)
+    elif lane is not None:
+        ref = lane.put(msg.data)
+    else:
+        ref = registry.put_shm(msg.data)
+    if plane is not None:
+        plane.note_remote_ref(mode)
+    # inline DeviceTensorRef(...).to_dict() — this sits on the per-message
+    # hot path and the dataclass round trip costs more than the whole dict
+    return {
+        "ref": ref,
+        "shape": list(msg.shape or ()),
+        "dtype": str(getattr(msg.data, "dtype", "") or ""),
+        "nbytes": nbytes,
+    }
+
+
 def encode_message(
-    codec: FrameCodec, msg: SeldonMessage, msg_type: int = MSG_PREDICT
+    codec: FrameCodec, msg: SeldonMessage, msg_type: int = MSG_PREDICT,
+    device_mode: str = "off", device_plane=None, device_lane=None,
 ) -> bytes:
     tensors = []
+    blob = _meta_blob(msg)
     if msg.data is not None:
-        tensors.append(np.ascontiguousarray(msg.host_data()))
-    meta = json.dumps(_meta_blob(msg)).encode()
+        if device_mode in ("loopback", "shm"):
+            try:
+                blob["deviceRef"] = _device_ref_entry(
+                    msg, device_mode, device_plane, lane=device_lane)
+            except ValueError:
+                if device_plane is not None:
+                    device_plane.note_downgrade("dtype")
+                tensors.append(np.ascontiguousarray(msg.host_data()))
+        else:
+            tensors.append(np.ascontiguousarray(msg.host_data()))
+    meta = json.dumps(blob).encode()
     return codec.encode(msg_type, meta=meta, tensors=tensors)
 
 
-def decode_message(frame: Frame) -> SeldonMessage:
+def decode_message(frame: Frame, device_plane=None) -> SeldonMessage:
     blob = json.loads(frame.meta) if frame.meta else {}
     msg = SeldonMessage(encoding="binTensor")
-    if frame.tensors:
+    wire_mode = "off"
+    peer_lane = ""
+    dref = blob.pop("deviceRef", None)
+    if dref is not None:
+        from seldon_core_tpu.runtime.device_registry import registry
+
+        ref = str(dref.get("ref", ""))
+        # raises ForeignProcessRef/KeyError when the ref cannot resolve
+        # here — the server's error channel carries it back to the sender
+        # (which downgrades and retries as bytes), never a silent empty
+        # message
+        msg.data = registry.resolve(ref)
+        if ref.startswith("shmc:"):
+            wire_mode = "shm"
+            peer_lane = ref.split(":", 2)[1]
+        elif ref.startswith("shm:"):
+            wire_mode = "shm"
+        else:
+            wire_mode = "loopback"
+        if device_plane is not None and wire_mode == "loopback":
+            device_plane.note_donation()  # one-shot consume freed producer
+    elif frame.tensors:
         msg.data = frame.tensors[0]
-    return _apply_blob(msg, blob)
+    _apply_blob(msg, blob)
+    # transport-internal: lets a server answer in the tier the request
+    # arrived on (a resolvable inbound ref proves the return path works);
+    # a named peer lane keys the server's pooled reply lane
+    msg.device_wire_mode = wire_mode
+    msg.device_peer_lane = peer_lane
+    return msg
+
+
+class _ReplyLanes:
+    """Server-side pool of reply staging lanes, keyed by the CLIENT's
+    inbound lane name (one client connection = one inbound lane = one
+    reply lane, strict request/response on both).  Bounded LRU: an
+    evicted lane just re-creates on the client's next request."""
+
+    def __init__(self, cap: int = 128):
+        self._lanes: "dict[str, object]" = {}
+        self._order: list = []
+        self._cap = cap
+        self._lock = threading.Lock()
+
+    def get(self, peer: str):
+        from seldon_core_tpu.runtime.device_registry import registry
+
+        with self._lock:
+            lane = self._lanes.get(peer)
+            if lane is None:
+                lane = registry.channel()
+                self._lanes[peer] = lane
+            else:
+                self._order.remove(peer)
+            self._order.append(peer)
+            evicted = []
+            while len(self._order) > self._cap:
+                old = self._order.pop(0)
+                evicted.append(self._lanes.pop(old))
+        for lane_ in evicted:
+            lane_.close()
+        return lane
+
+    def close_all(self) -> None:
+        with self._lock:
+            lanes, self._lanes, self._order = \
+                list(self._lanes.values()), {}, []
+        for lane in lanes:
+            lane.close()
+
+
+def _plane_hello_msg() -> SeldonMessage:
+    from seldon_core_tpu.runtime.device_registry import (
+        host_token,
+        process_token,
+    )
+
+    return SeldonMessage(json_data={"devicePlaneHello": {
+        "token": process_token(), "host": host_token()}})
+
+
+def _is_plane_hello(msg: SeldonMessage) -> bool:
+    return isinstance(msg.json_data, dict) and "devicePlaneHello" in msg.json_data
+
+
+def _plane_hello_reply() -> SeldonMessage:
+    from seldon_core_tpu.runtime.device_registry import (
+        host_token,
+        process_token,
+    )
+
+    return SeldonMessage(json_data={"devicePlane": {
+        "token": process_token(), "host": host_token()}})
+
+
+def _pick_device_mode(reply: SeldonMessage, plane) -> str:
+    """Client side of the negotiation: intersect the server's advertised
+    identity with our own and the plane's ``remote`` cap.  An old server
+    answers the hello like any predict (no ``devicePlane`` key) and
+    negotiates to ``off`` — the plane never assumes a capable peer."""
+    from seldon_core_tpu.runtime.device_registry import (
+        host_token,
+        process_token,
+    )
+
+    info = None
+    if isinstance(reply.json_data, dict):
+        info = reply.json_data.get("devicePlane")
+    if not isinstance(info, dict):
+        if plane is not None:
+            plane.note_downgrade("negotiation")
+        return "off"
+    cap = plane.config.remote if plane is not None else "auto"
+    if info.get("token") == process_token() and cap in ("auto", "loopback"):
+        return "loopback"
+    if info.get("host") == host_token() and cap in ("auto", "shm"):
+        return "shm"
+    if plane is not None:
+        plane.note_downgrade("foreign-process")
+    return "off"
 
 
 def encode_feedback(codec: FrameCodec, fb: Feedback) -> bytes:
@@ -192,10 +356,28 @@ def _writable(msg: SeldonMessage) -> None:
 class FramedComponentServer:
     """Serve a ComponentHandle (or GraphEngine) over the framed protocol."""
 
-    def __init__(self, target, port: int = 0, bind: str = "127.0.0.1"):
+    def __init__(self, target, port: int = 0, bind: str = "127.0.0.1",
+                 device_plane=None):
         self._codec = FrameCodec()
         self._target = target
         self._server = FramedServer(self._handle, port=port, bind=bind)
+        self.device_plane = device_plane
+        self._reply_lanes = _ReplyLanes()
+        if device_plane is not None and device_plane.enabled:
+            # a dead producer's shm exports outlive both processes; boot
+            # is the natural reap point (docs/device-plane.md)
+            from seldon_core_tpu.runtime.device_registry import registry
+
+            registry.reap_orphan_shm()
+
+    def _reply_mode(self, msg: SeldonMessage) -> str:
+        """Answer in the tier the request arrived on: an inbound ref that
+        resolved proves the reverse path resolves too (same process or
+        same shm namespace).  Requires this server's plane to be on —
+        a plane-less server always replies bytes."""
+        if self.device_plane is None or not self.device_plane.enabled:
+            return "off"
+        return getattr(msg, "device_wire_mode", "off")
 
     def _handle(self, req: bytes) -> bytes:
         try:
@@ -203,10 +385,24 @@ class FramedComponentServer:
             if frame.msg_type == MSG_FEEDBACK:
                 fb = decode_feedback(frame)
                 out = self._dispatch_feedback(fb)
+                reply_mode = "off"
             else:
-                msg = decode_message(frame)
+                msg = decode_message(frame, self.device_plane)
+                if _is_plane_hello(msg):
+                    return encode_message(
+                        self._codec, _plane_hello_reply(), MSG_RESPONSE)
                 out = self._dispatch_predict(msg)
-            return encode_message(self._codec, out, MSG_RESPONSE)
+                reply_mode = self._reply_mode(msg)
+            lane = None
+            if reply_mode == "shm" and getattr(msg, "device_peer_lane", ""):
+                # pooled request ⇒ pooled reply: reuse the lane keyed by
+                # the client's inbound lane (strict request/response on
+                # this connection makes in-place reuse safe)
+                lane = self._reply_lanes.get(msg.device_peer_lane)
+            return encode_message(self._codec, out, MSG_RESPONSE,
+                                  device_mode=reply_mode,
+                                  device_plane=self.device_plane,
+                                  device_lane=lane)
         except Exception as e:  # noqa: BLE001 — all errors go on the wire
             err = SeldonMessage(status=Status.failure(500, str(e)))
             return encode_message(self._codec, err, MSG_ERROR)
@@ -239,6 +435,7 @@ class FramedComponentServer:
 
     def stop(self) -> None:
         self._server.stop()
+        self._reply_lanes.close_all()
 
     def __enter__(self) -> "FramedComponentServer":
         return self.start()
@@ -265,12 +462,19 @@ class AsyncFramedComponentServer:
     parallelism, see AsyncFramedClient/FramedDriver).
     """
 
-    def __init__(self, target, port: int = 0, bind: str = "127.0.0.1"):
+    def __init__(self, target, port: int = 0, bind: str = "127.0.0.1",
+                 device_plane=None):
         self._codec = FrameCodec()
         self._target = target
         self._port_req = port
         self._bind = bind
         self._server: Optional[object] = None
+        self.device_plane = device_plane
+        self._reply_lanes = _ReplyLanes()
+        if device_plane is not None and device_plane.enabled:
+            from seldon_core_tpu.runtime.device_registry import registry
+
+            registry.reap_orphan_shm()
 
     async def start(self) -> "AsyncFramedComponentServer":
         import asyncio
@@ -289,6 +493,7 @@ class AsyncFramedComponentServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        self._reply_lanes.close_all()
 
     async def __aenter__(self) -> "AsyncFramedComponentServer":
         return await self.start()
@@ -328,12 +533,25 @@ class AsyncFramedComponentServer:
                     if part is not None:
                         _writable(part)
                 out = await self._feedback(fb)
+                reply_mode = "off"
             else:
-                msg = decode_message(frame)
+                msg = decode_message(frame, self.device_plane)
+                if _is_plane_hello(msg):
+                    return encode_message(
+                        self._codec, _plane_hello_reply(), MSG_RESPONSE)
                 _writable(msg)
                 with _bind_trace(msg):
                     out = await self._predict(msg)
-            return encode_message(self._codec, out, MSG_RESPONSE)
+                reply_mode = "off"
+                if self.device_plane is not None and self.device_plane.enabled:
+                    reply_mode = getattr(msg, "device_wire_mode", "off")
+            lane = None
+            if reply_mode == "shm" and getattr(msg, "device_peer_lane", ""):
+                lane = self._reply_lanes.get(msg.device_peer_lane)
+            return encode_message(self._codec, out, MSG_RESPONSE,
+                                  device_mode=reply_mode,
+                                  device_plane=self.device_plane,
+                                  device_lane=lane)
         except Exception as e:  # noqa: BLE001 — all errors go on the wire
             err = SeldonMessage(status=Status.failure(500, str(e)))
             return encode_message(self._codec, err, MSG_ERROR)
@@ -363,21 +581,43 @@ class AsyncFramedClient:
     executor hop per request, so a pool of these saturates the native epoll
     server from a single-core host."""
 
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, device_plane=None):
         self._codec = FrameCodec()
         self._reader = None
         self._writer = None
         self._lock = None  # created on connect (needs the running loop)
         self._timeout = timeout  # parity with FramedClient's socket timeout
+        self._device_plane = device_plane
+        self._device_mode = "off"
+        self._lane = None
+        self._lane_lock = None  # created on connect, like _lock
 
     async def connect(self, host: str = "127.0.0.1", port: int = 0) -> "AsyncFramedClient":
         import asyncio
 
         self._reader, self._writer = await asyncio.open_connection(host, port)
         self._lock = asyncio.Lock()
+        self._lane_lock = asyncio.Lock()
         sock = self._writer.get_extra_info("socket")
         if sock is not None:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        plane = self._device_plane
+        if plane is not None and plane.enabled and plane.config.remote != "off":
+            # one hello round trip decides the ref tier for the whole
+            # connection; any failure (old server treats the hello as a
+            # predict and errors, or answers without a devicePlane key)
+            # negotiates to bytes
+            try:
+                reply = decode_message(await self._roundtrip(encode_message(
+                    self._codec, _plane_hello_msg(), MSG_PREDICT)))
+                self._device_mode = _pick_device_mode(reply, plane)
+            except Exception:
+                plane.note_downgrade("negotiation")
+                self._device_mode = "off"
+        if self._device_mode == "shm":
+            from seldon_core_tpu.runtime.device_registry import registry
+
+            self._lane = registry.channel()
         return self
 
     async def _roundtrip(self, payload: bytes) -> Frame:
@@ -405,11 +645,35 @@ class AsyncFramedClient:
         return frame
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        return decode_message(
-            await self._roundtrip(
-                encode_message(self._codec, _traced_copy(msg), MSG_PREDICT)
+        # staging onto the connection's lane must be serialized with the
+        # round trip that licenses its reuse (the reply proves the server
+        # copied the message off the lane) — concurrent callers would
+        # otherwise overwrite each other's in-flight payload
+        async with self._lane_lock:
+            payload = encode_message(
+                self._codec, _traced_copy(msg), MSG_PREDICT,
+                device_mode=self._device_mode,
+                device_plane=self._device_plane, device_lane=self._lane,
             )
-        )
+            try:
+                return decode_message(await self._roundtrip(payload),
+                                      self._device_plane)
+            except RuntimeError as e:
+                if self._device_mode == "off" \
+                        or "DeviceTensorRef" not in str(e):
+                    raise
+                # the peer could not resolve our ref — permanent downgrade
+                # to bytes on this connection, retry the same request
+                self._device_plane.note_downgrade("resolve-failed")
+                self._device_mode = "off"
+                if self._lane is not None:
+                    self._lane.close()
+                    self._lane = None
+                return decode_message(
+                    await self._roundtrip(encode_message(
+                        self._codec, _traced_copy(msg), MSG_PREDICT)),
+                    self._device_plane,
+                )
 
     async def send_feedback(self, fb: Feedback) -> SeldonMessage:
         return decode_message(
@@ -417,6 +681,9 @@ class AsyncFramedClient:
         )
 
     def close(self) -> None:
+        if self._lane is not None:
+            self._lane.close()
+            self._lane = None
         if self._writer is not None:
             self._writer.close()
 
@@ -433,11 +700,31 @@ class FramedClient:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout: Optional[float] = 30.0):
+                 timeout: Optional[float] = 30.0, device_plane=None):
         self._codec = FrameCodec()
         self._timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._device_plane = device_plane
+        self._device_mode = "off"
+        self._lane = None
+        self._lane_lock = threading.Lock()
+        if device_plane is not None and device_plane.enabled \
+                and device_plane.config.remote != "off":
+            try:
+                reply = decode_message(self._roundtrip(encode_message(
+                    self._codec, _plane_hello_msg(), MSG_PREDICT)))
+                self._device_mode = _pick_device_mode(reply, device_plane)
+            except Exception:
+                device_plane.note_downgrade("negotiation")
+                self._device_mode = "off"
+        if self._device_mode == "shm":
+            from seldon_core_tpu.runtime.device_registry import registry
+
+            # persistent staging lane for this connection's requests —
+            # one segment rewritten per message instead of a
+            # create/unlink round trip per tensor
+            self._lane = registry.channel()
 
     def _roundtrip(self, payload: bytes,
                    timeout: Optional[float] = None) -> Frame:
@@ -475,11 +762,37 @@ class FramedClient:
 
     def predict(self, msg: SeldonMessage,
                 timeout: Optional[float] = None) -> SeldonMessage:
-        return decode_message(
-            self._roundtrip(
-                encode_message(self._codec, _traced_copy(msg), MSG_PREDICT),
-                timeout=timeout)
-        )
+        # the lane is rewritten in place, so staging message N+1 must not
+        # start before N's reply proves the server copied N off the lane
+        with self._lane_lock:
+            payload = encode_message(
+                self._codec, _traced_copy(msg), MSG_PREDICT,
+                device_mode=self._device_mode,
+                device_plane=self._device_plane, device_lane=self._lane,
+            )
+            try:
+                return decode_message(
+                    self._roundtrip(payload, timeout=timeout),
+                    self._device_plane)
+            except RuntimeError as e:
+                if self._device_mode == "off" \
+                        or "DeviceTensorRef" not in str(e):
+                    raise
+                self._device_plane.note_downgrade("resolve-failed")
+                self._device_mode = "off"
+                self._close_lane()
+                return decode_message(
+                    self._roundtrip(
+                        encode_message(self._codec, _traced_copy(msg),
+                                       MSG_PREDICT),
+                        timeout=timeout),
+                    self._device_plane,
+                )
+
+    def _close_lane(self) -> None:
+        if self._lane is not None:
+            self._lane.close()
+            self._lane = None
 
     def send_feedback(self, fb: Feedback,
                       timeout: Optional[float] = None) -> SeldonMessage:
@@ -496,6 +809,7 @@ class FramedClient:
         return self._recv_exact(n)
 
     def close(self) -> None:
+        self._close_lane()
         try:
             self._sock.close()
         except OSError:

@@ -61,14 +61,30 @@ def _err_json(code: int, info: str, reason: str = "") -> str:
     return SeldonMessage(status=Status.failure(code, info, reason)).to_json()
 
 
+#: response header advertising this process's device-plane identity
+#: (``<process-token>|<host-token>``) so a plane-enabled RemoteComponent
+#: can negotiate the loopback/shm fast path without an extra handshake
+#: round trip — a non-advertising (older) peer simply never gets refs
+DEVICE_PLANE_HEADER = "X-Seldon-Device-Plane"
+
+
+def _plane_identity() -> str:
+    from seldon_core_tpu.runtime.device_registry import (
+        host_token,
+        process_token,
+    )
+
+    return f"{process_token()}|{host_token()}"
+
+
 def _msg_response(msg: SeldonMessage) -> web.Response:
     code = 200
     if msg.status is not None and msg.status.status == "FAILURE":
         code = msg.status.code if 400 <= msg.status.code < 600 else 500
-    headers = None
+    headers = {DEVICE_PLANE_HEADER: _plane_identity()}
     if code == 429:
         # shed answers (admission / queue-full) always carry a retry hint
-        headers = {"Retry-After": "1"}
+        headers["Retry-After"] = "1"
     return web.Response(
         text=msg.to_json(), content_type="application/json", status=code,
         headers=headers,

@@ -41,6 +41,7 @@ __all__ = [
     "replay_record",
     "canonical_body",
     "artifact_source",
+    "device_plane_tag",
     "compare_responses",
     "main",
 ]
@@ -172,6 +173,24 @@ def artifact_source(body: bytes) -> str:
     return ""
 
 
+def device_plane_tag(body: bytes) -> str:
+    """The engine's device-plane stamp from a response body:
+    ``meta.tags["device-plane"]`` is ``"on"`` when the answering engine
+    served with the device-resident tensor plane enabled, ``""``
+    otherwise.  Like ``artifact_source``, read BEFORE canonicalization —
+    the stamp is volatile meta, so a plane-on response still compares
+    byte-parity-equal against a plane-off one (that equality IS the
+    plane's correctness proof)."""
+    try:
+        doc = json.loads(body)
+    except ValueError:
+        return ""
+    meta = doc.get("meta") if isinstance(doc, dict) else None
+    if isinstance(meta, dict) and isinstance(meta.get("tags"), dict):
+        return str(meta["tags"].get("device-plane", ""))
+    return ""
+
+
 def compare_responses(a: bytes, b: bytes, strict: bool = False
                       ) -> Tuple[bool, str]:
     """Parity verdict for two response bodies: ``(equal, detail)``."""
@@ -225,6 +244,14 @@ def main(argv: Optional[list] = None) -> int:
                          "stamp): 'aot-cache' proves a warm start — "
                          "every dispatched bucket hydrated from the "
                          "artifact store — 'live' proves a cold one")
+    ap.add_argument("--expect-device-plane",
+                    choices=["on", "off"], default="",
+                    help="assert the replay target's device-plane "
+                         "posture (meta.tags device-plane stamp): 'on' "
+                         "proves tensors rode HBM handles across "
+                         "interpreter-boundary edges, 'off' proves the "
+                         "host-copy baseline — pair with --compare to "
+                         "prove plane-on ≡ plane-off byte parity")
     ap.add_argument("--timeout", type=float, default=30.0)
     args = ap.parse_args(argv)
 
@@ -256,6 +283,14 @@ def main(argv: Optional[list] = None) -> int:
                   f"{got!r}", file=sys.stderr)
             return 1
         print(f"artifact-source: {got} (as expected)")
+    if args.expect_device_plane:
+        got = device_plane_tag(body) or "off"
+        if got != args.expect_device_plane:
+            print(f"device-plane: MISMATCH — expected "
+                  f"{args.expect_device_plane!r}, response stamped "
+                  f"{got!r}", file=sys.stderr)
+            return 1
+        print(f"device-plane: {got} (as expected)")
     if not args.compare:
         print(body.decode("utf-8", "replace")[:2000])
         return 0 if status < 400 else 1

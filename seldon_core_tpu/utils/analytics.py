@@ -333,6 +333,31 @@ CATALOG: tuple[MetricInfo, ...] = (
         ("probe",),
     ),
     MetricInfo(
+        "seldon_runtime_device_plane_transfers_avoided", "gauge",
+        "Device-plane avoided host transfers (all kinds) at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_plane_bytes_avoided", "gauge",
+        "Device-plane avoided transfer bytes (all kinds) at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_plane_remote_refs", "gauge",
+        "Device refs minted for remote edges at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_plane_downgrades", "gauge",
+        "Device-plane downgrades to the byte wire at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
+        "seldon_runtime_device_plane_donations", "gauge",
+        "One-shot device-ref donations at sample time",
+        ("probe",),
+    ),
+    MetricInfo(
         "seldon_runtime_sampler_ticks", "gauge",
         "Introspection samples taken since process start (a flat line "
         "means the sampler died — alert on it, it is the watchdog's "
@@ -487,8 +512,54 @@ CATALOG: tuple[MetricInfo, ...] = (
     MetricInfo(
         "seldon_device_registry_reaped_total", "counter",
         "Registry entries reaped (kind=entry on TTL/capacity eviction, "
-        "kind=shm for orphaned shared-memory segments)",
+        "kind=shm for this process's unconsumed shared-memory exports, "
+        "kind=orphan for dead producers' segments swept at boot)",
         ("kind",),
+    ),
+    MetricInfo(
+        "seldon_device_registry_transfer_bytes_total", "counter",
+        "Host↔device bytes the registry moved (direction=d2h on "
+        "put_shm, direction=h2d on shm resolution) or skipped entirely "
+        "(direction=avoided on loopback resolutions that hand back the "
+        "HBM handle) — the device plane's transfer ledger",
+        ("direction",),
+    ),
+    # -- device-resident tensor plane (docs/device-plane.md): HBM
+    #    handles across interpreter-boundary graph edges ----------------
+    MetricInfo(
+        "seldon_device_plane_transfers_avoided_total", "counter",
+        "Host transfers the device plane skipped (kind=d2h for "
+        "device→host materializations, kind=h2d for re-uploads, "
+        "kind=copy for defensive host copies replaced by immutable HBM "
+        "handles)",
+        ("kind",),
+    ),
+    MetricInfo(
+        "seldon_device_plane_bytes_avoided_total", "counter",
+        "Bytes those avoided transfers would have moved (same kind "
+        "labels as seldon_device_plane_transfers_avoided_total)",
+        ("kind",),
+    ),
+    MetricInfo(
+        "seldon_device_plane_remote_refs_total", "counter",
+        "Remote graph edges served by a DeviceTensorRef instead of "
+        "tensor bytes (mode=loopback for in-process registry refs, "
+        "mode=shm for same-host shared-memory staging)",
+        ("mode",),
+    ),
+    MetricInfo(
+        "seldon_device_plane_downgrades_total", "counter",
+        "Remote edges that fell back to the byte wire (reason="
+        "negotiation|foreign-process|resolve-failed|dtype|policy; a "
+        "silent downgrade would look exactly like a plane that does "
+        "not work — alert on a nonzero rate)",
+        ("reason",),
+    ),
+    MetricInfo(
+        "seldon_device_plane_donations_total", "counter",
+        "One-shot device refs consumed (the producer's buffer is "
+        "donated to the consumer and freed from the registry)",
+        (),
     ),
     # -- placement plane (docs/sharding.md): device meshes, HBM-aware
     #    segment placement, dp-sharded fused-segment execution ----------
@@ -1090,6 +1161,17 @@ def grafana_dashboard() -> dict:
                 "by (deployment, device)",
                 "max(seldon_placement_tp_bytes_per_device) "
                 "by (deployment, segment)"], y=88, x=12, unit="bytes"),
+        _panel(25, "Device plane: avoided transfer bytes + remote refs",
+               ["sum(rate(seldon_device_plane_bytes_avoided_total[5m])) "
+                "by (kind)",
+                "sum(rate(seldon_device_plane_remote_refs_total[5m])) "
+                "by (mode)"], y=96, x=0, unit="bytes"),
+        _panel(26, "Device plane: downgrades + registry transfer ledger",
+               ["sum(rate(seldon_device_plane_downgrades_total[5m])) "
+                "by (reason)",
+                "sum(rate("
+                "seldon_device_registry_transfer_bytes_total[5m])) "
+                "by (direction)"], y=96, x=12),
     ]
     return {
         "title": "Seldon Core TPU — Prediction Analytics",

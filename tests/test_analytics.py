@@ -36,6 +36,9 @@ def test_catalog_covers_every_emitted_metric():
         # legacy checkpoint metadata key kept for loading old artifacts
         # (runtime/checkpoint.py load fallback), not a metric
         "seldon_checkpoint",
+        # shm segment name prefix (runtime/device_registry.py SHM_PREFIX),
+        # not a metric
+        "seldon_dtr_",
     }
     # exposition suffixes (_bucket/_count/_sum) name series of a histogram,
     # not distinct metrics

@@ -1,0 +1,561 @@
+"""Device-resident tensor plane: HBM handles across interpreter-boundary
+graph edges (docs/device-plane.md).
+
+Registry semantics (one-shot exactly-once, capacity bounds, fork/process
+scoping, shm staging + pooled lanes), plane config/counters, the engine's
+meta-only route, framed negotiation + downgrade-retry, and the GL17xx
+admission lints are each pinned here; end-to-end parity and the
+performance floors live in ``bench.py --device-plane-smoke``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.engine import GraphEngine
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.component import ComponentHandle
+from seldon_core_tpu.runtime.device_plane import (
+    DevicePlane,
+    DevicePlaneConfig,
+    device_plane_config_from_annotations,
+)
+from seldon_core_tpu.runtime.device_registry import (
+    SHM_PREFIX,
+    DeviceBufferRegistry,
+    ForeignProcessRef,
+    process_token,
+)
+
+
+def _arr(shape=(4, 8), seed=0, dtype=np.float32):
+    return np.random.default_rng(seed).normal(size=shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# registry: loopback refs
+# ---------------------------------------------------------------------------
+
+
+def test_one_shot_resolve_is_exactly_once_under_concurrency():
+    r = DeviceBufferRegistry()
+    ref = r.put(_arr())
+    got, errs = [], []
+    start = threading.Barrier(16)
+
+    def worker():
+        start.wait()
+        try:
+            got.append(r.resolve(ref))
+        except KeyError as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(got) == 1 and len(errs) == 15
+    assert len(r) == 0 and r.nbytes == 0
+
+
+def test_capacity_eviction_bills_reaped_counter():
+    r = DeviceBufferRegistry(capacity=2)
+    refs = [r.put(_arr(seed=i)) for i in range(4)]
+    assert len(r) == 2
+    assert r.reaped == 2
+    for ref in refs[:2]:  # oldest two were evicted, never consumed
+        with pytest.raises(KeyError):
+            r.resolve(ref)
+    for ref in refs[2:]:
+        assert r.resolve(ref) is not None
+    assert r.nbytes == 0
+
+
+def test_foreign_process_ref_rejected_with_downgrade_marker():
+    r = DeviceBufferRegistry()
+    token = process_token()
+    # same pid, different process base — what a ref minted before a fork
+    # (or on another host) looks like to this process
+    foreign = f"not-{token}/deadbeef"
+    with pytest.raises(ForeignProcessRef) as ei:
+        r.resolve(foreign)
+    # the marker is the downgrade contract: framed clients retry as bytes
+    # exactly when the remote error names DeviceTensorRef
+    assert "DeviceTensorRef" in str(ei.value)
+
+
+def test_fork_scoping_is_pid_sensitive(monkeypatch):
+    r = DeviceBufferRegistry()
+    ref = r.put(_arr())
+    import seldon_core_tpu.runtime.device_registry as dr
+
+    # a forked child inherits _BASE but gets a fresh pid; its view of the
+    # parent's ref must reject (the HBM handle did not survive the fork)
+    real_pid = os.getpid()
+    monkeypatch.setattr(dr.os, "getpid", lambda: real_pid + 1)
+    with pytest.raises(ForeignProcessRef):
+        r.resolve(ref)
+    monkeypatch.undo()
+    np.testing.assert_array_equal(np.asarray(r.resolve(ref)), _arr())
+
+
+def test_non_consuming_resolve_keeps_entry():
+    r = DeviceBufferRegistry()
+    ref = r.put(_arr())
+    a = r.resolve(ref, consume=False)
+    b = r.resolve(ref)
+    assert a is b
+    with pytest.raises(KeyError):
+        r.resolve(ref)
+
+
+# ---------------------------------------------------------------------------
+# registry: shm staging (one-shot) + transfer ledger
+# ---------------------------------------------------------------------------
+
+
+def test_shm_round_trip_unlinks_on_consume():
+    r = DeviceBufferRegistry()
+    x = _arr((16, 32), seed=3)
+    ref = r.put_shm(x)
+    assert ref.startswith("shm:")
+    name = ref.split(":", 2)[1]
+    assert os.path.exists(f"/dev/shm/{name}")
+    out = r.resolve(ref)
+    np.testing.assert_array_equal(np.asarray(out), x)
+    assert not os.path.exists(f"/dev/shm/{name}")  # one-shot consume
+    with pytest.raises(KeyError) as ei:
+        r.resolve(ref)
+    assert "DeviceTensorRef" in str(ei.value)
+
+
+def test_shm_rejects_object_dtype():
+    r = DeviceBufferRegistry()
+    with pytest.raises(ValueError):
+        r.put_shm(np.array([{"a": 1}], dtype=object))
+
+
+def test_transfer_bytes_ledger():
+    r = DeviceBufferRegistry()
+    x = _arr((8, 8))
+    r.resolve(r.put_shm(x))
+    assert r.transfer_bytes["d2h"] == x.nbytes
+    assert r.transfer_bytes["h2d"] == x.nbytes
+    r.resolve(r.put(x))  # loopback: the wire copy never happens
+    assert r.transfer_bytes["avoided"] == x.nbytes
+
+
+def test_orphan_reap_collects_dead_producers_segments():
+    from multiprocessing import shared_memory
+
+    r = DeviceBufferRegistry()
+    name = f"{SHM_PREFIX}orphan_test_{os.getpid()}"
+    seg = shared_memory.SharedMemory(create=True, size=64, name=name)
+    seg.close()
+    old = time.time() - 3600
+    os.utime(f"/dev/shm/{name}", (old, old))
+    before = r.reaped
+    # high age limit: only the artificially aged segment qualifies, not
+    # live lanes other tests (or a parallel run) may hold
+    assert r.reap_orphan_shm(max_age_s=1800) >= 1
+    assert not os.path.exists(f"/dev/shm/{name}")
+    assert r.reaped > before
+
+
+# ---------------------------------------------------------------------------
+# registry: pooled staging lanes (ShmChannel)
+# ---------------------------------------------------------------------------
+
+
+def test_channel_round_trip_reuses_one_segment():
+    r = DeviceBufferRegistry()
+    lane = r.channel()
+    try:
+        a, b = _arr(seed=1), _arr(seed=2)
+        ref1 = lane.put(a)
+        assert ref1.startswith("shmc:")
+        lane_name = ref1.split(":", 2)[1]
+        assert lane_name.startswith(SHM_PREFIX)  # orphan reaper covers it
+        np.testing.assert_array_equal(np.asarray(r.resolve(ref1)), a)
+        # same lane rewritten in place: same segment name, bumped gen
+        ref2 = lane.put(b)
+        assert ref2.split(":", 2)[1] == lane_name
+        assert int(ref2.rsplit(":", 1)[1]) == int(ref1.rsplit(":", 1)[1]) + 1
+        np.testing.assert_array_equal(np.asarray(r.resolve(ref2)), b)
+        # channel refs are NOT consumed — the producer owns the segment
+        assert os.path.exists(f"/dev/shm/{lane_name}")
+    finally:
+        lane.close()
+
+
+def test_channel_layout_change_and_growth():
+    r = DeviceBufferRegistry()
+    lane = r.channel()
+    try:
+        name1 = lane.put(_arr((4, 4))).split(":", 2)[1]
+        # smaller payload, new dtype: same segment, fresh layout in the ref
+        small = np.arange(4, dtype=np.int32)
+        ref = lane.put(small)
+        assert ref.split(":", 2)[1] == name1
+        np.testing.assert_array_equal(np.asarray(r.resolve(ref)), small)
+        # outgrowing the segment re-creates the lane under a new name
+        big = _arr((64, 64), seed=9)
+        ref_big = lane.put(big)
+        assert ref_big.split(":", 2)[1] != name1
+        assert not os.path.exists(f"/dev/shm/{name1}")  # old lane unlinked
+        np.testing.assert_array_equal(np.asarray(r.resolve(ref_big)), big)
+    finally:
+        lane.close()
+
+
+def test_channel_close_degrades_fresh_attach_with_marker():
+    producer_side = DeviceBufferRegistry()
+    consumer_cached = DeviceBufferRegistry()
+    consumer_fresh = DeviceBufferRegistry()
+    lane = producer_side.channel()
+    x = _arr(seed=7)
+    ref = lane.put(x)
+    np.testing.assert_array_equal(
+        np.asarray(consumer_cached.resolve(ref)), x)
+    lane.close()
+    # a consumer holding the cached mapping keeps working (POSIX keeps
+    # unlinked segments alive while mapped) ...
+    np.testing.assert_array_equal(
+        np.asarray(consumer_cached.resolve(ref)), x)
+    # ... while a fresh attach fails with the downgrade marker
+    with pytest.raises(KeyError) as ei:
+        consumer_fresh.resolve(ref)
+    assert "DeviceTensorRef" in str(ei.value)
+
+
+def test_channel_rejects_object_dtype():
+    r = DeviceBufferRegistry()
+    lane = r.channel()
+    try:
+        with pytest.raises(ValueError):
+            lane.put(np.array(["a", {"b": 1}], dtype=object))
+    finally:
+        lane.close()
+
+
+# ---------------------------------------------------------------------------
+# plane config + counters
+# ---------------------------------------------------------------------------
+
+
+def test_config_absent_family_is_none():
+    assert device_plane_config_from_annotations({}, "p") is None
+    assert device_plane_config_from_annotations(
+        {"seldon.io/graph-plan": "fused"}, "p") is None
+
+
+def test_config_parses_and_validates():
+    cfg = device_plane_config_from_annotations(
+        {"seldon.io/device-plane": "true"}, "p")
+    assert cfg == DevicePlaneConfig(enabled=True, remote="auto")
+    cfg = device_plane_config_from_annotations(
+        {"seldon.io/device-plane": "false",
+         "seldon.io/device-plane-remote": " SHM "}, "p")
+    assert cfg == DevicePlaneConfig(enabled=False, remote="shm")
+    # any family member present turns the master switch default on
+    cfg = device_plane_config_from_annotations(
+        {"seldon.io/device-plane-remote": "loopback"}, "p")
+    assert cfg.enabled and cfg.remote == "loopback"
+    with pytest.raises(ValueError, match="p:.*device-plane"):
+        device_plane_config_from_annotations(
+            {"seldon.io/device-plane": "banana"}, "p")
+    with pytest.raises(ValueError, match="auto/loopback/shm/off"):
+        device_plane_config_from_annotations(
+            {"seldon.io/device-plane": "true",
+             "seldon.io/device-plane-remote": "nvlink"}, "p")
+
+
+def test_plane_counters_roll_up():
+    plane = DevicePlane(DevicePlaneConfig(enabled=True))
+    plane.note_avoided("d2h", 100)
+    plane.note_avoided("d2h", 50)
+    plane.note_avoided("copy", 10)
+    plane.note_remote_ref("loopback")
+    plane.note_downgrade("resolve-failed")
+    plane.note_donation()
+    snap = plane.snapshot()
+    assert snap["transfersAvoided"] == {"d2h": 2, "copy": 1}
+    assert snap["bytesAvoided"] == {"d2h": 150, "copy": 10}
+    assert snap["remoteRefs"] == {"loopback": 1}
+    assert snap["downgrades"] == {"resolve-failed": 1}
+    assert snap["donations"] == 1
+    counts = plane.counts()
+    assert counts["device_plane_transfers_avoided"] == 3.0
+    assert counts["device_plane_bytes_avoided"] == 160.0
+    assert counts["device_plane_remote_refs"] == 1.0
+    assert counts["device_plane_downgrades"] == 1.0
+    assert counts["device_plane_donations"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# metadata-only introspection
+# ---------------------------------------------------------------------------
+
+
+def test_shape_and_nbytes_never_materialize_host_data(monkeypatch):
+    import jax.numpy as jnp
+
+    msg = SeldonMessage.from_ndarray(jnp.zeros((3, 5), dtype=jnp.float32))
+    monkeypatch.setattr(
+        SeldonMessage, "host_data",
+        lambda self: (_ for _ in ()).throw(AssertionError("D2H tripwire")))
+    assert msg.shape == (3, 5)
+    assert msg.nbytes == 3 * 5 * 4
+    assert msg.is_device_resident
+
+
+# ---------------------------------------------------------------------------
+# engine: meta-only routers route without a D2H
+# ---------------------------------------------------------------------------
+
+
+def _resolver_for(mapping):
+    def resolve(unit):
+        obj, stype = mapping[unit.name]
+        return ComponentHandle(obj, name=unit.name, service_type=stype)
+
+    return resolve
+
+
+class _JaxDouble:
+    accepts_jax_arrays = True
+
+    def predict(self, X, names):
+        return X * 2
+
+
+def test_meta_only_router_skips_d2h_on_device_payload():
+    import jax.numpy as jnp
+
+    spec = {"name": "r", "type": "ROUTER",
+            "implementation": "SIMPLE_ROUTER",
+            "children": [{"name": "m", "type": "MODEL"}]}
+    plane = DevicePlane(DevicePlaneConfig(enabled=True))
+    eng = GraphEngine(spec, resolver=_resolver_for(
+        {"m": (_JaxDouble(), "MODEL")}), device_plane=plane)
+    x = _arr((2, 4))
+    eng.predict_sync(SeldonMessage.from_ndarray(jnp.asarray(x)))  # warm
+
+    counted = [0]
+    orig = SeldonMessage.host_data
+
+    def counting(self):
+        counted[0] += 1
+        return orig(self)
+
+    before = plane.counts()["device_plane_transfers_avoided"]
+    SeldonMessage.host_data = counting
+    try:
+        out = eng.predict_sync(SeldonMessage.from_ndarray(jnp.asarray(x)))
+    finally:
+        SeldonMessage.host_data = orig
+    assert counted[0] == 0  # neither the route nor the model touched host
+    assert plane.counts()["device_plane_transfers_avoided"] > before
+    np.testing.assert_allclose(np.asarray(out.host_data()), x * 2,
+                               rtol=1e-6)
+    assert out.meta.tags.get("device-plane") == "on"
+
+
+# ---------------------------------------------------------------------------
+# framed: negotiation, reply-in-kind, downgrade-retry
+# ---------------------------------------------------------------------------
+
+
+class _Echo:
+    def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        return SeldonMessage(data=msg.data, names=list(msg.names))
+
+
+def _plane(remote="auto"):
+    return DevicePlane(DevicePlaneConfig(enabled=True, remote=remote))
+
+
+def test_framed_negotiates_loopback_in_process():
+    from seldon_core_tpu.serving.framed import (
+        FramedClient,
+        FramedComponentServer,
+    )
+
+    plane = _plane()
+    with FramedComponentServer(_Echo(), device_plane=plane) as srv:
+        cli = FramedClient(port=srv.port, device_plane=plane)
+        try:
+            assert cli._device_mode == "loopback"
+            x = _arr((4, 4), seed=5)
+            out = cli.predict(SeldonMessage.from_ndarray(x))
+            np.testing.assert_array_equal(np.asarray(out.data), x)
+            assert plane.snapshot()["remoteRefs"].get("loopback", 0) >= 1
+        finally:
+            cli.close()
+
+
+def test_framed_shm_cap_forces_pooled_lane_and_reply_in_kind():
+    from seldon_core_tpu.serving.framed import (
+        FramedClient,
+        FramedComponentServer,
+    )
+
+    plane = _plane(remote="shm")
+    with FramedComponentServer(_Echo(), device_plane=plane) as srv:
+        cli = FramedClient(port=srv.port, device_plane=plane)
+        try:
+            assert cli._device_mode == "shm"
+            assert cli._lane is not None
+            x = _arr((8, 16), seed=6)
+            for seed in (6, 7):  # second message rides the same lane
+                x = _arr((8, 16), seed=seed)
+                out = cli.predict(SeldonMessage.from_ndarray(x))
+                np.testing.assert_array_equal(np.asarray(out.data), x)
+                # the server answered in kind: the reply arrived as a
+                # pooled shm ref, not bytes
+                assert out.device_wire_mode == "shm"
+            assert plane.snapshot()["remoteRefs"].get("shm", 0) >= 2
+        finally:
+            cli.close()
+
+
+def test_framed_remote_off_keeps_bytes():
+    from seldon_core_tpu.serving.framed import (
+        FramedClient,
+        FramedComponentServer,
+    )
+
+    plane = _plane(remote="off")
+    with FramedComponentServer(_Echo(), device_plane=plane) as srv:
+        cli = FramedClient(port=srv.port, device_plane=plane)
+        try:
+            assert cli._device_mode == "off"
+            x = _arr((2, 2))
+            out = cli.predict(SeldonMessage.from_ndarray(x))
+            np.testing.assert_array_equal(np.asarray(out.data), x)
+        finally:
+            cli.close()
+
+
+def test_framed_planeless_server_replies_bytes():
+    from seldon_core_tpu.serving.framed import (
+        FramedClient,
+        FramedComponentServer,
+    )
+
+    plane = _plane()
+    with FramedComponentServer(_Echo()) as srv:  # no plane on the server
+        cli = FramedClient(port=srv.port, device_plane=plane)
+        try:
+            # the server answers the hello regardless (it can resolve
+            # inbound refs passively) but a plane-less server always
+            # replies in bytes
+            x = _arr((2, 3))
+            out = cli.predict(SeldonMessage.from_ndarray(x))
+            np.testing.assert_array_equal(np.asarray(out.data), x)
+            assert out.device_wire_mode == "off"
+        finally:
+            cli.close()
+
+
+def test_framed_negotiation_downgrades_against_old_server(monkeypatch):
+    from seldon_core_tpu.serving import framed
+
+    # an OLD server has no hello handling: the hello dispatches like any
+    # predict and the reply carries no devicePlane key
+    monkeypatch.setattr(framed, "_is_plane_hello", lambda m: False)
+    plane = _plane()
+    with framed.FramedComponentServer(_Echo(), device_plane=plane) as srv:
+        cli = framed.FramedClient(port=srv.port, device_plane=plane)
+        try:
+            assert cli._device_mode == "off"
+            assert plane.snapshot()["downgrades"].get("negotiation", 0) >= 1
+            monkeypatch.undo()
+            x = _arr((2, 3))
+            out = cli.predict(SeldonMessage.from_ndarray(x))
+            np.testing.assert_array_equal(np.asarray(out.data), x)
+        finally:
+            cli.close()
+
+
+class _FailOnceWithMarker:
+    """First predict raises the registry's downgrade marker (what a peer
+    that cannot resolve our ref answers); echoes afterwards."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        self.calls += 1
+        if self.calls == 1:
+            raise KeyError("shm DeviceTensorRef lane gone (test)")
+        return SeldonMessage(data=msg.data, names=list(msg.names))
+
+
+def test_framed_client_downgrade_retries_as_bytes_and_sticks():
+    from seldon_core_tpu.serving.framed import (
+        FramedClient,
+        FramedComponentServer,
+    )
+
+    plane = _plane(remote="shm")
+    target = _FailOnceWithMarker()
+    with FramedComponentServer(target, device_plane=plane) as srv:
+        cli = FramedClient(port=srv.port, device_plane=plane)
+        try:
+            assert cli._device_mode == "shm"
+            x = _arr((4, 4), seed=8)
+            out = cli.predict(SeldonMessage.from_ndarray(x))
+            # one transparent retry: the caller sees the answer, not the
+            # error; the connection is now stickily on bytes
+            np.testing.assert_array_equal(np.asarray(out.data), x)
+            assert target.calls == 2
+            assert cli._device_mode == "off"
+            assert cli._lane is None  # lane closed on downgrade
+            assert plane.snapshot()["downgrades"].get(
+                "resolve-failed", 0) >= 1
+        finally:
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# admission: GL17xx
+# ---------------------------------------------------------------------------
+
+
+def _lint(ann):
+    from seldon_core_tpu.analysis import lint_graph
+
+    spec = {"name": "m", "type": "MODEL", "parameters": [
+        {"name": "model_class", "type": "STRING",
+         "value": "seldon_core_tpu.models.iris:IrisClassifier"}]}
+    return [f for f in lint_graph(spec, annotations=ann)
+            if f.code.startswith("GL17")]
+
+
+def test_gl1701_rejects_malformed_values():
+    (f,) = _lint({"seldon.io/device-plane": "banana"})
+    assert f.code == "GL1701" and f.severity == "ERROR"
+    (f,) = _lint({"seldon.io/device-plane": "true",
+                  "seldon.io/device-plane-remote": "nvlink"})
+    assert f.code == "GL1701" and "nvlink" in f.message
+
+
+def test_gl1702_warns_on_knobs_without_plane():
+    (f,) = _lint({"seldon.io/device-plane": "false",
+                  "seldon.io/device-plane-remote": "shm"})
+    assert f.code == "GL1702" and f.severity == "WARN"
+    assert "seldon.io/device-plane-remote" in f.message
+
+
+def test_gl1703_reports_effective_posture():
+    (f,) = _lint({"seldon.io/device-plane": "true",
+                  "seldon.io/device-plane-remote": "loopback"})
+    assert f.code == "GL1703" and f.severity == "INFO"
+    assert "'loopback'" in f.message
+    assert _lint({}) == []
