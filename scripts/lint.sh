@@ -6,12 +6,18 @@
 #                  not installed (the hermetic CI image does not ship it).
 #   2. graphlint --self   AST passes: blocking calls on async hot paths,
 #                  host-sync JAX ops inside jit'd functions, asyncio
-#                  races (RL4xx/RL5xx/RL6xx) — plus the GL16xx
-#                  signature-registry trace verification when jax is
-#                  importable.  The analysis/ package itself is held to
-#                  --fail-on warn: the linter ships zero-warning.
+#                  races, device-ref ownership (RL4xx/RL5xx/RL6xx/RL7xx)
+#                  — plus the GL16xx signature-registry trace
+#                  verification when jax is importable.  The WHOLE
+#                  package is held to --fail-on warn against the
+#                  committed baseline (scripts/lint-baseline.json):
+#                  only NEW findings fail; refresh the snapshot with
+#                  --baseline-write after triage.
 #   3. graphlint over every shipped example graph, so examples/ never
-#                  drifts dirty (GL1xx/GL2xx/GL3xx).
+#                  drifts dirty (GL1xx/GL2xx/GL3xx) — then again with
+#                  the device plane forced on AND off (--plan), so the
+#                  GL18xx plan-residency verification holds in both
+#                  postures (planlint smoke).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -24,13 +30,17 @@ else
   echo "lint.sh: ruff not installed — skipping ruff, graphlint still gates" >&2
 fi
 
-echo "== graphlint --self (seldon_core_tpu/) =="
-python -m seldon_core_tpu.analysis --self seldon_core_tpu
-
-echo "== graphlint --self --fail-on warn (seldon_core_tpu/analysis/) =="
-python -m seldon_core_tpu.analysis --self seldon_core_tpu/analysis --fail-on warn
+echo "== graphlint --self --fail-on warn --baseline (seldon_core_tpu/) =="
+python -m seldon_core_tpu.analysis --self seldon_core_tpu \
+  --fail-on warn --baseline scripts/lint-baseline.json
 
 echo "== graphlint (examples/graphs/) =="
 python -m seldon_core_tpu.analysis examples/graphs/*.json
+
+echo "== planlint smoke: examples with device plane on AND off =="
+python -m seldon_core_tpu.analysis examples/graphs/*.json --plan on \
+  --fail-on warn >/dev/null
+python -m seldon_core_tpu.analysis examples/graphs/*.json --plan off \
+  --fail-on warn >/dev/null
 
 echo "lint.sh: OK"

@@ -9,7 +9,8 @@ Public surface:
   combined AST repo-lint pass: blocking calls in async functions and
   host-sync ops inside jit'd functions (RL4xx/RL5xx,
   ``analysis/repolint.py``) plus the asyncio concurrency lint
-  (RL6xx, ``analysis/asynclint.py``).
+  (RL6xx, ``analysis/asynclint.py``) and the device-ref ownership
+  lint (RL7xx, ``analysis/ownlint.py``).
 - :func:`lint_registry` — GL16xx signature-registry verification by
   abstract tracing (``analysis/tracelint.py``; imports jax).
 - :class:`Finding` — one diagnosed defect with a stable code.
@@ -23,6 +24,7 @@ Finding codes and severities are documented in docs/static-analysis.md.
 from typing import Iterable, Optional
 
 from seldon_core_tpu.analysis import asynclint as _asynclint
+from seldon_core_tpu.analysis import ownlint as _ownlint
 from seldon_core_tpu.analysis import repolint as _repolint
 from seldon_core_tpu.analysis.findings import (
     ERROR,
@@ -41,14 +43,17 @@ from seldon_core_tpu.analysis.graphlint import (
 
 
 def lint_source(source: str, rel_path: str) -> list[Finding]:
-    """All repo-lint families (RL4xx/RL5xx + RL6xx) for one source."""
+    """All repo-lint families (RL4xx/RL5xx + RL6xx + RL7xx) for one
+    source."""
     return (_repolint.lint_source(source, rel_path)
-            + _asynclint.lint_source(source, rel_path))
+            + _asynclint.lint_source(source, rel_path)
+            + _ownlint.lint_source(source, rel_path))
 
 
 def lint_file(path: str, root: Optional[str] = None) -> list[Finding]:
     return (_repolint.lint_file(path, root)
-            + _asynclint.lint_file(path, root))
+            + _asynclint.lint_file(path, root)
+            + _ownlint.lint_file(path, root))
 
 
 def lint_paths(paths: Iterable[str],
@@ -56,7 +61,8 @@ def lint_paths(paths: Iterable[str],
     """Repo-lint files/directories with every RL family."""
     paths = list(paths)
     return (_repolint.lint_paths(paths, root)
-            + _asynclint.lint_paths(paths, root))
+            + _asynclint.lint_paths(paths, root)
+            + _ownlint.lint_paths(paths, root))
 
 
 def lint_registry(model_classes=None) -> list[Finding]:

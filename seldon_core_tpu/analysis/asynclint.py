@@ -132,26 +132,41 @@ def _module_shared_globals(tree: ast.Module) -> set:
 def _class_shared_state(cls: ast.ClassDef) -> tuple:
     """(shared container attrs, lock attrs) of one class: ``self.x = {}``
     in ``__init__`` (or a container class attribute), ``self._lock =
-    asyncio.Lock()``."""
+    asyncio.Lock()``.  Annotated forms (``self._cond: asyncio.Condition
+    = asyncio.Condition()``) and class-body lock attributes count the
+    same as their bare equivalents — any ``asyncio`` guard primitive
+    (Lock/Semaphore/Condition/...) marks a guarded region."""
     shared: set = set()
     locks: set = set()
     for stmt in cls.body:
-        if isinstance(stmt, ast.Assign) and _is_container_expr(stmt.value):
-            shared.update(t.id for t in stmt.targets
-                          if isinstance(t, ast.Name))
+        targets: list = []
+        if isinstance(stmt, ast.Assign):
+            targets = [(t, stmt.value) for t in stmt.targets]
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [(stmt.target, stmt.value)]
+        for t, value in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if _is_container_expr(value):
+                shared.add(t.id)
+            elif _is_lock_expr_ctor(value):
+                locks.add(t.id)
         if not (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
                 and stmt.name == "__init__"):
             continue
         for sub in ast.walk(stmt):
-            if not isinstance(sub, ast.Assign):
-                continue
-            for t in sub.targets:
+            pairs: list = []
+            if isinstance(sub, ast.Assign):
+                pairs = [(t, sub.value) for t in sub.targets]
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                pairs = [(sub.target, sub.value)]
+            for t, value in pairs:
                 if (isinstance(t, ast.Attribute)
                         and isinstance(t.value, ast.Name)
                         and t.value.id == "self"):
-                    if _is_container_expr(sub.value):
+                    if _is_container_expr(value):
                         shared.add(t.attr)
-                    elif _is_lock_expr_ctor(sub.value):
+                    elif _is_lock_expr_ctor(value):
                         locks.add(t.attr)
     return shared, locks
 

@@ -44,6 +44,14 @@ greps, and operator status all key on it), a severity, the unit path or
 - ``GL17xx`` — device-plane admission (``seldon.io/device-plane*``
   annotation validation, plane knobs set while the plane is off,
   effective enable/remote-mode report)
+- ``GL18xx`` — plan-level residency verification
+  (``analysis/planlint.py``): an abstract interpreter over the fused
+  plan the spec will compile to, propagating a per-edge ResidencyState
+  (tier x partition x ownership) under the ``seldon.io/device-plane``
+  and ``seldon.io/mesh`` annotations — structural byte downgrades,
+  donated one-shot handles with a second consumer, tp→dp reshards
+  inside fused spans, transition-cost deadline feasibility, and the
+  full planned residency map
 - ``RL4xx`` — blocking calls on async hot paths (repo lint)
 - ``RL5xx`` — host-sync JAX ops inside jit'd hot paths (repo lint)
 - ``RL6xx`` — asyncio concurrency lint (``analysis/asynclint.py``):
@@ -51,6 +59,11 @@ greps, and operator status all key on it), a severity, the unit path or
   ``await``, unlocked cross-await mutation), fire-and-forget
   ``create_task``, locks held across remote awaits, and unguarded
   ``asyncio.gather``
+- ``RL7xx`` — DeviceTensorRef lifecycle lint (``analysis/ownlint.py``):
+  AST dataflow over one-shot registry refs — use-after-consume,
+  double-consume across branches, resolution sites without a
+  byte-downgrade error path, and ``ShmChannel`` lanes not closed on
+  all exits
 
 Codes are append-only: never renumber or reuse a retired code.
 """
@@ -130,6 +143,11 @@ TRACE_SIGNATURE_DRIFT = "GL1601"    # declared output shape/dtype != traced
 TRACE_IMPLICIT_PROMOTION = "GL1602"  # float64/weak-type escapes the segment
 TRACE_CALLBACK_IN_PURE_FN = "GL1603"  # host callback inside a pure_fn node
 TRACE_MESH_INDIVISIBLE = "GL1604"   # dp/tp axis does not divide its dim
+RESIDENCY_STRUCTURAL_DOWNGRADE = "GL1801"  # edge downgrades to bytes always
+RESIDENCY_DONATED_SHARED = "GL1802"  # one-shot handle has a second consumer
+RESIDENCY_RESHARD_HOST_TRIP = "GL1803"  # tp→dp reshard inside a fused span
+RESIDENCY_DEADLINE_INFEASIBLE = "GL1804"  # deadline + transition costs
+RESIDENCY_MAP_REPORT = "GL1805"     # residency report: the planned map
 
 # -- repo lint --------------------------------------------------------------
 BLOCKING_CALL_IN_ASYNC = "RL401"  # time.sleep / sync HTTP in an async def
@@ -141,6 +159,10 @@ SHARED_MUTATION_ACROSS_AWAIT = "RL602"  # shared container mutated across await
 DISCARDED_TASK = "RL603"          # asyncio.create_task() result dropped
 LOCK_HELD_ACROSS_REMOTE_AWAIT = "RL604"  # asyncio.Lock over remote await
 GATHER_WITHOUT_RETURN_EXCEPTIONS = "RL605"  # bare gather in try-less scope
+REF_USE_AFTER_CONSUME = "RL701"   # one-shot ref used after resolve consumed it
+REF_DOUBLE_CONSUME = "RL702"      # ref consumed again after a branch consumed
+REF_NO_DOWNGRADE_PATH = "RL703"   # resolve site without a byte-downgrade path
+SHM_LANE_NOT_CLOSED = "RL704"     # ShmChannel lane not closed on all exits
 
 #: every code → default severity; the single source of truth for docs
 CODE_SEVERITY = {
@@ -208,6 +230,11 @@ CODE_SEVERITY = {
     TRACE_IMPLICIT_PROMOTION: WARN,
     TRACE_CALLBACK_IN_PURE_FN: ERROR,
     TRACE_MESH_INDIVISIBLE: ERROR,
+    RESIDENCY_STRUCTURAL_DOWNGRADE: ERROR,
+    RESIDENCY_DONATED_SHARED: ERROR,
+    RESIDENCY_RESHARD_HOST_TRIP: WARN,
+    RESIDENCY_DEADLINE_INFEASIBLE: WARN,
+    RESIDENCY_MAP_REPORT: INFO,
     BLOCKING_CALL_IN_ASYNC: ERROR,
     SYNC_OPEN_IN_ASYNC: WARN,
     HOST_SYNC_IN_JIT: ERROR,
@@ -217,6 +244,10 @@ CODE_SEVERITY = {
     DISCARDED_TASK: ERROR,
     LOCK_HELD_ACROSS_REMOTE_AWAIT: WARN,
     GATHER_WITHOUT_RETURN_EXCEPTIONS: WARN,
+    REF_USE_AFTER_CONSUME: ERROR,
+    REF_DOUBLE_CONSUME: ERROR,
+    REF_NO_DOWNGRADE_PATH: WARN,
+    SHM_LANE_NOT_CLOSED: WARN,
 }
 
 
@@ -226,22 +257,32 @@ class Finding:
     severity: str  # ERROR | WARN | INFO
     path: str      # unit path ("p/root/child") or source location ("f.py:12")
     message: str
+    #: secondary (path, message) anchors for multi-location findings —
+    #: e.g. GL1802's producer and second consumer.  Rendered as SARIF
+    #: ``relatedLocations`` by the CLI.
+    related: tuple = ()
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "code": self.code,
             "severity": self.severity,
             "path": self.path,
             "message": self.message,
         }
+        if self.related:
+            d["related"] = [{"path": p, "message": m}
+                            for p, m in self.related]
+        return d
 
     def __str__(self) -> str:
         return f"{self.severity:5s} {self.code} {self.path}: {self.message}"
 
 
 def make_finding(code: str, path: str, message: str,
-                 severity: str | None = None) -> Finding:
-    return Finding(code, severity or CODE_SEVERITY[code], path, message)
+                 severity: str | None = None,
+                 related: tuple = ()) -> Finding:
+    return Finding(code, severity or CODE_SEVERITY[code], path, message,
+                   tuple(related))
 
 
 def errors(findings: list[Finding]) -> list[Finding]:

@@ -132,13 +132,19 @@ class GraphAnalysisError(Exception):
     """Raised by admission when a spec carries ERROR-severity findings.
 
     ``operator/compile.py`` converts this into a failed compile; the
-    reconcile loop surfaces ``findings`` on the CR's status."""
+    reconcile loop surfaces ``findings`` on the CR's status.
+
+    ``findings`` may include the WARN/INFO context from the rejecting
+    predictors (e.g. the GL1805 residency map) — it is ordered errors
+    first, and the message names only the ERRORs that caused rejection."""
 
     def __init__(self, findings: list[Finding]):
-        self.findings = list(findings)
-        lines = "; ".join(str(f) for f in self.findings)
+        fs = list(findings)
+        errors = [f for f in fs if f.severity == "ERROR"]
+        self.findings = errors + [f for f in fs if f.severity != "ERROR"]
+        lines = "; ".join(str(f) for f in errors or fs)
         super().__init__(
-            f"graphlint: {len(self.findings)} error finding(s): {lines}"
+            f"graphlint: {len(errors or fs)} error finding(s): {lines}"
         )
 
 
@@ -200,8 +206,20 @@ def lint_graph(
         findings.extend(_fleet_obs_pass(unit, ann, path_prefix))
         findings.extend(_artifact_pass(unit, ann, path_prefix))
         findings.extend(_device_plane_pass(unit, ann, path_prefix))
+        findings.extend(_residency_pass(unit, ann, path_prefix))
         findings.extend(_tracelint_pass(unit, ann, path_prefix))
     return findings
+
+
+def _residency_pass(root: "PredictiveUnit", ann: dict,
+                    prefix: str) -> list[Finding]:
+    """GL18xx: abstract-interpret the fused plan's per-edge residency
+    (analysis/planlint.py) — gated there on the ``seldon.io/device-plane``
+    family being present.  Lazy import: planlint reads this module's
+    segment/signature helpers at import time."""
+    from seldon_core_tpu.analysis.planlint import lint_plan_residency
+
+    return lint_plan_residency(root, ann, prefix)
 
 
 def _tracelint_pass(root: "PredictiveUnit", ann: dict,

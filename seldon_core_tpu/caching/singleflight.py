@@ -36,7 +36,10 @@ class SingleFlight:
         if fut is not None:
             return await fut, True
         fut = asyncio.get_running_loop().create_future()
-        self._inflight[key] = fut
+        # leader path: probe -> insert with NO await between them (the
+        # await above is on the follower's return branch), so the
+        # check-then-act is atomic on the event loop
+        self._inflight[key] = fut  # graphlint: disable=RL601
         try:
             result = await compute()
         except BaseException as e:

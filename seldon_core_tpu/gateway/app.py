@@ -298,7 +298,9 @@ class Gateway:
             await self._session.close()
         for ch in self._grpc_channels.values():
             await ch.close()
-        self._grpc_channels.clear()
+        # shutdown path, called once after the server stops accepting —
+        # no concurrent coroutine mutates the pool here
+        self._grpc_channels.clear()  # graphlint: disable=RL602
         # drain the firehose sink (NetworkFirehose buffers + batches;
         # records still queued at shutdown would otherwise vanish)
         closer = getattr(self.firehose, "close", None)

@@ -888,6 +888,32 @@ class GraphPlan:
             "fully_fused": self.fully_fused,
         }
 
+    def residency_map(self, annotations: Optional[dict] = None) -> list:
+        """Dry-run residency of this plan's edges under ``annotations``
+        (device-plane/mesh posture): one dict per request-flow edge with
+        the planned tier, partition, and ownership.  Delegates to the
+        same abstract interpretation the GL18xx admission lint runs
+        offline (``analysis/planlint.py plan_edges``), so the live
+        plan's answer and the ``status.analysis`` residency map can
+        never drift.  Spec-only — no dispatch, no weights touched."""
+        from seldon_core_tpu.analysis.graphlint import PLAN_ANNOTATION
+        from seldon_core_tpu.analysis.planlint import plan_edges
+
+        ann = dict(annotations or {})
+        # this object IS the fused plan — pin the posture the offline
+        # interpreter should reconstruct
+        ann.setdefault(PLAN_ANNOTATION, "fused")
+        return [
+            {
+                "src": e.src, "dst": e.dst,
+                "tier": e.state.tier,
+                "partition": e.state.partition,
+                "ownership": e.state.ownership,
+                "fused": e.fused, "remote": e.remote,
+            }
+            for e in plan_edges(self.root.node.unit, ann)
+        ]
+
     def warmup(self, example_row=None) -> int:
         """Pre-compile every batcher bucket of every segment (first TPU
         compile is seconds — pay it before traffic).  ``example_row`` may

@@ -385,7 +385,8 @@ class SeldonMessage:
                 from seldon_core_tpu.runtime.device_registry import registry
 
                 ref = DeviceTensorRef.from_dict(datad["deviceRef"])
-                msg.data = registry.resolve(ref.ref)
+                # the raise IS the downgrade signal at this boundary
+                msg.data = registry.resolve(ref.ref)  # graphlint: disable=RL703
                 msg.encoding = "binTensor"
         elif "binData" in d:
             msg.bin_data = base64.b64decode(d["binData"])

@@ -469,7 +469,12 @@ def graphlint_mode(dep: SeldonDeployment, p: PredictorSpec) -> str:
 def admission_lint(dep: SeldonDeployment) -> list:
     """Static graph analysis at admission (the deploy-time analog of the
     reference's validate step, but semantic: structure, shape/dtype edges,
-    deadline/HBM feasibility — docs/static-analysis.md).
+    deadline/HBM feasibility, and — when the device-plane family is on —
+    the GL18xx plan-residency verification, so a graph whose edges
+    structurally downgrade to bytes (GL1801) or double-consume a donated
+    handle (GL1802) is rejected before any pod exists, with the planned
+    residency map (GL1805) landing on ``status.analysis`` —
+    docs/static-analysis.md).
 
     Raises :class:`~seldon_core_tpu.analysis.GraphAnalysisError` when an
     enforce-mode predictor carries ERROR findings; returns every finding
@@ -490,7 +495,7 @@ def admission_lint(dep: SeldonDeployment) -> list:
         pass  # spec-only environment: those passes stay off
 
     findings = []
-    rejects = []
+    reject_findings = []
     for p in dep.predictors:
         mode = graphlint_mode(dep, p)
         if mode == "off":
@@ -498,10 +503,13 @@ def admission_lint(dep: SeldonDeployment) -> list:
         ann = {**dep.annotations, **p.annotations}
         fs = lint_graph(p.graph, ann, path_prefix=p.name)
         findings.extend(fs)
-        if mode != "warn":
-            rejects.extend(f for f in fs if f.severity == "ERROR")
-    if rejects:
-        raise GraphAnalysisError(rejects)
+        if mode != "warn" and any(f.severity == "ERROR" for f in fs):
+            # carry the predictor's WHOLE finding set so the WARN/INFO
+            # context (notably the GL1805 residency map) reaches
+            # status.analysis alongside the rejecting errors
+            reject_findings.extend(fs)
+    if reject_findings:
+        raise GraphAnalysisError(reject_findings)
     return findings
 
 

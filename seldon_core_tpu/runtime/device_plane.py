@@ -39,8 +39,15 @@ __all__ = [
     "DEVICE_PLANE_PREFIX",
     "DEVICE_PLANE_REMOTE_ANNOTATION",
     "REMOTE_MODES",
+    "RESIDENCY_TIERS",
+    "TIER_HOST_BYTES",
+    "TIER_SHM_LANE",
+    "TIER_LOOPBACK_REF",
+    "TIER_HBM_HANDLE",
     "DevicePlaneConfig",
     "device_plane_config_from_annotations",
+    "negotiated_remote_tier",
+    "tier_transfers",
     "DevicePlane",
     "device_plane_probe",
 ]
@@ -55,6 +62,54 @@ DEVICE_PLANE_REMOTE_ANNOTATION = "seldon.io/device-plane-remote"
 #: the negotiation at that tier; ``off`` keeps remote edges on bytes
 #: while in-process edges still ride the plane.
 REMOTE_MODES = ("auto", "loopback", "shm", "off")
+
+# -- pure residency model ----------------------------------------------------
+# The tiers a graph edge's payload can live in, ordered worst → best.
+# This is the plane's declarative model of itself: the runtime fast
+# paths (serving/framed.py, serving/client.py, proto/convert.py)
+# realize these tiers, and the GL18xx plan-residency lint
+# (analysis/planlint.py) predicts them from the spec — both sides read
+# THIS table so they can never drift.
+
+TIER_HOST_BYTES = "host-bytes"      # serialized onto the byte wire
+TIER_SHM_LANE = "shm-lane"          # staged: one D2H + one H2D, no bytes
+TIER_LOOPBACK_REF = "loopback-ref"  # in-process registry ref, zero copies
+TIER_HBM_HANDLE = "hbm-handle"      # the jax.Array itself stays on device
+
+RESIDENCY_TIERS = (TIER_HOST_BYTES, TIER_SHM_LANE,
+                   TIER_LOOPBACK_REF, TIER_HBM_HANDLE)
+
+
+def negotiated_remote_tier(config: "DevicePlaneConfig",
+                           transport: str) -> str:
+    """The best residency tier a remote edge can STRUCTURALLY negotiate.
+
+    Pure function of the plane posture and the edge's transport: device
+    refs ride the proto/framed codecs only (``GRPC``), so a ``REST``
+    edge can never carry one — with the plane on, every request on such
+    an edge pays the byte downgrade.  ``auto`` answers the best tier the
+    runtime may reach (loopback when the peer turns out in-process); the
+    runtime negotiates DOWN from here per peer, never up."""
+    if not config.enabled or config.remote == "off":
+        return TIER_HOST_BYTES
+    if str(transport).upper() != "GRPC":
+        return TIER_HOST_BYTES
+    if config.remote == "shm":
+        return TIER_SHM_LANE
+    return TIER_LOOPBACK_REF  # loopback or auto
+
+
+def tier_transfers(tier: str) -> tuple:
+    """Host↔device transfers one payload pays to cross an edge at this
+    tier — the compile-ledger price tags GL1804 adds to the GL3xx
+    deadline model.  Ref tiers move nothing; shm stages exactly one D2H
+    + one H2D; the byte wire pays the same two hops plus serialization
+    (billed as a second pair by the serialize/parse round trip)."""
+    if tier == TIER_HOST_BYTES:
+        return ("d2h", "serialize", "parse", "h2d")
+    if tier == TIER_SHM_LANE:
+        return ("d2h", "h2d")
+    return ()
 
 _TRUE = ("1", "true", "yes", "on")
 _FALSE = ("0", "false", "no", "off")

@@ -52,10 +52,36 @@ from collections import OrderedDict
 from typing import Any
 
 __all__ = ["DeviceBufferRegistry", "ShmChannel", "registry",
-           "process_token", "host_token", "ForeignProcessRef", "SHM_PREFIX"]
+           "process_token", "host_token", "ForeignProcessRef", "SHM_PREFIX",
+           "OWNERSHIP_SHARED", "OWNERSHIP_ONE_SHOT", "ref_ownership"]
 
 #: namespace prefix of every shm export — the orphan reaper scans it
 SHM_PREFIX = "seldon_dtr_"
+
+# -- pure ownership model ----------------------------------------------------
+# Declarative ownership semantics of every ref family this registry
+# mints.  The RL7xx lifecycle lint (analysis/ownlint.py) and the GL18xx
+# plan-residency lint mirror this table instead of re-deriving it from
+# resolve()'s control flow, so the lints and the runtime agree by
+# construction.
+
+#: many observers: resolution copies/hands back without invalidating
+OWNERSHIP_SHARED = "shared"
+#: donated: the FIRST resolve consumes (deletes the entry / unlinks the
+#: segment); a second observer sees a dead ref
+OWNERSHIP_ONE_SHOT = "one-shot"
+
+
+def ref_ownership(ref: str) -> str:
+    """Ownership class of a ref string, from its format alone.
+
+    ``shmc:`` lane refs are producer-owned and copied off (shared across
+    messages); ``shm:`` one-shot exports unlink on resolve; loopback
+    ``<token>/<uuid>`` entries are consumed by default.  Pure — safe for
+    lint-time use with no registry instance."""
+    if ref.startswith("shmc:"):
+        return OWNERSHIP_SHARED
+    return OWNERSHIP_ONE_SHOT
 
 _HOST_TOKEN: "str | None" = None
 

@@ -169,7 +169,7 @@ def decode_message(frame: Frame, device_plane=None) -> SeldonMessage:
         # here — the server's error channel carries it back to the sender
         # (which downgrades and retries as bytes), never a silent empty
         # message
-        msg.data = registry.resolve(ref)
+        msg.data = registry.resolve(ref)  # graphlint: disable=RL703
         if ref.startswith("shmc:"):
             wire_mode = "shm"
             peer_lane = ref.split(":", 2)[1]

@@ -325,7 +325,9 @@ class FramedDriver:
         try:
             if client is None:  # prior failure parked a tombstone: reconnect
                 client = await AsyncFramedClient().connect(self.host, self.port)
-                self._clients.append(client)
+                # ownership of each client is serialized through the
+                # _free queue; the list is close-time bookkeeping only
+                self._clients.append(client)  # graphlint: disable=RL602
             await client.predict(self._msg)
         except BaseException:
             # the stream may be desynced mid-frame — never reuse it
@@ -335,7 +337,8 @@ class FramedDriver:
                 except Exception:
                     pass
                 if client in self._clients:
-                    self._clients.remove(client)
+                    # same queue-serialized ownership as the append above
+                    self._clients.remove(client)  # graphlint: disable=RL602
             self._free.put_nowait(None)
             raise
         else:

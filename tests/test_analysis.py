@@ -637,3 +637,130 @@ def test_valid_bool_spellings_still_coerce():
         ],
     })
     assert unit.parameters == {"a": True, "b": False, "c": True}
+
+# ---------------------------------------------------------------------------
+# SARIF relatedLocations + round-trip (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+FANOUT_GRAPH = {
+    "name": "ens", "type": "COMBINER",
+    "implementation": "AVERAGE_COMBINER",
+    "children": [
+        {"name": "left", "type": "MODEL",
+         "endpoint": {"service_host": "left.default.svc",
+                      "service_port": 9000, "type": "GRPC"}},
+        {"name": "right", "type": "MODEL",
+         "endpoint": {"service_host": "right.default.svc",
+                      "service_port": 9000, "type": "GRPC"}},
+    ],
+}
+
+
+def test_cli_sarif_related_locations(tmp_path, capsys):
+    spec = tmp_path / "fanout.json"
+    spec.write_text(json.dumps(FANOUT_GRAPH))
+    sarif_path = tmp_path / "out.sarif"
+    assert analysis_main(
+        [str(spec), "--plan", "on", "--sarif", str(sarif_path)]) == 1
+    capsys.readouterr()
+    log = json.loads(sarif_path.read_text())
+    (run,) = log["runs"]
+    (gl1802,) = [r for r in run["results"] if r["ruleId"] == "GL1802"]
+    related = gl1802["relatedLocations"]
+    assert [loc["logicalLocations"][0]["fullyQualifiedName"]
+            for loc in related] == ["ens/left", "ens/right"]
+    assert "first consumer" in related[0]["message"]["text"]
+    assert "second consumer" in related[1]["message"]["text"]
+
+
+def test_sarif_round_trips_through_json_with_schema_shape():
+    from seldon_core_tpu.analysis.cli import to_sarif
+    from seldon_core_tpu.analysis.findings import make_finding
+
+    findings = [
+        make_finding("GL1802", "ens", "donated handle fan-out",
+                     related=(("ens/left", "first consumer"),
+                              ("ens/right", "second consumer"))),
+        make_finding("RL703", "mod.py:12", "resolve outside try"),
+    ]
+    log = to_sarif(findings)
+    # byte-stable through a serialize/parse cycle
+    assert json.loads(json.dumps(log)) == log
+    # SARIF 2.1.0 schema shape: versioned, one run, every result's
+    # ruleId declared in the driver rules, every (related) location a
+    # logical OR physical location object
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-2.1.0.json")
+    (run,) = log["runs"]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    for result in run["results"]:
+        assert result["ruleId"] in rules
+        locations = list(result["locations"])
+        locations.extend(result.get("relatedLocations", []))
+        for loc in locations:
+            assert ("logicalLocations" in loc) != ("physicalLocation" in loc)
+    # the physical-location finding carries its file + line region
+    (rl703,) = [r for r in run["results"] if r["ruleId"] == "RL703"]
+    phys = rl703["locations"][0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"].endswith("mod.py")
+    assert phys["region"]["startLine"] == 12
+
+
+# ---------------------------------------------------------------------------
+# --baseline: grandfather known findings, gate only on new ones
+# ---------------------------------------------------------------------------
+
+RL703_SRC = textwrap.dedent("""
+    def serve(registry, ref):
+        return registry.resolve(ref)
+""")
+
+
+def test_cli_baseline_gates_only_new_findings(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text(RL703_SRC)
+    baseline = tmp_path / "baseline.json"
+    argv = ["--self", str(mod), "--fail-on", "warn",
+            "--baseline", str(baseline)]
+
+    # ungated: the WARN fails the run
+    assert analysis_main(["--self", str(mod), "--fail-on", "warn"]) == 1
+
+    # snapshot, then the same findings are grandfathered
+    assert analysis_main([*argv, "--baseline-write"]) == 0
+    doc = json.loads(baseline.read_text())
+    assert doc["version"] == 1
+    assert len(doc["findings"]) == 1
+    assert doc["findings"][0].startswith("RL703|")
+    assert analysis_main(argv) == 0
+    out = capsys.readouterr().out
+    assert "0 new vs baseline" in out
+
+    # a new finding on top of the snapshot fails again — line-shifting
+    # the old one must NOT (keys drop line numbers)
+    mod.write_text("# shifted\n" + RL703_SRC + textwrap.dedent("""
+        def pump(registry, frames):
+            lane = registry.channel()
+            for f in frames:
+                lane.put(f)
+    """))
+    assert analysis_main(argv) == 1
+    out = capsys.readouterr().out
+    assert "1 new vs baseline" in out
+
+
+def test_cli_baseline_missing_file_is_an_error(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    rc = analysis_main(["--self", str(mod),
+                        "--baseline", str(tmp_path / "nope.json")])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_baseline_write_requires_baseline(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    with pytest.raises(SystemExit):
+        analysis_main(["--self", str(mod), "--baseline-write"])
+    capsys.readouterr()

@@ -321,3 +321,69 @@ def test_combined_lint_source_includes_rl6xx():
 def test_syntax_error_is_quiet():
     # repolint owns parse-failure reporting; asynclint stays silent
     assert async_only("def broken(:\n", "mod.py") == []
+
+
+# ---------------------------------------------------------------------------
+# guard recognition (ISSUE 20 satellite): every asyncio guard primitive
+# counts, whether bound bare or annotated, in __init__ or the class body
+# ---------------------------------------------------------------------------
+
+def test_semaphore_class_body_attr_recognized_as_guard():
+    src = """
+        import asyncio
+
+        class Pool:
+            _entries: dict = {}
+            _gate = asyncio.Semaphore(4)
+
+            async def get_or_load(self, key, load):
+                async with self._gate:
+                    if key in self._entries:
+                        return self._entries[key]
+                    value = await load(key)
+                    self._entries[key] = value
+                    return value
+    """
+    assert lint(src) == []
+
+
+def test_annotated_condition_attr_recognized_as_guard():
+    src = """
+        import asyncio
+
+        class Pool:
+            def __init__(self):
+                self._entries: dict = {}
+                self._cond: asyncio.Condition = asyncio.Condition()
+
+            async def get_or_load(self, key, load):
+                async with self._cond:
+                    if key in self._entries:
+                        return self._entries[key]
+                    value = await load(key)
+                    self._entries[key] = value
+                    return value
+    """
+    assert lint(src) == []
+
+
+def test_annotated_shared_state_still_fires_unguarded():
+    # the AnnAssign fix must widen GUARD recognition without narrowing
+    # shared-state recognition: an annotated container with no lock at
+    # all is still a TOCTOU
+    src = """
+        import asyncio
+
+        class Pool:
+            def __init__(self):
+                self._entries: dict = {}
+
+            async def get_or_load(self, key, load):
+                if key in self._entries:
+                    return self._entries[key]
+                value = await load(key)
+                self._entries[key] = value
+                return value
+    """
+    f = the(lint(src), UNLOCKED_CHECK_THEN_ACT)
+    assert "self._entries" in f.message

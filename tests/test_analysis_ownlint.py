@@ -1,0 +1,251 @@
+"""RL7xx device-ref ownership lint (ISSUE 20).
+
+Each rule RL701-RL704 is pinned with a seeded-bad snippet asserting the
+exact code and a minimally-fixed twin asserting silence, mirroring the
+RL6xx suite: the one-shot resolve contract (RL701/RL702), the
+byte-downgrade error path (RL703), and the ShmChannel close obligation
+(RL704).  ``consume=False`` peeks, branch-merge semantics, lane
+hand-off, and pragma suppression are covered alongside.
+"""
+
+import textwrap
+
+from seldon_core_tpu.analysis import lint_source
+from seldon_core_tpu.analysis.findings import (
+    REF_DOUBLE_CONSUME,
+    REF_NO_DOWNGRADE_PATH,
+    REF_USE_AFTER_CONSUME,
+    SHM_LANE_NOT_CLOSED,
+)
+from seldon_core_tpu.analysis.ownlint import lint_source as own_only
+
+
+def lint(src):
+    return own_only(textwrap.dedent(src), "mod.py")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def the(findings, code):
+    hits = [f for f in findings if f.code == code]
+    assert len(hits) == 1, f"expected exactly one {code}, got {findings}"
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# RL701: use after resolve() consumed the ref
+# ---------------------------------------------------------------------------
+
+RL701_BAD = """
+    def serve(registry, payload):
+        ref = registry.put(payload)
+        try:
+            a = registry.resolve(ref)
+            b = registry.resolve(ref)  # dead: first resolve donated it
+        except KeyError:
+            return None
+        return a, b
+"""
+
+
+def test_rl701_second_resolve_of_consumed_ref():
+    f = the(lint(RL701_BAD), REF_USE_AFTER_CONSUME)
+    assert "'ref'" in f.message
+    assert f.path.startswith("mod.py:")
+
+
+def test_rl701_generic_read_after_consume():
+    src = """
+        def serve(registry, payload, log):
+            ref = registry.put(payload)
+            try:
+                arr = registry.resolve(ref)
+            except KeyError:
+                return None
+            log.info("served %s", ref)  # reads the dead ref
+            return arr
+    """
+    f = the(lint(src), REF_USE_AFTER_CONSUME)
+    assert "'ref'" in f.message
+
+
+def test_rl701_fixed_single_resolve_is_quiet():
+    src = """
+        def serve(registry, payload):
+            ref = registry.put(payload)
+            try:
+                return registry.resolve(ref)
+            except KeyError:
+                return None
+    """
+    assert lint(src) == []
+
+
+def test_rl701_consume_false_peek_keeps_ref_live():
+    src = """
+        def serve(registry, payload):
+            ref = registry.put(payload)
+            try:
+                peek = registry.resolve(ref, consume=False)
+                real = registry.resolve(ref)
+            except KeyError:
+                return None
+            return peek, real
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RL702: consumed on one branch, resolved again after the join
+# ---------------------------------------------------------------------------
+
+RL702_BAD = """
+    def serve(registry, payload, eager):
+        ref = registry.put(payload)
+        try:
+            if eager:
+                cached = registry.resolve(ref)
+            out = registry.resolve(ref)  # dead exactly when eager
+        except KeyError:
+            return None
+        return out
+"""
+
+
+def test_rl702_branch_consume_then_resolve():
+    f = the(lint(RL702_BAD), REF_DOUBLE_CONSUME)
+    assert "'ref'" in f.message and "branch" in f.message
+
+
+def test_rl702_fixed_resolve_on_the_other_branch_is_quiet():
+    src = """
+        def serve(registry, payload, eager):
+            ref = registry.put(payload)
+            try:
+                if eager:
+                    out = registry.resolve(ref)
+                else:
+                    out = registry.resolve(ref)
+            except KeyError:
+                return None
+            return out
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RL703: resolve with no byte-downgrade error path
+# ---------------------------------------------------------------------------
+
+RL703_BAD = """
+    def serve(registry, ref):
+        return registry.resolve(ref)
+"""
+
+
+def test_rl703_resolve_outside_any_try():
+    f = the(lint(RL703_BAD), REF_NO_DOWNGRADE_PATH)
+    assert "byte wire" in f.message
+
+
+def test_rl703_fixed_try_wrapped_resolve_is_quiet():
+    src = """
+        def serve(registry, ref, fallback):
+            try:
+                return registry.resolve(ref)
+            except KeyError:
+                return fallback(ref)
+    """
+    assert lint(src) == []
+
+
+def test_rl703_matches_registry_local_from_constructor():
+    src = """
+        from seldon_core_tpu.runtime.device_registry import DeviceBufferRegistry
+
+        buffers = DeviceBufferRegistry()
+
+        def serve(ref):
+            return buffers.resolve(ref)
+    """
+    the(lint(src), REF_NO_DOWNGRADE_PATH)
+
+
+def test_rl703_ignores_unrelated_resolve_methods():
+    src = """
+        def serve(dns, name):
+            return dns.resolve(name)
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# RL704: ShmChannel lane acquired and never closed
+# ---------------------------------------------------------------------------
+
+RL704_BAD = """
+    def pump(registry, frames):
+        lane = registry.channel()
+        for f in frames:
+            lane.put(f)
+"""
+
+
+def test_rl704_lane_never_closed():
+    f = the(lint(RL704_BAD), SHM_LANE_NOT_CLOSED)
+    assert "'lane'" in f.message and "finally" in f.message
+
+
+def test_rl704_fixed_close_in_finally_is_quiet():
+    src = """
+        def pump(registry, frames):
+            lane = registry.channel()
+            try:
+                for f in frames:
+                    lane.put(f)
+            finally:
+                lane.close()
+    """
+    assert lint(src) == []
+
+
+def test_rl704_handed_off_lane_is_quiet():
+    src = """
+        def open_lane(registry):
+            lane = registry.channel()
+            return lane
+
+        class Pump:
+            def start(self, registry):
+                lane = registry.channel()
+                self._lane = lane
+    """
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# integration: the package entry point and pragma suppression
+# ---------------------------------------------------------------------------
+
+def test_rl7xx_reported_through_package_lint_source():
+    fs = lint_source(textwrap.dedent(RL703_BAD), "mod.py")
+    assert REF_NO_DOWNGRADE_PATH in codes(fs)
+
+
+def test_pragma_suppresses_rl703():
+    src = """
+        def serve(registry, ref):
+            return registry.resolve(ref)  # graphlint: disable=RL703
+    """
+    assert lint(src) == []
+
+
+def test_skip_file_pragma_suppresses_everything():
+    src = """
+        # graphlint: skip-file
+        def serve(registry, ref):
+            return registry.resolve(ref)
+    """
+    assert lint(src) == []

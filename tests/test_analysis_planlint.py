@@ -1,0 +1,358 @@
+"""GL18xx plan-level residency verification (ISSUE 20).
+
+Each rule GL1801-GL1804 is pinned with a seeded-bad spec asserting the
+exact code (and, for GL1802, the related first/second-consumer
+locations) plus a minimally-fixed twin asserting silence; GL1805 pins
+the always-on residency map in both plane postures.  The shipped
+example graphs must lint clean in BOTH postures — the same smoke the
+CI planlint job runs — and a GL1801 deployment must be rejected at
+admission with the finding on ``status.analysis``, covered at the
+bottom.
+"""
+
+import glob
+import os
+
+from seldon_core_tpu.analysis import lint_graph
+from seldon_core_tpu.analysis.cli import main as analysis_main
+from seldon_core_tpu.analysis.findings import (
+    RESIDENCY_DEADLINE_INFEASIBLE,
+    RESIDENCY_DONATED_SHARED,
+    RESIDENCY_MAP_REPORT,
+    RESIDENCY_RESHARD_HOST_TRIP,
+    RESIDENCY_STRUCTURAL_DOWNGRADE,
+)
+from seldon_core_tpu.analysis.planlint import plan_edges
+from seldon_core_tpu.graph.spec import PredictiveUnit
+
+IRIS = "seldon_core_tpu.models.iris:IrisClassifier"
+MLP = "seldon_core_tpu.models.mlp:MNISTMLP"
+RESNET = "seldon_core_tpu.models.resnet:ResNet50Model"
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "graphs")
+
+PLANE_ON = {"seldon.io/device-plane": "true"}
+PLANE_OFF = {"seldon.io/device-plane": "false"}
+
+
+def _model(name, model_class, extra_params=(), children=()):
+    return {
+        "name": name,
+        "type": "MODEL",
+        "parameters": [
+            {"name": "model_class", "value": model_class, "type": "STRING"},
+            *extra_params,
+        ],
+        "children": list(children),
+    }
+
+
+def _remote(name, transport, extra_params=(), children=()):
+    return {
+        "name": name,
+        "type": "MODEL",
+        "parameters": list(extra_params),
+        "endpoint": {
+            "service_host": f"{name}.default.svc",
+            "service_port": 9000,
+            "type": transport,
+        },
+        "children": list(children),
+    }
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def the(findings, code):
+    hits = [f for f in findings if f.code == code]
+    assert len(hits) == 1, f"expected exactly one {code}, got {findings}"
+    return hits[0]
+
+
+def gl18(findings):
+    return [f for f in findings if f.code.startswith("GL18")]
+
+
+# ---------------------------------------------------------------------------
+# gating: the pass only runs when the device-plane family is present
+# ---------------------------------------------------------------------------
+
+def test_no_plane_annotation_means_no_gl18():
+    assert gl18(lint_graph(_model("m", IRIS))) == []
+
+
+def test_malformed_plane_value_owned_by_gl1701():
+    ann = {"seldon.io/device-plane": "maybe"}
+    fs = lint_graph(_model("m", IRIS), annotations=ann)
+    assert gl18(fs) == []  # GL1701 already rejected the posture
+    assert "GL1701" in codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL1801: structural byte downgrade on a plane-on remote fast path
+# ---------------------------------------------------------------------------
+
+GL1801_BAD = _model("iris", IRIS, children=[_remote("post", "REST")])
+
+
+def test_gl1801_rest_edge_can_never_negotiate():
+    f = the(lint_graph(GL1801_BAD, annotations=PLANE_ON),
+            RESIDENCY_STRUCTURAL_DOWNGRADE)
+    assert f.severity == "ERROR"
+    assert f.path == "iris/post"
+    assert "REST" in f.message
+    assert "iris -> post" in f.message
+
+
+def test_gl1801_fixed_grpc_edge_is_quiet():
+    fixed = _model("iris", IRIS, children=[_remote("post", "GRPC")])
+    fs = lint_graph(fixed, annotations=PLANE_ON)
+    assert RESIDENCY_STRUCTURAL_DOWNGRADE not in codes(fs)
+
+
+def test_gl1801_fixed_explicit_remote_off_is_quiet():
+    ann = dict(PLANE_ON, **{"seldon.io/device-plane-remote": "off"})
+    fs = lint_graph(GL1801_BAD, annotations=ann)
+    assert RESIDENCY_STRUCTURAL_DOWNGRADE not in codes(fs)
+
+
+def test_gl1801_plane_off_is_quiet():
+    fs = lint_graph(GL1801_BAD, annotations=PLANE_OFF)
+    assert RESIDENCY_STRUCTURAL_DOWNGRADE not in codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL1802: donated one-shot handle with a second consumer
+# ---------------------------------------------------------------------------
+
+GL1802_FANOUT_BAD = {
+    "name": "ens", "type": "COMBINER",
+    "implementation": "AVERAGE_COMBINER",
+    "children": [_remote("left", "GRPC"), _remote("right", "GRPC")],
+}
+
+
+def test_gl1802_fanout_second_consumer_sees_dead_ref():
+    f = the(lint_graph(GL1802_FANOUT_BAD, annotations=PLANE_ON),
+            RESIDENCY_DONATED_SHARED)
+    assert f.severity == "ERROR"
+    assert f.path == "ens"
+    assert "one-shot" in f.message
+    # related carries the first and second consumer, in order
+    related = dict(f.related)
+    assert "ens/left" in related and "ens/right" in related
+    assert "first consumer" in related["ens/left"]
+    assert "second consumer" in related["ens/right"]
+
+
+def test_gl1802_router_dispatches_to_one_child_only():
+    fixed = {
+        "name": "ab", "type": "ROUTER",
+        "implementation": "RANDOM_ABTEST",
+        "children": [_remote("left", "GRPC"), _remote("right", "GRPC")],
+    }
+    fs = lint_graph(fixed, annotations=PLANE_ON)
+    assert RESIDENCY_DONATED_SHARED not in codes(fs)
+
+
+def test_gl1802_fanout_quiet_when_edges_stay_shared():
+    # remote=off caps every remote edge at host-bytes/shared: no donation
+    ann = dict(PLANE_ON, **{"seldon.io/device-plane-remote": "off"})
+    fs = lint_graph(GL1802_FANOUT_BAD, annotations=ann)
+    assert RESIDENCY_DONATED_SHARED not in codes(fs)
+
+
+def test_gl1802_cache_replays_consumed_reply_handle():
+    spec = _remote("big", "GRPC")
+    ann = dict(PLANE_ON, **{"seldon.io/prediction-cache": "true"})
+    f = the(lint_graph(spec, annotations=ann), RESIDENCY_DONATED_SHARED)
+    assert "cache" in f.message
+    related = dict(f.related)
+    assert "big/<prediction-cache>" in related
+
+
+def test_gl1802_cache_off_is_quiet():
+    fs = lint_graph(_remote("big", "GRPC"), annotations=PLANE_ON)
+    assert RESIDENCY_DONATED_SHARED not in codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL1803: tp→dp reshard inside a fused span
+# ---------------------------------------------------------------------------
+
+MESH_2X2 = {
+    "seldon.io/graph-plan": "fused",
+    "seldon.io/mesh": "dp=2,tp=2",
+}
+
+# MNISTMLP registers tp_param_specs; IrisClassifier is weighted but has
+# no tp layout — fused together under a dp×tp mesh, the span reshards.
+GL1803_BAD = _model("mlp", MLP, children=[_model("iris", IRIS)])
+
+
+def test_gl1803_tp_member_feeds_untp_weighted_member():
+    f = the(lint_graph(GL1803_BAD, annotations=dict(PLANE_ON, **MESH_2X2)),
+            RESIDENCY_RESHARD_HOST_TRIP)
+    assert f.severity == "WARN"
+    assert f.path == "mlp/iris"
+    assert "'mlp'" in f.message and "'iris'" in f.message
+
+
+def test_gl1803_quiet_without_tp_dimension():
+    ann = dict(PLANE_ON, **MESH_2X2)
+    ann["seldon.io/mesh"] = "dp=4,tp=1"
+    fs = lint_graph(GL1803_BAD, annotations=ann)
+    assert RESIDENCY_RESHARD_HOST_TRIP not in codes(fs)
+
+
+def test_gl1803_quiet_in_walk_mode():
+    ann = dict(PLANE_ON, **{"seldon.io/mesh": "dp=2,tp=2"})  # no fused plan
+    fs = lint_graph(GL1803_BAD, annotations=ann)
+    assert RESIDENCY_RESHARD_HOST_TRIP not in codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL1804: deadline feasible on budgets, infeasible with transition costs
+# ---------------------------------------------------------------------------
+
+def _gl1804_spec():
+    # two 4ms budgets (base 8ms); the entry edge and the byte-capped
+    # remote edge each move 256×1000 float32 rows at host-bytes tier
+    t4 = [{"name": "timeout_ms", "value": "4", "type": "INT"}]
+    return _model(
+        "top", RESNET, extra_params=t4,
+        children=[_remote(
+            "tail", "REST",
+            extra_params=[
+                {"name": "model_class", "value": RESNET, "type": "STRING"},
+                *t4,
+            ],
+        )],
+    )
+
+
+def _gl1804_ann(deadline):
+    return dict(
+        PLANE_ON,
+        **{
+            "seldon.io/device-plane-remote": "off",  # bytes by choice
+            "seldon.io/engine-walk-timeout-ms": str(deadline),
+            "seldon.io/batch-max-size": "256",
+        },
+    )
+
+
+def test_gl1804_transition_costs_break_the_deadline():
+    f = the(lint_graph(_gl1804_spec(), annotations=_gl1804_ann(8.5)),
+            RESIDENCY_DEADLINE_INFEASIBLE)
+    assert f.severity == "WARN"
+    assert f.path == "top"
+    assert "8ms" in f.message  # budgets alone fit
+
+
+def test_gl1804_quiet_when_deadline_absorbs_transitions():
+    fs = lint_graph(_gl1804_spec(), annotations=_gl1804_ann(60))
+    assert RESIDENCY_DEADLINE_INFEASIBLE not in codes(fs)
+
+
+def test_gl1804_defers_to_gl301_when_budgets_alone_blow_it():
+    fs = lint_graph(_gl1804_spec(), annotations=_gl1804_ann(7))
+    assert RESIDENCY_DEADLINE_INFEASIBLE not in codes(fs)
+    assert "GL301" in codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL1805: the residency map itself, in both postures
+# ---------------------------------------------------------------------------
+
+def test_gl1805_reports_the_planned_map():
+    spec = _model("iris", IRIS, children=[_remote("post", "GRPC")])
+    f = the(lint_graph(spec, annotations=PLANE_ON), RESIDENCY_MAP_REPORT)
+    assert f.severity == "INFO"
+    assert "device plane on" in f.message
+    assert "<request>->iris host-bytes/replicated/shared" in f.message
+    assert "iris->post loopback-ref/replicated/one-shot" in f.message
+
+
+def test_gl1805_plane_off_posture_prices_remote_edges_as_bytes():
+    spec = _model("iris", IRIS, children=[_remote("post", "GRPC")])
+    fs = lint_graph(spec, annotations=PLANE_OFF)
+    assert codes(gl18(fs)) == [RESIDENCY_MAP_REPORT]
+    f = the(fs, RESIDENCY_MAP_REPORT)
+    assert "device plane off" in f.message
+    assert "iris->post host-bytes/replicated/shared" in f.message
+
+
+# ---------------------------------------------------------------------------
+# plan_edges: the pure abstract interpretation (reused by
+# GraphPlan.residency_map — parity covered in test_graph_plan.py)
+# ---------------------------------------------------------------------------
+
+def test_plan_edges_fused_interior_stays_in_hbm():
+    spec = _model("a", IRIS, children=[_model("b", IRIS)])
+    unit = PredictiveUnit.from_dict(spec)
+    ann = dict(PLANE_ON, **{"seldon.io/graph-plan": "fused"})
+    entry, interior = plan_edges(unit, ann)
+    assert (entry.src, entry.dst) == ("<request>", "a")
+    assert entry.state.tier == "host-bytes"
+    assert not entry.fused
+    assert (interior.src, interior.dst) == ("a", "b")
+    assert interior.state.tier == "hbm-handle"
+    assert interior.state.ownership == "shared"
+    assert interior.fused
+
+
+# ---------------------------------------------------------------------------
+# the planlint smoke the CI job runs: every shipped example graph lints
+# clean with the plane forced on AND off
+# ---------------------------------------------------------------------------
+
+def test_examples_lint_clean_in_both_postures(capsys):
+    graphs = sorted(glob.glob(os.path.join(EXAMPLES, "*.json")))
+    assert graphs, "no example graphs found"
+    for posture in ("on", "off"):
+        rc = analysis_main([*graphs, "--plan", posture, "--fail-on", "warn"])
+        capsys.readouterr()
+        assert rc == 0, f"examples dirty with --plan {posture}"
+
+
+# ---------------------------------------------------------------------------
+# admission: a GL1801 spec is rejected before any pod exists, with the
+# finding on status.analysis
+# ---------------------------------------------------------------------------
+
+def test_gl1801_rejected_at_admission_with_status_analysis():
+    from seldon_core_tpu.operator.reconcile import (
+        FakeKubeApi,
+        SeldonDeploymentWatcher,
+    )
+
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha3",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "downgrade-dep", "namespace": "default"},
+        "spec": {
+            "name": "downgrade-dep",
+            "annotations": dict(PLANE_ON),
+            "predictors": [{"name": "main", "graph": GL1801_BAD}],
+        },
+    }
+    api = FakeKubeApi()
+    watcher = SeldonDeploymentWatcher(api, namespace="default")
+    api.create(cr)
+    watcher.run_once()
+    got = api.get("SeldonDeployment", "default", "downgrade-dep")
+    assert got["status"]["state"] == "Failed"
+    assert "GL1801" in got["status"]["description"]
+    analysis = got["status"]["analysis"]
+    f = next(a for a in analysis if a["code"] == "GL1801")
+    assert f["severity"] == "ERROR"
+    assert f["path"] == "main/iris/post"
+    # errors lead, but the residency map (GL1805) rides along as context
+    assert analysis[0]["severity"] == "ERROR"
+    m = next(a for a in analysis if a["code"] == RESIDENCY_MAP_REPORT)
+    assert m["severity"] == "INFO"
+    # nothing half-created
+    assert api.list("Deployment", "default") == []

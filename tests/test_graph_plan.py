@@ -417,3 +417,30 @@ def test_operator_rejects_bad_plan_annotation():
     })
     with pytest.raises(DeploymentValidationError):
         graph_plan_mode(dep, dep.predictors[0])
+
+
+def test_residency_map_reports_planned_edge_states():
+    # ISSUE 20: the compiled plan exposes the same per-edge residency
+    # map the GL18xx admission lint computes offline (planlint parity)
+    spec = {
+        "name": "ens", "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [mlp_node(f"m{i}", seed=i) for i in range(2)],
+    }
+    eng = GraphEngine(spec, resolver=resolver_for(), name="p",
+                      plan_mode="fused")
+    assert eng.plan is not None and eng.plan.fully_fused
+    rows = eng.plan.residency_map()
+    by = {(r["src"], r["dst"]): r for r in rows}
+    entry = by[("<request>", "ens")]
+    assert entry["tier"] == "host-bytes" and not entry["fused"]
+    for i in range(2):
+        e = by[("ens", f"m{i}")]
+        assert e["tier"] == "hbm-handle"
+        assert e["ownership"] == "shared"
+        assert e["fused"] and not e["remote"]
+        assert e["partition"] == "replicated"  # no mesh annotation
+    # under a tp mesh the fused members report their sharded layout
+    sharded = eng.plan.residency_map({"seldon.io/mesh": "dp=2,tp=2"})
+    by = {(r["src"], r["dst"]): r for r in sharded}
+    assert by[("ens", "m0")]["partition"] == "tp"
